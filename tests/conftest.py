@@ -1,0 +1,30 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip). Env vars must be set
+before jax imports anywhere, hence this top-of-conftest block.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def ctx():
+    """Fresh local Context per test. The Env (shuffle store, trackers) is a
+    process singleton like the reference's (src/env.rs:38-40), so contexts
+    must not overlap — function scope guarantees that."""
+    import vega_tpu as v
+
+    context = v.Context("local", num_workers=4)
+    yield context
+    context.stop()

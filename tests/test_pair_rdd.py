@@ -1,0 +1,192 @@
+"""Pair-op golden tests (reference: tests/test_pair_rdd.rs)."""
+
+import pytest
+
+import vega_tpu as v
+
+
+def test_group_by_key(ctx):
+    """Reference: test_pair_rdd.rs:9-38."""
+    pairs = ctx.parallelize(
+        [("a", 1), ("b", 2), ("a", 3), ("c", 4), ("a", 5)], 3
+    )
+    grouped = dict(pairs.group_by_key(2).collect())
+    assert sorted(grouped["a"]) == [1, 3, 5]
+    assert grouped["b"] == [2]
+    assert grouped["c"] == [4]
+
+
+def test_reduce_by_key(ctx):
+    """Reference: pair_rdd.rs:54-80."""
+    pairs = ctx.parallelize([(i % 4, i) for i in range(100)], 5)
+    result = dict(pairs.reduce_by_key(lambda a, b: a + b, 3).collect())
+    expected = {}
+    for i in range(100):
+        expected[i % 4] = expected.get(i % 4, 0) + i
+    assert result == expected
+
+
+def test_combine_by_key(ctx):
+    """Reference: pair_rdd.rs:20-33."""
+    pairs = ctx.parallelize([("x", 1), ("y", 2), ("x", 3)], 2)
+    result = dict(
+        pairs.combine_by_key(
+            lambda value: [value],
+            lambda combiner, value: combiner + [value],
+            lambda c1, c2: c1 + c2,
+            2,
+        ).collect()
+    )
+    assert sorted(result["x"]) == [1, 3]
+    assert result["y"] == [2]
+
+
+def test_fold_by_key(ctx):
+    pairs = ctx.parallelize([(i % 3, 1) for i in range(30)], 4)
+    result = dict(pairs.fold_by_key(0, lambda a, b: a + b, 3).collect())
+    assert result == {0: 10, 1: 10, 2: 10}
+
+
+def test_aggregate_by_key(ctx):
+    pairs = ctx.parallelize([("k", i) for i in range(10)], 3)
+    result = dict(
+        pairs.aggregate_by_key(
+            (0, 0),
+            lambda acc, x: (acc[0] + x, acc[1] + 1),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            2,
+        ).collect()
+    )
+    assert result == {"k": (45, 10)}
+
+
+def test_map_values(ctx):
+    """Reference: pair_rdd.rs:82-91."""
+    pairs = ctx.parallelize([("a", 1), ("b", 2)], 2)
+    assert sorted(pairs.map_values(lambda x: x * 10).collect()) == [
+        ("a", 10), ("b", 20)
+    ]
+
+
+def test_flat_map_values(ctx):
+    """Reference: pair_rdd.rs:93-102."""
+    pairs = ctx.parallelize([("a", [1, 2]), ("b", [3])], 2)
+    assert sorted(pairs.flat_map_values(lambda x: x).collect()) == [
+        ("a", 1), ("a", 2), ("b", 3)
+    ]
+
+
+def test_join(ctx):
+    """Reference: test_pair_rdd.rs:40-83."""
+    a = ctx.parallelize([(1, "a1"), (2, "a2"), (3, "a3")], 2)
+    b = ctx.parallelize([(1, "b1"), (2, "b2"), (2, "b3"), (4, "b4")], 2)
+    joined = sorted(a.join(b).collect())
+    assert joined == [
+        (1, ("a1", "b1")), (2, ("a2", "b2")), (2, ("a2", "b3"))
+    ]
+
+
+def test_outer_joins(ctx):
+    a = ctx.parallelize([(1, "a"), (2, "b")], 2)
+    b = ctx.parallelize([(2, "x"), (3, "y")], 2)
+    assert sorted(a.left_outer_join(b).collect()) == [
+        (1, ("a", None)), (2, ("b", "x"))
+    ]
+    assert sorted(a.right_outer_join(b).collect()) == [
+        (2, ("b", "x")), (3, (None, "y"))
+    ]
+    assert sorted(a.full_outer_join(b).collect()) == [
+        (1, ("a", None)), (2, ("b", "x")), (3, (None, "y"))
+    ]
+
+
+def test_cogroup(ctx):
+    """Reference: pair_rdd.rs:123-155 / co_grouped_rdd.rs."""
+    a = ctx.parallelize([(1, "a"), (1, "aa"), (2, "b")], 2)
+    b = ctx.parallelize([(1, "x"), (3, "z")], 2)
+    grouped = dict(a.cogroup(b).collect())
+    assert sorted(grouped[1][0]) == ["a", "aa"]
+    assert grouped[1][1] == ["x"]
+    assert grouped[2] == (["b"], [])
+    assert grouped[3] == ([], ["z"])
+
+
+def test_cogroup_narrow_when_copartitioned(ctx):
+    """Co-partitioned parents take the narrow path
+    (reference: co_grouped_rdd.rs:102-127)."""
+    part = v.HashPartitioner(3)
+    a = ctx.parallelize([(i, i) for i in range(30)], 4).reduce_by_key(
+        lambda x, y: x + y, part
+    )
+    b = ctx.parallelize([(i, i * 2) for i in range(30)], 4).reduce_by_key(
+        lambda x, y: x + y, part
+    )
+    assert a.partitioner == part
+    cg = a.cogroup(b, partitioner_or_num=part)
+    # narrow edges: no new shuffle deps on co-partitioned parents
+    from vega_tpu.dependency import ShuffleDependency
+
+    shuffle_deps = [
+        d for d in cg.get_dependencies() if isinstance(d, ShuffleDependency)
+    ]
+    assert shuffle_deps == []
+    grouped = dict(cg.collect())
+    assert grouped[5] == ([5], [10])
+
+
+def test_partition_by_key(ctx):
+    """Reference: pair_rdd.rs:157-173."""
+    pairs = ctx.parallelize([(i, i) for i in range(50)], 3)
+    repartitioned = pairs.partition_by_key(5)
+    assert repartitioned.num_partitions == 5
+    assert sorted(repartitioned.collect()) == [(i, i) for i in range(50)]
+    part = repartitioned.partitioner
+    glommed = repartitioned.glom().collect()
+    for pid, chunk in enumerate(glommed):
+        for k, _ in chunk:
+            assert part.get_partition(k) == pid
+
+
+def test_count_by_key(ctx):
+    pairs = ctx.parallelize([("a", 1), ("a", 2), ("b", 9)], 2)
+    assert pairs.count_by_key() == {"a": 2, "b": 1}
+
+
+def test_collect_as_map_and_lookup(ctx):
+    pairs = ctx.parallelize([(1, "x"), (2, "y")], 2)
+    assert pairs.collect_as_map() == {1: "x", 2: "y"}
+    shuffled = pairs.reduce_by_key(lambda a, b: a, 2)
+    assert shuffled.lookup(1) == ["x"]
+    assert shuffled.lookup(99) == []
+
+
+def test_sort_by_key(ctx):
+    import random
+
+    items = [(i, str(i)) for i in range(300)]
+    random.Random(5).shuffle(items)
+    rdd = ctx.parallelize(items, 6)
+    result = rdd.sort_by_key(num_partitions=4).collect()
+    assert result == sorted(items)
+    desc = rdd.sort_by_key(ascending=False, num_partitions=4).collect()
+    assert desc == sorted(items, reverse=True)
+
+
+def test_subtract_by_key(ctx):
+    a = ctx.parallelize([(1, "a"), (2, "b"), (3, "c")], 2)
+    b = ctx.parallelize([(2, "zzz")], 1)
+    assert sorted(a.subtract_by_key(b).collect()) == [(1, "a"), (3, "c")]
+
+
+def test_keys_values(ctx):
+    pairs = ctx.parallelize([(1, "a"), (2, "b")], 2)
+    assert sorted(pairs.keys().collect()) == [1, 2]
+    assert sorted(pairs.values().collect()) == ["a", "b"]
+
+
+def test_group_by(ctx):
+    """Reference: test_pair_rdd.rs:112-134."""
+    rdd = ctx.make_rdd(list(range(20)), 3)
+    grouped = dict(rdd.group_by(lambda x: x % 2, 2).collect())
+    assert sorted(grouped[0]) == list(range(0, 20, 2))
+    assert sorted(grouped[1]) == list(range(1, 20, 2))

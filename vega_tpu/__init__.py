@@ -1,0 +1,49 @@
+"""vega_tpu: a TPU-native distributed data-processing framework.
+
+Same capabilities as rajasekarv/vega (a Rust reimplementation of the Apache
+Spark RDD core): lazy RDD lineage, the full transformation/action surface, a
+stage-cutting DAG scheduler, driver/executor runtime, and distributed shuffle
+— re-architected for TPU. Numeric partitions execute as jitted XLA shard
+programs on a JAX device mesh (vega_tpu.tpu); hash shuffles lower to
+sort-based exchanges / all_to_all collectives over ICI instead of the
+reference's HTTP pull shuffle; the host tier keeps full generality for
+arbitrary Python objects.
+"""
+
+from vega_tpu.aggregator import Aggregator
+from vega_tpu.context import Context
+from vega_tpu.env import Configuration, DeploymentMode, Env
+from vega_tpu.errors import (
+    FetchFailedError,
+    NetworkError,
+    PartialJobError,
+    ShuffleError,
+    TaskError,
+    VegaError,
+)
+from vega_tpu.partial.bounded_double import BoundedDouble
+from vega_tpu.partial.partial_result import PartialResult
+from vega_tpu.partitioner import HashPartitioner, Partitioner, RangePartitioner
+from vega_tpu.rdd.base import RDD
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Aggregator",
+    "BoundedDouble",
+    "Configuration",
+    "Context",
+    "DeploymentMode",
+    "Env",
+    "FetchFailedError",
+    "HashPartitioner",
+    "NetworkError",
+    "PartialJobError",
+    "PartialResult",
+    "Partitioner",
+    "RangePartitioner",
+    "RDD",
+    "ShuffleError",
+    "TaskError",
+    "VegaError",
+]

@@ -1,0 +1,47 @@
+"""Shuffle-side combiner triple (reference: src/aggregator.rs).
+
+create_combiner / merge_value / merge_combiners exactly as in the reference
+(src/aggregator.rs:8-31); the default list-collecting aggregator used by
+group_by_key mirrors src/aggregator.rs:33-53.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+C = TypeVar("C")
+
+
+class Aggregator(Generic[K, V, C]):
+    __slots__ = ("create_combiner", "merge_value", "merge_combiners")
+
+    def __init__(
+        self,
+        create_combiner: Callable[[V], C],
+        merge_value: Callable[[C, V], C],
+        merge_combiners: Callable[[C, C], C],
+    ):
+        self.create_combiner = create_combiner
+        self.merge_value = merge_value
+        self.merge_combiners = merge_combiners
+
+    @staticmethod
+    def default() -> "Aggregator":
+        """List-collecting aggregator for group_by (reference: aggregator.rs:33-53)."""
+        return Aggregator(
+            create_combiner=lambda v: [v],
+            merge_value=_append,
+            merge_combiners=_extend,
+        )
+
+
+def _append(c, v):
+    c.append(v)
+    return c
+
+
+def _extend(c1, c2):
+    c1.extend(c2)
+    return c1

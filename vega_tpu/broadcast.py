@@ -1,0 +1,59 @@
+"""Broadcast variables (vega_tpu addition; the reference has none — its only
+data-distribution primitive is the shuffle).
+
+Local mode: shared by reference. Distributed mode: the value ships pickled
+inside the Broadcast handle once per task, and executors memoize it in the
+BROADCAST key space of the bounded cache so repeated tasks on one executor
+deserialize once.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any
+
+from vega_tpu import serialization
+from vega_tpu.cache import KeySpace
+from vega_tpu.env import Env
+
+_next_id = itertools.count(0)
+_local_values: dict = {}
+_lock = threading.Lock()
+
+
+class Broadcast:
+    def __init__(self, _ctx, value: Any):
+        self.id = next(_next_id)
+        with _lock:
+            _local_values[self.id] = value
+        self._payload = None  # lazily pickled on first serialization
+
+    @property
+    def value(self) -> Any:
+        with _lock:
+            if self.id in _local_values:
+                return _local_values[self.id]
+        env = Env.get()
+        cached = env.cache.get(KeySpace.BROADCAST, self.id, 0)
+        if cached is not None:
+            return cached
+        value = serialization.loads(self._payload)
+        env.cache.put(KeySpace.BROADCAST, self.id, 0, value)
+        return value
+
+    def unpersist(self) -> None:
+        with _lock:
+            _local_values.pop(self.id, None)
+        Env.get().cache.remove_datum(KeySpace.BROADCAST, self.id)
+
+    def __getstate__(self):
+        if self._payload is None:
+            with _lock:
+                value = _local_values.get(self.id)
+            self._payload = serialization.dumps(value)
+        return {"id": self.id, "_payload": self._payload}
+
+    def __setstate__(self, state):
+        self.id = state["id"]
+        self._payload = state["_payload"]

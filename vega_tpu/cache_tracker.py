@@ -1,0 +1,84 @@
+"""Cached-partition location registry + get_or_compute.
+
+Reference: src/cache_tracker.rs — driver-side rdd->partition->hosts registry
+(:289-317) feeding scheduler cache locality, and the get_or_compute
+partition materializer (:327-365) that the reference never actually calls
+(SURVEY.md §2.6). vega_tpu calls it from RDD.iterator, completing the cache
+feature.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from vega_tpu.cache import KeySpace
+from vega_tpu.env import Env
+
+
+class CacheTracker:
+    def __init__(self):
+        # rdd_id -> partition -> [host uris]
+        self._locs: Dict[int, Dict[int, List[str]]] = {}
+        self._lock = threading.Lock()
+
+    def register_rdd(self, rdd_id: int, num_partitions: int) -> None:
+        with self._lock:
+            self._locs.setdefault(rdd_id, {})
+
+    def unregister_rdd(self, rdd_id: int) -> None:
+        with self._lock:
+            self._locs.pop(rdd_id, None)
+
+    def add_host(self, rdd_id: int, partition: int, host: str) -> None:
+        with self._lock:
+            self._locs.setdefault(rdd_id, {}).setdefault(partition, [])
+            if host not in self._locs[rdd_id][partition]:
+                self._locs[rdd_id][partition].insert(0, host)
+
+    def drop_host(self, rdd_id: int, partition: int, host: str) -> None:
+        with self._lock:
+            locs = self._locs.get(rdd_id, {}).get(partition, [])
+            if host in locs:
+                locs.remove(host)
+
+    def get_location_snapshot(self) -> Dict[int, Dict[int, List[str]]]:
+        """Reference: cache_tracker.rs:302-317."""
+        with self._lock:
+            return {
+                rdd: {p: list(hosts) for p, hosts in parts.items()}
+                for rdd, parts in self._locs.items()
+            }
+
+    def get_cache_locs(self, rdd_id: int, partition: int) -> List[str]:
+        with self._lock:
+            return list(self._locs.get(rdd_id, {}).get(partition, []))
+
+
+# Per-partition materialization locks so two tasks computing the same cached
+# partition don't duplicate work (the reference busy-waits on a 'loading' set,
+# cache_tracker.rs:337-340).
+_loading_locks: Dict = {}
+_loading_guard = threading.Lock()
+
+
+def get_or_compute(rdd, split, task_context=None):
+    """Reference: cache_tracker.rs:327-365."""
+    env = Env.get()
+    key = (KeySpace.RDD, rdd.rdd_id, split.index)
+    cached = env.cache.get(*key)
+    if cached is not None:
+        return iter(cached)
+    with _loading_guard:
+        lock = _loading_locks.setdefault(key, threading.Lock())
+    with lock:
+        cached = env.cache.get(*key)
+        if cached is not None:
+            return iter(cached)
+        data = list(rdd.compute(split, task_context))
+        env.cache.put(KeySpace.RDD, rdd.rdd_id, split.index, data)
+        tracker = env.cache_tracker
+        if tracker is not None:
+            host = env.executor_id or "local"
+            tracker.add_host(rdd.rdd_id, split.index, host)
+        return iter(data)

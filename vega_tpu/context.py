@@ -1,0 +1,281 @@
+"""Driver entry point (reference: src/context.rs).
+
+Owns RDD/shuffle id counters (context.rs:398-404), RDD constructors
+(make_rdd/parallelize/range/read_source/union, context.rs:406-455,537-539) and
+job runners (run_job/run_approximate_job, context.rs:457-524). Deployment mode
+selects the task backend: local thread pool, distributed executor fleet
+(vega_tpu/distributed), with the device tier layered on top for numeric RDDs
+(vega_tpu/tpu).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+from vega_tpu.cache_tracker import CacheTracker
+from vega_tpu.env import Configuration, DeploymentMode, Env
+from vega_tpu.map_output_tracker import MapOutputTracker
+from vega_tpu.partial.partial_result import PartialResult
+from vega_tpu.rdd.base import RDD
+from vega_tpu.scheduler.dag import DAGScheduler
+from vega_tpu.scheduler.events import LiveListenerBus, MetricsListener
+from vega_tpu.scheduler.local_backend import LocalBackend
+
+log = logging.getLogger("vega_tpu")
+
+_active_context_lock = threading.Lock()
+_active_context: Optional["Context"] = None
+
+
+class Context:
+    def __init__(self, mode: str | DeploymentMode = "local",
+                 conf: Optional[Configuration] = None, **conf_overrides):
+        global _active_context
+        if isinstance(mode, str):
+            mode = DeploymentMode(mode)
+        conf = conf or Configuration.from_environ()
+        conf.deployment_mode = mode
+        for key, value in conf_overrides.items():
+            if not hasattr(conf, key):
+                raise TypeError(f"unknown configuration field: {key}")
+            setattr(conf, key, value)
+        self.conf = conf
+        env = Env.reset(conf, is_driver=True)
+        env.map_output_tracker = MapOutputTracker()
+        env.cache_tracker = CacheTracker()
+
+        self._next_rdd_id = itertools.count(0)
+        self._next_shuffle_id = itertools.count(0)
+        self._stopped = False
+
+        self.bus = LiveListenerBus()
+        self.metrics = MetricsListener()
+        self.bus.add_listener(self.metrics)
+        self.bus.start()
+
+        if mode is DeploymentMode.LOCAL:
+            self._backend = LocalBackend()
+        else:
+            from vega_tpu.distributed.backend import DistributedBackend
+
+            self._backend = DistributedBackend(conf)
+        self.scheduler = DAGScheduler(self._backend, self.bus)
+        with _active_context_lock:
+            _active_context = self
+
+    # ------------------------------------------------------------------ ids
+    def new_rdd_id(self) -> int:
+        """Reference: context.rs:398-400."""
+        return next(self._next_rdd_id)
+
+    def new_shuffle_id(self) -> int:
+        """Reference: context.rs:402-404."""
+        return next(self._next_shuffle_id)
+
+    # ----------------------------------------------------------- constructors
+    def parallelize(self, data: Sequence, num_slices: Optional[int] = None) -> RDD:
+        """Reference: context.rs:406-420 (make_rdd/parallelize)."""
+        from vega_tpu.rdd.narrow import ParallelCollectionRDD
+
+        n = num_slices or self.default_parallelism
+        return ParallelCollectionRDD(self, data, n)
+
+    make_rdd = parallelize
+
+    def range(self, start: int, stop: Optional[int] = None, step: int = 1,
+              num_slices: Optional[int] = None) -> RDD:
+        """Reference: context.rs:422-442. Lazy: slices of a Python range are
+        ranges, so no materialization happens until compute."""
+        if stop is None:
+            start, stop = 0, start
+        return self.parallelize(range(start, stop, step), num_slices)
+
+    def union(self, rdds: List[RDD]) -> RDD:
+        """Reference: context.rs:537-539."""
+        from vega_tpu.rdd.union import UnionRDD
+
+        return UnionRDD(self, rdds)
+
+    def empty_rdd(self) -> RDD:
+        return self.parallelize([], 1)
+
+    def read_source(self, config, decoder: Optional[Callable] = None) -> RDD:
+        """Reference: context.rs:445-455 + src/io/local_file_reader.rs."""
+        rdd = config.make_reader(self)
+        if decoder is not None:
+            rdd = rdd.map(decoder)
+        return rdd
+
+    def text_file(self, path: str, num_partitions: Optional[int] = None) -> RDD:
+        from vega_tpu.io.readers import TextFileReaderConfig
+
+        return self.read_source(
+            TextFileReaderConfig(path, num_partitions or self.default_parallelism)
+        )
+
+    def whole_text_files(self, path: str) -> RDD:
+        from vega_tpu.io.readers import WholeFileReaderConfig
+
+        return self.read_source(WholeFileReaderConfig(path))
+
+    def parquet_file(self, path: str, columns: Optional[List[str]] = None,
+                     num_partitions: Optional[int] = None) -> RDD:
+        from vega_tpu.io.readers import ParquetReaderConfig
+
+        return self.read_source(
+            ParquetReaderConfig(path, columns,
+                                num_partitions or self.default_parallelism)
+        )
+
+    # Device-tier sources (vega_tpu/tpu): numeric RDDs whose partitions are
+    # arrays and whose ops lower to XLA.
+    def dense_range(self, n: int, num_partitions: Optional[int] = None,
+                    dtype=None):
+        from vega_tpu.tpu.dense_rdd import dense_range
+
+        return dense_range(self, n, num_partitions or self.default_parallelism,
+                           dtype)
+
+    def dense_from_numpy(self, *columns, num_partitions: Optional[int] = None):
+        from vega_tpu.tpu.dense_rdd import dense_from_numpy
+
+        return dense_from_numpy(
+            self, columns, num_partitions or self.default_parallelism
+        )
+
+    def broadcast(self, value: Any):
+        """Driver-side broadcast variable (absent from the reference; Spark
+        parity). Local mode shares by reference; distributed mode ships once
+        per executor and caches in the BROADCAST key space."""
+        from vega_tpu.broadcast import Broadcast
+
+        return Broadcast(self, value)
+
+    # ------------------------------------------------------------------ jobs
+    def run_job(self, rdd: RDD, func: Callable,
+                partitions: Optional[List[int]] = None) -> list:
+        """Reference: context.rs:457-473."""
+        self._check_alive()
+        return self.scheduler.run_job(rdd, func, partitions)
+
+    def run_approximate_job(self, rdd: RDD, func: Callable, evaluator,
+                            timeout_s: float) -> PartialResult:
+        """Reference: context.rs:510-524 + approximate_action_listener.rs."""
+        self._check_alive()
+        done = threading.Event()
+        failure: List[BaseException] = []
+
+        def runner():
+            try:
+                self.scheduler.run_job_with_listener(
+                    rdd, func, list(range(rdd.num_partitions)), evaluator.merge
+                )
+            except BaseException as exc:  # noqa: BLE001
+                failure.append(exc)
+            finally:
+                done.set()
+
+        thread = threading.Thread(target=runner, name="approx-job", daemon=True)
+        start = time.time()
+        thread.start()
+        finished = done.wait(timeout_s)
+        if finished and not failure:
+            value = evaluator.current_result()
+            log.debug("approximate job finished in %.3fs", time.time() - start)
+            return PartialResult(value, is_final=True)
+        if finished and failure:
+            result: PartialResult = PartialResult(None, is_final=False)
+            result.set_failure(failure[0])
+            return result
+        # Deadline hit: return the current estimate, deliver the final value
+        # when the background job drains (reference:
+        # approximate_action_listener.rs:58-111).
+        result = PartialResult(evaluator.current_result(), is_final=False)
+
+        def finisher():
+            thread.join()
+            if failure:
+                result.set_failure(failure[0])
+            else:
+                result.set_final_value(evaluator.current_result())
+
+        threading.Thread(target=finisher, daemon=True).start()
+        return result
+
+    # ----------------------------------------------------------------- admin
+    @property
+    def default_parallelism(self) -> int:
+        return max(2, self._backend.parallelism)
+
+    def metrics_summary(self) -> dict:
+        return self.metrics.summary()
+
+    def stop(self) -> None:
+        """Reference: context.rs:131-144 (drop/cleanup)."""
+        global _active_context
+        if self._stopped:
+            return
+        self._stopped = True
+        self.scheduler.stop()
+        env = Env.get()
+        env.shuffle_store.clear()
+        env.cache.clear()
+        with _active_context_lock:
+            if _active_context is self:
+                _active_context = None
+
+    def _check_alive(self):
+        if self._stopped:
+            raise RuntimeError("Context is stopped")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------- pickling
+    # RDD lineages hold a Context reference; tasks serialize lineages. The
+    # Context itself must not travel (it owns threads and sockets) — ship a
+    # handle that rebinds to the process-active context, mirroring the
+    # reference's weak Context ref inside RddVals (rdd/rdd.rs:54-76).
+    def __reduce__(self):
+        return (_deserialize_context, ())
+
+
+class _StubContext:
+    """Context stand-in inside executor processes: id counters only."""
+
+    def __init__(self):
+        self._next_rdd_id = itertools.count(1 << 40)
+        self._next_shuffle_id = itertools.count(1 << 40)
+
+    def new_rdd_id(self):
+        return next(self._next_rdd_id)
+
+    def new_shuffle_id(self):
+        return next(self._next_shuffle_id)
+
+    def run_job(self, *_a, **_k):
+        raise RuntimeError("run_job is driver-only; executors compute partitions")
+
+    def __reduce__(self):
+        return (_deserialize_context, ())
+
+
+_stub_context: Optional[_StubContext] = None
+
+
+def _deserialize_context():
+    global _stub_context
+    with _active_context_lock:
+        if _active_context is not None:
+            return _active_context
+    if _stub_context is None:
+        _stub_context = _StubContext()
+    return _stub_context

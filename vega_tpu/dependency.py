@@ -1,0 +1,138 @@
+"""Lineage edges: narrow vs shuffle dependencies.
+
+Reference: src/dependency.rs. The Dependency enum (dependency.rs:15-20),
+OneToOneDependency (:28), RangeDependency (:51), ShuffleDependency (:119-149)
+and the map-side combine loop do_shuffle_task (:164-229) all have direct
+counterparts here.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, List
+
+from vega_tpu import serialization
+from vega_tpu.aggregator import Aggregator
+from vega_tpu.env import Env
+from vega_tpu.partitioner import Partitioner
+
+if TYPE_CHECKING:
+    from vega_tpu.rdd.base import RDD
+
+log = logging.getLogger("vega_tpu")
+
+
+class Dependency:
+    __slots__ = ("rdd",)
+
+    def __init__(self, rdd: "RDD"):
+        self.rdd = rdd
+
+
+class NarrowDependency(Dependency):
+    """Parent partitions used by at most one child partition
+    (reference: src/dependency.rs:22-25)."""
+
+    def get_parents(self, partition_id: int) -> List[int]:
+        raise NotImplementedError
+
+
+class OneToOneDependency(NarrowDependency):
+    """Reference: src/dependency.rs:28-48."""
+
+    def get_parents(self, partition_id: int) -> List[int]:
+        return [partition_id]
+
+
+class RangeDependency(NarrowDependency):
+    """Child partitions [out_start, out_start+length) map 1:1 onto parent
+    partitions [in_start, in_start+length) — used by union
+    (reference: src/dependency.rs:51-89, src/rdd/union_rdd.rs:115-134)."""
+
+    __slots__ = ("in_start", "out_start", "length")
+
+    def __init__(self, rdd: "RDD", in_start: int, out_start: int, length: int):
+        super().__init__(rdd)
+        self.in_start = in_start
+        self.out_start = out_start
+        self.length = length
+
+    def get_parents(self, partition_id: int) -> List[int]:
+        if self.out_start <= partition_id < self.out_start + self.length:
+            return [partition_id - self.out_start + self.in_start]
+        return []
+
+
+class ManyToOneDependency(NarrowDependency):
+    """Child partition <- explicit parent-partition group; used by coalesce
+    (reference: CoalescedSplitDep, src/rdd/coalesced_rdd.rs:94-111)."""
+
+    __slots__ = ("groups",)
+
+    def __init__(self, rdd: "RDD", groups: List[List[int]]):
+        super().__init__(rdd)
+        self.groups = groups
+
+    def get_parents(self, partition_id: int) -> List[int]:
+        return list(self.groups[partition_id])
+
+
+class ShuffleDependency(Dependency):
+    """A stage boundary (reference: src/dependency.rs:119-149).
+
+    Holds the parent RDD, the aggregator (map-side combine) and the output
+    partitioner. `shuffle_id` is allocated by the Context
+    (reference: shuffled_rdd.rs:58-87 via context.rs:398-404).
+    """
+
+    __slots__ = ("shuffle_id", "aggregator", "partitioner", "is_cogroup")
+
+    def __init__(
+        self,
+        shuffle_id: int,
+        rdd: "RDD",
+        aggregator: Aggregator,
+        partitioner: Partitioner,
+        is_cogroup: bool = False,
+    ):
+        super().__init__(rdd)
+        self.shuffle_id = shuffle_id
+        self.aggregator = aggregator
+        self.partitioner = partitioner
+        self.is_cogroup = is_cogroup
+
+    def do_shuffle_task(self, split, task_context=None) -> str:
+        """Map-side combine: bucket parent partition by key, pre-merge, store.
+
+        Reference hot loop 1: src/dependency.rs:164-229 — iterate parent
+        partition, hash each key into its reducer bucket, merge_value into a
+        per-bucket map, serialize each bucket into SHUFFLE_CACHE, return this
+        server's shuffle URI.
+
+        The device tier bypasses this entirely (tpu/exchange.py does a
+        sort-based exchange); this path serves host-tier RDDs.
+        """
+        env = Env.get()
+        n_out = self.partitioner.num_partitions
+        agg = self.aggregator
+        get_partition = self.partitioner.get_partition
+        create = agg.create_combiner
+        merge = agg.merge_value
+
+        buckets = [dict() for _ in range(n_out)]
+        for k, v in self.rdd.iterator(split, task_context):
+            bucket = buckets[get_partition(k)]
+            if k in bucket:
+                bucket[k] = merge(bucket[k], v)
+            else:
+                bucket[k] = create(v)
+
+        for reduce_id, bucket in enumerate(buckets):
+            env.shuffle_store.put(
+                self.shuffle_id,
+                split.index,
+                reduce_id,
+                serialization.dumps(list(bucket.items())),
+            )
+        server_uri = env.shuffle_server.uri if env.shuffle_server else "local"
+        return server_uri

@@ -1,0 +1,128 @@
+"""Process-global environment: config + lazily-started services.
+
+Reference: src/env.rs. The reference holds a lazy singleton bundling the tokio
+runtime, map-output tracker, shuffle manager and cache (env.rs:38-96) plus a
+Configuration read from VEGA_* env vars / a worker-local config.toml
+(env.rs:131-293). vega_tpu keeps the same shape: `Env.get()` is the process
+singleton; configuration comes from VEGA_TPU_* env vars with the same field
+set (deployment_mode, local_ip, local_dir, log_level, shuffle port, ...).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import os
+import tempfile
+import threading
+import uuid
+from typing import Optional
+
+log = logging.getLogger("vega_tpu")
+
+
+class DeploymentMode(enum.Enum):
+    """Reference: src/env.rs:146-149."""
+
+    LOCAL = "local"
+    DISTRIBUTED = "distributed"
+
+
+@dataclasses.dataclass
+class Configuration:
+    """Reference: src/env.rs:162-272 (field-for-field, TPU additions at end)."""
+
+    deployment_mode: DeploymentMode = DeploymentMode.LOCAL
+    local_ip: str = "127.0.0.1"
+    local_dir: str = dataclasses.field(
+        default_factory=lambda: os.path.join(tempfile.gettempdir(), "vega-tpu")
+    )
+    log_level: str = "WARNING"
+    log_cleanup: bool = True
+    shuffle_service_port: Optional[int] = None
+    slave_deployment: bool = False
+    slave_port: Optional[int] = None
+    # --- vega_tpu additions ---
+    # Worker threads for the local scheduler's task pool.
+    num_workers: int = dataclasses.field(
+        default_factory=lambda: os.cpu_count() or 4
+    )
+    # Round-trip tasks through serialization even in local mode, like the
+    # reference does (local_scheduler.rs:345-351): catches unserializable
+    # closures early. Costs wall time; disable for pure-local perf runs.
+    serialize_tasks_locally: bool = False
+    # Cache capacity in bytes for BoundedMemoryCache (reference hardcodes
+    # 2000MB at cache.rs:29; we make it configurable and actually evict).
+    cache_capacity_bytes: int = 2_000 * 1024 * 1024
+    # Scheduler timeouts (reference: distributed_scheduler.rs:87-88).
+    resubmit_timeout_s: float = 2.0
+    poll_timeout_s: float = 0.05
+    # Max task retries before failing the job (reference plumbs max_failures
+    # but never enforces it, local_scheduler.rs:29,57 — we enforce it).
+    max_failures: int = 4
+
+    @staticmethod
+    def from_environ(environ=None) -> "Configuration":
+        env = os.environ if environ is None else environ
+        cfg = Configuration()
+        pref = "VEGA_TPU_"
+        if env.get(pref + "DEPLOYMENT_MODE"):
+            cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
+        for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL"):
+            if env.get(pref + name):
+                setattr(cfg, name.lower(), env[pref + name])
+        for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",
+                     "CACHE_CAPACITY_BYTES", "MAX_FAILURES"):
+            if env.get(pref + name):
+                setattr(cfg, name.lower(), int(env[pref + name]))
+        for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY"):
+            if env.get(pref + name):
+                setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
+        return cfg
+
+
+class Env:
+    """Lazy process singleton (reference: src/env.rs:38-96).
+
+    Bundles the shuffle store, map-output tracker client/server, cache, and
+    cache tracker. Services start on first access, exactly like the
+    reference's once_cell pattern.
+    """
+
+    _instance: Optional["Env"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[Configuration] = None, is_driver: bool = True):
+        from vega_tpu.cache import BoundedMemoryCache
+        from vega_tpu.shuffle.store import ShuffleStore
+
+        self.conf = conf or Configuration.from_environ()
+        self.is_driver = is_driver
+        self.session_id = uuid.uuid4().hex[:12]
+        self.shuffle_store = ShuffleStore()
+        self.cache = BoundedMemoryCache(self.conf.cache_capacity_bytes)
+        self.map_output_tracker = None  # set by Context/Executor at startup
+        self.cache_tracker = None
+        self.shuffle_server = None  # distributed mode only
+        self.executor_id: Optional[str] = None
+
+    @classmethod
+    def get(cls) -> "Env":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = Env()
+        return cls._instance
+
+    @classmethod
+    def reset(cls, conf: Optional[Configuration] = None, is_driver: bool = True) -> "Env":
+        """Replace the singleton (tests / worker bootstrap)."""
+        with cls._lock:
+            cls._instance = Env(conf, is_driver)
+        return cls._instance
+
+    def work_dir(self) -> str:
+        d = os.path.join(self.conf.local_dir, f"session-{self.session_id}")
+        os.makedirs(d, exist_ok=True)
+        return d

@@ -1,0 +1,68 @@
+"""Error hierarchy for vega_tpu.
+
+Mirrors the reference's error taxonomy (reference: src/error.rs:9-130,
+src/shuffle/mod.rs:17-57, src/map_output_tracker.rs:283-287,
+src/partial/mod.rs:19-35) with Python exception classes.
+"""
+
+
+class VegaError(Exception):
+    """Base class for all framework errors (reference: src/error.rs:9)."""
+
+
+class NetworkError(VegaError):
+    """Control/data-plane communication failure (reference: src/error.rs:100-130)."""
+
+
+class ShuffleError(VegaError):
+    """Shuffle write/fetch failure (reference: src/shuffle/mod.rs:17-57)."""
+
+
+class FetchFailedError(ShuffleError):
+    """A reduce task failed to fetch a map output.
+
+    Unlike the reference — where a failed fetch becomes a generic error and the
+    event loop panics (src/distributed_scheduler.rs:272-273) — vega_tpu actually
+    raises this typed error so the DAG scheduler can unregister the map output
+    and resubmit the parent stage (the recovery path the reference built but
+    never triggered, src/scheduler/base_scheduler.rs:172-200).
+    """
+
+    def __init__(self, server_uri, shuffle_id, map_id, reduce_id, message=""):
+        self.server_uri = server_uri
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.reduce_id = reduce_id
+        super().__init__(
+            message
+            or f"fetch failed: shuffle={shuffle_id} map={map_id} "
+            f"reduce={reduce_id} from {server_uri}"
+        )
+
+
+class MapOutputError(VegaError):
+    """Map-output tracker protocol failure (reference: src/map_output_tracker.rs:283-287)."""
+
+
+class PartialJobError(VegaError):
+    """Approximate-action failure (reference: src/partial/mod.rs:19-35)."""
+
+
+class CancelledError(VegaError):
+    """Job was cancelled before completion."""
+
+
+class TaskError(VegaError):
+    """A task raised; carries the remote traceback text."""
+
+    def __init__(self, message, remote_traceback=None):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+class TraceFallbackError(VegaError):
+    """A user function could not be traced for the TPU tier.
+
+    Raised internally when a closure marked for device execution turns out not
+    to be jax-traceable; callers fall back to the host tier.
+    """

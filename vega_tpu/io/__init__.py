@@ -1,0 +1,13 @@
+from vega_tpu.io.readers import (
+    ParquetReaderConfig,
+    TextFileReaderConfig,
+    WholeFileReaderConfig,
+    LocalFsReaderConfig,
+)
+
+__all__ = [
+    "LocalFsReaderConfig",
+    "ParquetReaderConfig",
+    "TextFileReaderConfig",
+    "WholeFileReaderConfig",
+]

@@ -1,0 +1,88 @@
+"""Map-output location registry (reference: src/map_output_tracker.rs).
+
+The driver records, per shuffle_id, the server URI of every map partition's
+output (register/unregister, map_output_tracker.rs:168-211) and bumps a
+generation counter on invalidation (:267-281). Workers query over the control
+plane instead of busy-waiting with 1ms sleeps like the reference
+(:122-132,227-244) — vega_tpu uses a condition variable locally and a blocking
+RPC in distributed mode.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from vega_tpu.errors import MapOutputError
+
+
+class MapOutputTracker:
+    """Driver-side (master) tracker; also the local-mode implementation."""
+
+    def __init__(self):
+        self._outputs: Dict[int, List[Optional[str]]] = {}
+        self._generation = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    # --- registration (driver) ---------------------------------------------
+    def register_shuffle(self, shuffle_id: int, num_maps: int) -> None:
+        with self._lock:
+            if shuffle_id not in self._outputs:
+                self._outputs[shuffle_id] = [None] * num_maps
+
+    def register_map_output(self, shuffle_id: int, map_id: int, uri: str) -> None:
+        with self._cond:
+            self._outputs[shuffle_id][map_id] = uri
+            self._cond.notify_all()
+
+    def register_map_outputs(self, shuffle_id: int, uris: List[Optional[str]]) -> None:
+        """Reference: map_output_tracker.rs:192-199."""
+        with self._cond:
+            self._outputs[shuffle_id] = list(uris)
+            self._cond.notify_all()
+
+    def unregister_map_output(self, shuffle_id: int, map_id: int, uri: str) -> None:
+        """Called on fetch failure; bumps generation
+        (reference: map_output_tracker.rs:201-211)."""
+        with self._cond:
+            locs = self._outputs.get(shuffle_id)
+            if locs is None:
+                raise MapOutputError(f"unknown shuffle {shuffle_id}")
+            if locs[map_id] == uri:
+                locs[map_id] = None
+            self._generation += 1
+            self._cond.notify_all()
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._outputs.pop(shuffle_id, None)
+
+    # --- queries (workers / reduce tasks) ----------------------------------
+    def get_server_uris(self, shuffle_id: int, timeout: float = 60.0) -> List[str]:
+        """Block until every map output of the shuffle has a location."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: shuffle_id in self._outputs
+                and all(u is not None for u in self._outputs[shuffle_id]),
+                timeout=timeout,
+            )
+            if not ok:
+                raise MapOutputError(
+                    f"timed out waiting for map outputs of shuffle {shuffle_id}"
+                )
+            return list(self._outputs[shuffle_id])
+
+    def has_outputs(self, shuffle_id: int) -> bool:
+        with self._lock:
+            locs = self._outputs.get(shuffle_id)
+            return locs is not None and all(u is not None for u in locs)
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def increment_generation(self) -> None:
+        with self._lock:
+            self._generation += 1

@@ -1,0 +1,4 @@
+from vega_tpu.partial.bounded_double import BoundedDouble
+from vega_tpu.partial.partial_result import PartialResult
+
+__all__ = ["BoundedDouble", "PartialResult"]

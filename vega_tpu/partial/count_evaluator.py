@@ -1,0 +1,55 @@
+"""Approximate count with Poisson confidence bounds.
+
+Reference: src/partial/count_evaluator.rs:29-63. The reference stubs the
+interval math (low/high hardcoded to 0.0, count_evaluator.rs:51-54);
+vega_tpu implements the real bound: with p = outputs_merged/total_outputs and
+observed sum S, the completed count is modeled Poisson with mean S/p and the
+interval comes from the normal approximation to the Poisson quantiles.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+from vega_tpu.partial.bounded_double import BoundedDouble
+
+# Two-sided normal quantile for common confidences; erfinv-free approximation.
+def _z_for_confidence(conf: float) -> float:
+    # Rational approximation of the probit function (Beasley-Springer-Moro).
+    p = 1.0 - (1.0 - conf) / 2.0
+    if p <= 0.5:
+        return 0.0
+    t = math.sqrt(-2.0 * math.log(1.0 - p))
+    return t - (2.30753 + 0.27061 * t) / (1.0 + 0.99229 * t + 0.04481 * t * t)
+
+
+class CountEvaluator:
+    def __init__(self, total_outputs: int, confidence: float):
+        self.total_outputs = total_outputs
+        self.confidence = confidence
+        self.outputs_merged = 0
+        self.sum = 0
+        self._lock = threading.Lock()
+
+    def merge(self, _output_id: int, task_result: int) -> None:
+        with self._lock:
+            self.outputs_merged += 1
+            self.sum += task_result
+
+    def current_result(self) -> BoundedDouble:
+        with self._lock:
+            merged, total = self.outputs_merged, self.sum
+        if merged == self.total_outputs:
+            return BoundedDouble(float(total), 1.0, float(total), float(total))
+        if merged == 0 or total == 0:
+            return BoundedDouble(0.0, 0.0, 0.0, float("inf"))
+        p = merged / self.total_outputs
+        mean = total / p
+        # Poisson(mean) ~ N(mean, mean) for the extrapolated remainder.
+        var = total * (1 - p) / (p * p)
+        z = _z_for_confidence(self.confidence)
+        sd = math.sqrt(var)
+        return BoundedDouble(
+            mean, self.confidence, max(0.0, mean - z * sd), mean + z * sd
+        )

@@ -1,0 +1,81 @@
+"""Result of an approximate action (reference: src/partial/partial_result.rs).
+
+Carries either a final value (job finished before the deadline) or a partial
+estimate, with on_complete/on_fail callbacks (partial_result.rs:103-217).
+vega_tpu uses a threading.Event instead of the reference's 1ms busy-wait
+(partial_result.rs:45-48).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, Optional, TypeVar
+
+from vega_tpu.errors import PartialJobError
+
+R = TypeVar("R")
+
+
+class PartialResult(Generic[R]):
+    def __init__(self, initial: R, is_final: bool):
+        self._value: Optional[R] = initial
+        self._final = is_final
+        self._failure: Optional[BaseException] = None
+        self._event = threading.Event()
+        self._completion_handler: Optional[Callable[[R], None]] = None
+        self._failure_handler: Optional[Callable[[BaseException], None]] = None
+        self._lock = threading.Lock()
+        if is_final:
+            self._event.set()
+
+    @property
+    def initial_value(self) -> R:
+        return self._value
+
+    @property
+    def is_initial_value_final(self) -> bool:
+        return self._final
+
+    def get_final_value(self, timeout: Optional[float] = None) -> R:
+        """Block until the job completes (reference: partial_result.rs:39-63)."""
+        if not self._event.wait(timeout):
+            raise PartialJobError("timed out waiting for final value")
+        if self._failure is not None:
+            raise self._failure
+        return self._value
+
+    def on_complete(self, handler: Callable[[R], None]) -> "PartialResult[R]":
+        with self._lock:
+            self._completion_handler = handler
+            if self._final:
+                handler(self._value)
+        return self
+
+    def on_fail(self, handler: Callable[[BaseException], None]) -> "PartialResult[R]":
+        with self._lock:
+            self._failure_handler = handler
+            if self._failure is not None:
+                handler(self._failure)
+        return self
+
+    # --- producer side ------------------------------------------------------
+    def set_final_value(self, value: R) -> None:
+        with self._lock:
+            self._value = value
+            self._final = True
+            handler = self._completion_handler
+        self._event.set()
+        if handler:
+            handler(value)
+
+    def set_failure(self, exc: BaseException) -> None:
+        with self._lock:
+            self._failure = exc
+            handler = self._failure_handler
+        self._event.set()
+        if handler:
+            handler(exc)
+
+    def __repr__(self):
+        state = "final" if self._final else "partial"
+        return f"PartialResult({state}: {self._value})"

@@ -1,0 +1,172 @@
+"""Partitioners: key -> reducer-bucket mapping.
+
+Reference: src/partitioner.rs. The reference uses MetroHash for key hashing
+(src/partitioner.rs:28-58) and uses partitioner equality to elide shuffles when
+two RDDs are already co-partitioned (src/partitioner.rs:11-17, used by
+src/rdd/co_grouped_rdd.rs:102-127).
+
+vega_tpu uses a splittable 64-bit mix hash (same scheme the TPU tier uses on
+device, so host and device bucketing agree bit-for-bit — a requirement for the
+CPU-vs-TPU parity oracle, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+# 64-bit finalizer from splitmix64. Chosen because it is 4 multiplies/shifts —
+# trivially expressible in XLA for the device-side bucketing in tpu/ops.py.
+_M1 = 0xBF58476D1CE4E5B9
+_M2 = 0x94D049BB133111EB
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & _MASK
+    x = ((x ^ (x >> 30)) * _M1) & _MASK
+    x = ((x ^ (x >> 27)) * _M2) & _MASK
+    return x ^ (x >> 31)
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64 array (numpy host path)."""
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(_M1)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(_M2)
+    return x ^ (x >> np.uint64(31))
+
+
+def hash_key(key: Any) -> int:
+    """Hash an arbitrary Python key to a stable uint64.
+
+    Integers (incl. numpy ints) hash via splitmix64 of their 64-bit value so
+    the host path matches the device path exactly. Everything else goes
+    through Python's hash() folded by splitmix64. Reference equivalent:
+    partitioner.rs:21-25 (fasthash::metro::hash64 of serialized key).
+    """
+    if isinstance(key, (bool, np.bool_)):
+        return splitmix64(int(key))
+    if isinstance(key, (int, np.integer)):
+        return splitmix64(int(key) & _MASK)
+    if isinstance(key, (float, np.floating)):
+        # Hash the bit pattern, not the float, for exact CPU/TPU agreement.
+        return splitmix64(struct.unpack("<Q", struct.pack("<d", float(key)))[0])
+    if isinstance(key, str):
+        h = 0xCBF29CE484222325
+        for b in key.encode("utf-8"):
+            h = ((h ^ b) * 0x100000001B3) & _MASK
+        return splitmix64(h)
+    if isinstance(key, bytes):
+        h = 0xCBF29CE484222325
+        for b in key:
+            h = ((h ^ b) * 0x100000001B3) & _MASK
+        return splitmix64(h)
+    if isinstance(key, tuple):
+        h = 0x345678
+        for item in key:
+            h = splitmix64((h * 1000003) ^ hash_key(item))
+        return h & _MASK
+    return splitmix64(hash(key) & _MASK)
+
+
+class Partitioner:
+    """Key -> partition mapping (reference: src/partitioner.rs:11-17).
+
+    equals() (here __eq__) is load-bearing: co-partitioned parents skip the
+    shuffle in cogroup/join (reference: src/rdd/co_grouped_rdd.rs:102-127).
+    """
+
+    @property
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    def get_partition(self, key: Any) -> int:
+        raise NotImplementedError
+
+    def __eq__(self, other) -> bool:
+        raise NotImplementedError
+
+    def __ne__(self, other) -> bool:
+        return not self.__eq__(other)
+
+
+class HashPartitioner(Partitioner):
+    """Hash-modulo bucketing (reference: src/partitioner.rs:28-58)."""
+
+    def __init__(self, partitions: int):
+        if partitions <= 0:
+            raise ValueError("partitions must be positive")
+        self._partitions = int(partitions)
+
+    @property
+    def num_partitions(self) -> int:
+        return self._partitions
+
+    def get_partition(self, key: Any) -> int:
+        return hash_key(key) % self._partitions
+
+    def get_partition_np(self, keys: np.ndarray) -> np.ndarray:
+        """Vectorized bucketing for int64 key arrays (host numeric path)."""
+        return (splitmix64_np(keys.astype(np.int64).view(np.uint64)) %
+                np.uint64(self._partitions)).astype(np.int64)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, HashPartitioner)
+            and other._partitions == self._partitions
+        )
+
+    def __hash__(self):
+        return hash(("HashPartitioner", self._partitions))
+
+    def __repr__(self):
+        return f"HashPartitioner({self._partitions})"
+
+
+class RangePartitioner(Partitioner):
+    """Ordered bucketing by sampled split points; basis of sort_by_key.
+
+    The reference lacks a RangePartitioner (sorting is only take_ordered via a
+    bounded heap, src/rdd/rdd.rs:1124-1153); vega_tpu adds one because a
+    distributed sort is required by BASELINE config 5 (sort_by_key over 1B
+    keys).
+    """
+
+    def __init__(self, bounds, ascending: bool = True):
+        # bounds: sorted list of num_partitions-1 upper split points.
+        self._bounds = list(bounds)
+        self._ascending = ascending
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._bounds) + 1
+
+    @property
+    def bounds(self):
+        return list(self._bounds)
+
+    def get_partition(self, key: Any) -> int:
+        import bisect
+
+        idx = bisect.bisect_left(self._bounds, key)
+        if not self._ascending:
+            idx = len(self._bounds) - idx
+        return idx
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, RangePartitioner)
+            and other._bounds == self._bounds
+            and other._ascending == self._ascending
+        )
+
+    def __hash__(self):
+        return hash(("RangePartitioner", tuple(self._bounds), self._ascending))
+
+    def __repr__(self):
+        return f"RangePartitioner(n={self.num_partitions})"

@@ -1,0 +1,31 @@
+from vega_tpu.rdd.base import RDD
+from vega_tpu.rdd.narrow import (
+    FlatMapperRDD,
+    MapPartitionsRDD,
+    MapperRDD,
+    ParallelCollectionRDD,
+    PartitionwiseSampledRDD,
+    ZippedPartitionsRDD,
+)
+from vega_tpu.rdd.shuffled import ShuffledRDD
+from vega_tpu.rdd.cogrouped import CoGroupedRDD
+from vega_tpu.rdd.cartesian import CartesianRDD
+from vega_tpu.rdd.coalesced import CoalescedRDD
+from vega_tpu.rdd.union import UnionRDD
+from vega_tpu.rdd.checkpoint import CheckpointRDD
+
+__all__ = [
+    "RDD",
+    "CartesianRDD",
+    "CheckpointRDD",
+    "CoGroupedRDD",
+    "CoalescedRDD",
+    "FlatMapperRDD",
+    "MapPartitionsRDD",
+    "MapperRDD",
+    "ParallelCollectionRDD",
+    "PartitionwiseSampledRDD",
+    "ShuffledRDD",
+    "UnionRDD",
+    "ZippedPartitionsRDD",
+]

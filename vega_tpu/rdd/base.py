@@ -1,0 +1,689 @@
+"""The RDD: a lazy, partitioned, lineage-tracked dataset.
+
+Reference: src/rdd/rdd.rs — RddBase (untyped scheduler surface, rdd.rs:82-170)
+and Rdd (typed op surface, rdd.rs:173-1154) collapse into one Python class
+here (Python is untyped; no AnyData machinery is needed — that whole subsystem
+exists in the reference only because Rust lacks runtime reflection, see
+SURVEY.md §2.1).
+
+Every transformation/action carries the reference line it mirrors. Items are
+arbitrary Python objects on this host tier; the device tier (vega_tpu/tpu/)
+provides DenseRDD, which overrides the narrow ops with traced/jitted
+equivalents and lowers shuffles to device exchanges.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional, TYPE_CHECKING
+
+from vega_tpu.dependency import Dependency
+from vega_tpu.errors import VegaError
+from vega_tpu.partitioner import Partitioner
+from vega_tpu.rdd.pair import PairOpsMixin
+from vega_tpu.split import Split
+from vega_tpu.utils.bounded_priority_queue import BoundedPriorityQueue
+from vega_tpu.utils.random import (
+    BernoulliCellSampler,
+    BernoulliSampler,
+    PoissonSampler,
+    compute_fraction_for_sample_size,
+)
+
+if TYPE_CHECKING:
+    from vega_tpu.context import Context
+
+
+class RDD(PairOpsMixin):
+    """Base of the lineage graph (reference: rdd/rdd.rs:54-76 RddVals +
+    trait Rdd)."""
+
+    def __init__(
+        self,
+        ctx: "Context",
+        deps: Optional[List[Dependency]] = None,
+        partitioner: Optional[Partitioner] = None,
+    ):
+        self.context = ctx
+        self.rdd_id: int = ctx.new_rdd_id()
+        self._deps: List[Dependency] = deps or []
+        self._partitioner = partitioner
+        self.should_cache = False  # reference: rdd.rs:57 (unfinished there; real here)
+        self._pinned = False
+        self._checkpoint_dir: Optional[str] = None
+        self._checkpointed_rdd = None
+
+    # ------------------------------------------------------------------ core
+    def get_dependencies(self) -> List[Dependency]:
+        """Reference: rdd.rs:86."""
+        if self._checkpointed_rdd is not None:
+            return self._checkpointed_rdd.get_dependencies()
+        return self._deps
+
+    def splits(self) -> List[Split]:
+        """Reference: rdd.rs:98 — one Split per partition."""
+        return [Split(i) for i in range(self.num_partitions)]
+
+    @property
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def partitioner(self) -> Optional[Partitioner]:
+        """Reference: rdd.rs:102-104."""
+        return self._partitioner
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        """Materialize one partition (reference: rdd.rs:179)."""
+        raise NotImplementedError
+
+    def iterator(self, split: Split, task_context=None) -> Iterator:
+        """Cache/checkpoint-aware compute (reference: rdd.rs:181-183 — which
+        skips the cache because .cache() is unfinished there; vega_tpu wires
+        it through CacheTracker.get_or_compute as intended,
+        cf. cache_tracker.rs:327-365)."""
+        if self._checkpointed_rdd is not None:
+            return self._checkpointed_rdd.iterator(split, task_context)
+        if self.should_cache:
+            from vega_tpu.cache_tracker import get_or_compute
+
+            return get_or_compute(self, split, task_context)
+        return self.compute(split, task_context)
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        """Reference: rdd.rs:92-97."""
+        return []
+
+    @property
+    def is_pinned(self) -> bool:
+        """Pinned RDDs must run on their preferred host
+        (reference: rdd.rs:113-115, mapper_rdd.rs:67-70)."""
+        return self._pinned
+
+    def pin(self):
+        self._pinned = True
+        return self
+
+    # ------------------------------------------------------------- persistence
+    def cache(self):
+        """Mark for in-memory caching (finishes what the reference left
+        half-built, SURVEY.md §2.6)."""
+        self.should_cache = True
+        return self
+
+    persist = cache
+
+    def unpersist(self):
+        from vega_tpu.cache import KeySpace
+        from vega_tpu.env import Env
+
+        self.should_cache = False
+        Env.get().cache.remove_datum(KeySpace.RDD, self.rdd_id)
+        if Env.get().cache_tracker is not None:
+            Env.get().cache_tracker.unregister_rdd(self.rdd_id)
+        return self
+
+    def checkpoint(self, directory: Optional[str] = None):
+        """Materialize to disk and truncate lineage (absent from the
+        reference — SURVEY.md §5 'Checkpoint/resume: none'; recovery there is
+        lineage recomputation only). Defaults to a per-session directory
+        under Env.local_dir."""
+        if directory is None:
+            import os
+
+            from vega_tpu.env import Env
+
+            directory = os.path.join(
+                Env.get().work_dir(), f"checkpoint-rdd-{self.rdd_id}"
+            )
+        self._checkpoint_dir = directory
+        return self
+
+    def _do_checkpoint(self):
+        if self._checkpoint_dir is None or self._checkpointed_rdd is not None:
+            return
+        if getattr(self, "_checkpointing", False):
+            return  # the materialization job itself re-enters run_job
+        from vega_tpu.rdd.checkpoint import CheckpointRDD
+
+        self._checkpointing = True
+        try:
+            self._checkpointed_rdd = CheckpointRDD.write(self, self._checkpoint_dir)
+        finally:
+            self._checkpointing = False
+
+    # --------------------------------------------------------- transformations
+    def map(self, f: Callable):
+        """Reference: rdd.rs:199-205 (MapperRdd)."""
+        from vega_tpu.rdd.narrow import MapperRDD
+
+        return MapperRDD(self, f)
+
+    def flat_map(self, f: Callable):
+        """Reference: rdd.rs:207-214 (FlatMapperRdd)."""
+        from vega_tpu.rdd.narrow import FlatMapperRDD
+
+        return FlatMapperRDD(self, f)
+
+    def filter(self, predicate: Callable):
+        """Reference: rdd.rs:186-197 (implemented via MapPartitions there too)."""
+        from vega_tpu.rdd.narrow import MapPartitionsRDD
+
+        def apply(_idx, it):
+            return (x for x in it if predicate(x))
+
+        return MapPartitionsRDD(self, apply, preserves_partitioning=True)
+
+    def map_partitions(self, f: Callable, preserves_partitioning: bool = False):
+        """f(iterator) -> iterator (reference: rdd.rs:216-226)."""
+        from vega_tpu.rdd.narrow import MapPartitionsRDD
+
+        return MapPartitionsRDD(
+            self, lambda _idx, it: f(it), preserves_partitioning
+        )
+
+    def map_partitions_with_index(self, f: Callable,
+                                  preserves_partitioning: bool = False):
+        """f(index, iterator) -> iterator (reference: rdd.rs:228-237)."""
+        from vega_tpu.rdd.narrow import MapPartitionsRDD
+
+        return MapPartitionsRDD(self, f, preserves_partitioning)
+
+    def glom(self):
+        """Each partition becomes one list item (reference: rdd.rs:239-252)."""
+        from vega_tpu.rdd.narrow import MapPartitionsRDD
+
+        return MapPartitionsRDD(self, lambda _idx, it: iter([list(it)]))
+
+    def coalesce(self, num_partitions: int, shuffle: bool = False):
+        """Reference: rdd.rs:386-418 + coalesced_rdd.rs."""
+        if shuffle:
+            from vega_tpu.rdd.narrow import MapPartitionsRDD
+
+            def key_by_round_robin(idx, it):
+                counter = itertools.count(idx)
+                return ((next(counter), x) for x in it)
+
+            keyed = self.map_partitions_with_index(key_by_round_robin)
+            return (
+                keyed.partition_by_key(num_partitions).values()
+            )
+        from vega_tpu.rdd.coalesced import CoalescedRDD
+
+        return CoalescedRDD(self, num_partitions)
+
+    def repartition(self, num_partitions: int):
+        """Always shuffles (reference: rdd.rs:552-563)."""
+        return self.coalesce(num_partitions, shuffle=True)
+
+    def sample(self, with_replacement: bool, fraction: float,
+               seed: Optional[int] = None):
+        """Reference: rdd.rs:690-715 (PartitionwiseSampledRdd)."""
+        from vega_tpu.rdd.narrow import PartitionwiseSampledRDD
+
+        sampler = (
+            PoissonSampler(fraction, seed)
+            if with_replacement
+            else BernoulliSampler(fraction, seed)
+        )
+        return PartitionwiseSampledRDD(self, sampler)
+
+    def random_split(self, weights: List[float], seed: Optional[int] = None):
+        """Reference: rdd.rs:623-688 (BernoulliCellSampler per weight band)."""
+        total = sum(weights)
+        bounds = [0.0]
+        for w in weights:
+            bounds.append(bounds[-1] + w / total)
+        from vega_tpu.rdd.narrow import PartitionwiseSampledRDD
+
+        return [
+            PartitionwiseSampledRDD(
+                self, BernoulliCellSampler(lb, ub, seed=seed)
+            )
+            for lb, ub in zip(bounds, bounds[1:])
+        ]
+
+    def key_by(self, f: Callable):
+        """Reference: rdd.rs:1059-1071."""
+        return self.map(lambda x: (f(x), x))
+
+    def group_by(self, f: Callable, partitioner_or_num: Any = None):
+        return self.key_by(f).group_by_key(partitioner_or_num)
+
+    def union(self, other: "RDD"):
+        """Reference: rdd.rs:805-816 / union_rdd.rs."""
+        from vega_tpu.rdd.union import UnionRDD
+
+        return UnionRDD(self.context, [self, other])
+
+    __add__ = union
+
+    def zip(self, other: "RDD"):
+        """Pairwise zip of co-indexed partitions (reference: rdd.rs:818-829 /
+        zip_rdd.rs)."""
+        from vega_tpu.rdd.narrow import ZippedPartitionsRDD
+
+        return ZippedPartitionsRDD(self.context, self, other)
+
+    def zip_with_index(self):
+        """(item, global_index); costs one pass to count partition sizes
+        (Spark parity; absent from the reference)."""
+        counts = self.map_partitions(lambda it: iter([sum(1 for _ in it)])).collect()
+        offsets = [0]
+        for c in counts[:-1]:
+            offsets.append(offsets[-1] + c)
+
+        def index_partition(idx, it):
+            return ((x, i) for i, x in enumerate(it, start=offsets[idx]))
+
+        return self.map_partitions_with_index(index_partition)
+
+    def cartesian(self, other: "RDD"):
+        """Reference: rdd.rs:354-360 / cartesian_rdd.rs."""
+        from vega_tpu.rdd.cartesian import CartesianRDD
+
+        return CartesianRDD(self.context, self, other)
+
+    def distinct(self, num_partitions: Optional[int] = None):
+        """Reference: rdd.rs:525-532 (map to (x,None) -> reduce_by_key)."""
+        n = num_partitions or self.num_partitions
+        return (
+            self.map(lambda x: (x, None))
+            .reduce_by_key(lambda a, _b: a, n)
+            .keys()
+        )
+
+    def intersection(self, other: "RDD", num_partitions: Optional[int] = None):
+        """Reference: rdd.rs:831-841."""
+        n = num_partitions or max(self.num_partitions, other.num_partitions)
+        left = self.map(lambda x: (x, None))
+        right = other.map(lambda x: (x, None))
+
+        def emit(groups):
+            l, r = groups
+            return [None] if l and r else []
+
+        return left.cogroup(right, partitioner_or_num=n).flat_map_values(emit).keys()
+
+    def subtract(self, other: "RDD", num_partitions: Optional[int] = None):
+        """Reference: rdd.rs:843-865."""
+        n = num_partitions or max(self.num_partitions, other.num_partitions)
+        left = self.map(lambda x: (x, None))
+        right = other.map(lambda x: (x, None))
+        return left.subtract_by_key(right, partitioner_or_num=n).keys()
+
+    def sort_by(self, key_func: Callable, ascending: bool = True,
+                num_partitions: Optional[int] = None):
+        return (
+            self.key_by(key_func)
+            .sort_by_key(ascending, num_partitions)
+            .values()
+        )
+
+    def pipe(self, command: List[str] | str):
+        """Pipe each partition through an external command, one item per line
+        (Spark parity; absent from the reference)."""
+        import shlex
+        import subprocess
+
+        argv = shlex.split(command) if isinstance(command, str) else command
+
+        def run(it):
+            proc = subprocess.Popen(
+                argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True
+            )
+            out, _ = proc.communicate("\n".join(str(x) for x in it) + "\n")
+            return iter(out.splitlines())
+
+        return self.map_partitions(run)
+
+    # ----------------------------------------------------------------- actions
+    def collect(self) -> list:
+        """Reference: rdd.rs:420-434."""
+        results = self.context.run_job(self, lambda _tc, it: list(it))
+        return list(itertools.chain.from_iterable(results))
+
+    def count(self) -> int:
+        """Reference: rdd.rs:436-448."""
+        return sum(
+            self.context.run_job(self, lambda _tc, it: sum(1 for _ in it))
+        )
+
+    def reduce(self, f: Callable):
+        """Reference: rdd.rs:274-309 (empty partitions skipped; empty RDD is
+        an error, matching Spark semantics)."""
+        _MISSING = _Sentinel
+
+        def reduce_partition(_tc, it):
+            acc = _MISSING
+            for x in it:
+                acc = x if acc is _MISSING else f(acc, x)
+            return acc
+
+        parts = [
+            r
+            for r in self.context.run_job(self, reduce_partition)
+            if r is not _MISSING
+        ]
+        if not parts:
+            raise VegaError("reduce() of empty RDD")
+        acc = parts[0]
+        for x in parts[1:]:
+            acc = f(acc, x)
+        return acc
+
+    def fold(self, zero, f: Callable):
+        """Reference: rdd.rs:311-337."""
+        import copy
+
+        def fold_partition(_tc, it):
+            acc = copy.deepcopy(zero)
+            for x in it:
+                acc = f(acc, x)
+            return acc
+
+        acc = copy.deepcopy(zero)
+        for part in self.context.run_job(self, fold_partition):
+            acc = f(acc, part)
+        return acc
+
+    def aggregate(self, zero, seq_func: Callable, comb_func: Callable):
+        """Reference: rdd.rs:339-352."""
+        import copy
+
+        def agg_partition(_tc, it):
+            acc = copy.deepcopy(zero)
+            for x in it:
+                acc = seq_func(acc, x)
+            return acc
+
+        acc = copy.deepcopy(zero)
+        for part in self.context.run_job(self, agg_partition):
+            acc = comb_func(acc, part)
+        return acc
+
+    def take(self, n: int) -> list:
+        """Scan partitions incrementally, growing the scan 4x each round
+        (reference: rdd.rs:565-621)."""
+        if n <= 0:
+            return []
+        taken: list = []
+        total_parts = self.num_partitions
+        scanned = 0
+        num_to_scan = 1
+        while scanned < total_parts and len(taken) < n:
+            num_to_scan = min(num_to_scan, total_parts - scanned)
+            need = n - len(taken)
+            results = self.context.run_job(
+                self,
+                lambda _tc, it: list(itertools.islice(it, need)),
+                partitions=list(range(scanned, scanned + num_to_scan)),
+            )
+            for part in results:
+                taken.extend(part)
+                if len(taken) >= n:
+                    break
+            scanned += num_to_scan
+            num_to_scan *= 4
+        return taken[:n]
+
+    def first(self):
+        """Reference: rdd.rs:534-543."""
+        got = self.take(1)
+        if not got:
+            raise VegaError("first() of empty RDD")
+        return got[0]
+
+    def take_sample(self, with_replacement: bool, num: int,
+                    seed: Optional[int] = None) -> list:
+        """Reference: rdd.rs:717-784."""
+        import numpy as np
+
+        if num == 0:
+            return []
+        initial_count = self.count()
+        if initial_count == 0:
+            return []
+        rng = np.random.Generator(np.random.PCG64(seed if seed is not None else 7))
+        if not with_replacement and num >= initial_count:
+            items = self.collect()
+            rng.shuffle(items)
+            return items
+        fraction = compute_fraction_for_sample_size(
+            num, initial_count, with_replacement
+        )
+        samples = self.sample(with_replacement, fraction, seed).collect()
+        attempts = 0
+        while len(samples) < num and attempts < 20:
+            attempts += 1
+            samples = self.sample(
+                with_replacement, fraction,
+                (seed or 0) + attempts
+            ).collect()
+        rng.shuffle(samples)
+        return samples[:num]
+
+    def for_each(self, f: Callable) -> None:
+        """Reference: rdd.rs:786-794."""
+        def run(_tc, it):
+            for x in it:
+                f(x)
+
+        self.context.run_job(self, run)
+
+    def for_each_partition(self, f: Callable) -> None:
+        self.context.run_job(self, lambda _tc, it: f(it))
+
+    def save_as_text_file(self, path: str) -> None:
+        """One part-NNNNN file per partition (reference: rdd.rs:254-272)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+        def write(tc, it):
+            out = os.path.join(path, f"part-{tc.split_index:05d}")
+            with open(out, "w") as f:
+                for x in it:
+                    f.write(f"{x}\n")
+
+        self.context.run_job(self, write)
+
+    def max(self):
+        """Reference: rdd.rs:1081-1089."""
+        return self.reduce(lambda a, b: a if a >= b else b)
+
+    def min(self):
+        """Reference: rdd.rs:1091-1099."""
+        return self.reduce(lambda a, b: a if a <= b else b)
+
+    def top(self, n: int, key: Optional[Callable] = None) -> list:
+        """Largest n (reference: rdd.rs:1106-1122)."""
+        base_key = key or (lambda x: x)
+        return self.take_ordered(n, key=_Neg(base_key))
+
+    def take_ordered(self, n: int, key: Optional[Callable] = None) -> list:
+        """Smallest n via per-partition bounded heaps merged on the driver
+        (reference: rdd.rs:1124-1153 + bounded_priority_queue.rs)."""
+        if n <= 0:
+            return []
+
+        def heap_partition(_tc, it):
+            return BoundedPriorityQueue(n, key).extend(it)
+
+        queues = self.context.run_job(self, heap_partition)
+        merged = BoundedPriorityQueue(n, key)
+        for q in queues:
+            merged.merge(q)
+        return merged.items_sorted()
+
+    def count_by_value(self) -> dict:
+        """Reference: rdd.rs:450-464."""
+        return dict(
+            self.map(lambda x: (x, 1)).reduce_by_key(lambda a, b: a + b).collect()
+        )
+
+    def is_empty(self) -> bool:
+        """Reference: rdd.rs:1073-1079."""
+        return self.num_partitions == 0 or len(self.take(1)) == 0
+
+    def to_local_iterator(self) -> Iterator:
+        """Partition-at-a-time driver iteration (Spark parity)."""
+        for p in range(self.num_partitions):
+            results = self.context.run_job(
+                self, lambda _tc, it: list(it), partitions=[p]
+            )
+            yield from results[0]
+
+    def histogram(self, buckets: int | List[float]):
+        """Numeric histogram (Spark DoubleRDD parity)."""
+        if isinstance(buckets, int):
+            lo = self.min()
+            hi = self.max()
+            if lo == hi:
+                return ([lo, hi], [self.count()])
+            step = (hi - lo) / buckets
+            edges = [lo + i * step for i in range(buckets)] + [hi]
+        else:
+            edges = list(buckets)
+            buckets = len(edges) - 1
+
+        def hist_partition(_tc, it):
+            import bisect
+
+            counts = [0] * buckets
+            for x in it:
+                if edges[0] <= x <= edges[-1]:
+                    idx = min(bisect.bisect_right(edges, x) - 1, buckets - 1)
+                    counts[idx] += 1
+            return counts
+
+        totals = [0] * buckets
+        for part in self.context.run_job(self, hist_partition):
+            for i, c in enumerate(part):
+                totals[i] += c
+        return edges, totals
+
+    def stats(self) -> dict:
+        """count/mean/stdev/min/max in one pass (Spark parity)."""
+        def stat_partition(_tc, it):
+            n = 0
+            mean = 0.0
+            m2 = 0.0
+            mn = float("inf")
+            mx = float("-inf")
+            for x in it:
+                n += 1
+                d = x - mean
+                mean += d / n
+                m2 += d * (x - mean)
+                mn = min(mn, x)
+                mx = max(mx, x)
+            return (n, mean, m2, mn, mx)
+
+        def merge(a, b):
+            (na, ma, sa, mna, mxa), (nb, mb, sb, mnb, mxb) = a, b
+            if na == 0:
+                return b
+            if nb == 0:
+                return a
+            n = na + nb
+            delta = mb - ma
+            mean = ma + delta * nb / n
+            m2 = sa + sb + delta * delta * na * nb / n
+            return (n, mean, m2, min(mna, mnb), max(mxa, mxb))
+
+        parts = self.context.run_job(self, stat_partition)
+        n, mean, m2, mn, mx = (0, 0.0, 0.0, float("inf"), float("-inf"))
+        for p in parts:
+            n, mean, m2, mn, mx = merge((n, mean, m2, mn, mx), p)
+        import math
+
+        return {
+            "count": n,
+            "mean": mean if n else float("nan"),
+            "stdev": math.sqrt(m2 / n) if n else float("nan"),
+            "min": mn,
+            "max": mx,
+        }
+
+    # ----------------------------------------------------- approximate actions
+    def count_approx(self, timeout_s: float, confidence: float = 0.95):
+        """Reference: rdd.rs:1030-1056 + partial/count_evaluator.rs."""
+        from vega_tpu.partial.count_evaluator import CountEvaluator
+
+        evaluator = CountEvaluator(self.num_partitions, confidence)
+        return self.context.run_approximate_job(
+            self, lambda _tc, it: sum(1 for _ in it), evaluator, timeout_s
+        )
+
+    def count_by_value_approx(self, timeout_s: float, confidence: float = 0.95):
+        """Reference: rdd.rs:466-523 + partial/grouped_count_evaluator.rs."""
+        from vega_tpu.partial.grouped_count_evaluator import GroupedCountEvaluator
+
+        def count_partition(_tc, it):
+            counts: dict = {}
+            for x in it:
+                counts[x] = counts.get(x, 0) + 1
+            return counts
+
+        evaluator = GroupedCountEvaluator(self.num_partitions, confidence)
+        return self.context.run_approximate_job(
+            self, count_partition, evaluator, timeout_s
+        )
+
+    def mean_approx(self, timeout_s: float, confidence: float = 0.95):
+        from vega_tpu.partial.mean_evaluator import MeanEvaluator
+
+        def sum_partition(_tc, it):
+            n = 0
+            s = 0.0
+            ss = 0.0
+            for x in it:
+                n += 1
+                s += x
+                ss += x * x
+            return (n, s, ss)
+
+        evaluator = MeanEvaluator(self.num_partitions, confidence)
+        return self.context.run_approximate_job(
+            self, sum_partition, evaluator, timeout_s
+        )
+
+    # ------------------------------------------------------------------- misc
+    def id(self) -> int:
+        return self.rdd_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}(id={self.rdd_id}, partitions={self.num_partitions})"
+
+
+class _Sentinel:
+    pass
+
+
+class _Neg:
+    """Wraps a key function to invert ordering (for top())."""
+
+    __slots__ = ("f",)
+
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, x):
+        return _NegOrd(self.f(x))
+
+
+class _NegOrd:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __le__(self, other):
+        return other.v <= self.v
+
+    def __eq__(self, other):
+        return other.v == self.v

@@ -1,0 +1,51 @@
+"""Checkpointed RDD: partitions materialized to disk, lineage truncated.
+
+The reference has no checkpoint/resume (SURVEY.md §5); its only recovery
+primitive is lineage recomputation. vega_tpu adds a simple reliable
+checkpoint: each partition is written as a pickled file part-NNNNN.ckpt; the
+CheckpointRDD reads them back with no dependencies, so recovery after failure
+does not recompute the full lineage.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List
+
+from vega_tpu import serialization
+from vega_tpu.rdd.base import RDD
+from vega_tpu.split import Split
+
+
+class CheckpointRDD(RDD):
+    def __init__(self, ctx, directory: str, num_partitions: int):
+        super().__init__(ctx)
+        self.directory = directory
+        self._num_partitions = num_partitions
+
+    @staticmethod
+    def write(rdd: RDD, directory: str) -> "CheckpointRDD":
+        os.makedirs(directory, exist_ok=True)
+
+        def write_partition(tc, it):
+            path = os.path.join(directory, f"part-{tc.split_index:05d}.ckpt")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(serialization.dumps(list(it)))
+            os.replace(tmp, path)
+            return tc.split_index
+
+        rdd.context.run_job(rdd, write_partition)
+        return CheckpointRDD(rdd.context, directory, rdd.num_partitions)
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def splits(self) -> List[Split]:
+        return [Split(i) for i in range(self._num_partitions)]
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        path = os.path.join(self.directory, f"part-{split.index:05d}.ckpt")
+        with open(path, "rb") as f:
+            return iter(serialization.loads(f.read()))

@@ -1,0 +1,87 @@
+"""Shrink partition count without a shuffle (reference: src/rdd/coalesced_rdd.rs).
+
+The reference's DefaultPartitionCoalescer does locality-aware bin-packing with
+power-of-two-choices and a balance slack (coalesced_rdd.rs:406-732). vega_tpu
+keeps the same contract — group parent partitions into <= n groups, preferring
+groups whose parents share a preferred location — with a simpler two-pass
+packer: seed groups by distinct location, then assign each parent partition to
+the smallest group that matches its location (falling back to globally
+smallest), which is the reference algorithm minus its randomized probing.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import Counter
+from typing import Iterator, List
+
+from vega_tpu.dependency import ManyToOneDependency
+from vega_tpu.rdd.base import RDD
+from vega_tpu.split import Split
+
+
+class CoalescedRDD(RDD):
+    def __init__(self, prev: RDD, num_partitions: int):
+        if num_partitions <= 0:
+            raise ValueError("num_partitions must be positive")
+        groups = self._pack(prev, num_partitions)
+        super().__init__(
+            prev.context, deps=[ManyToOneDependency(prev, groups)]
+        )
+        self.prev = prev
+        self.groups = groups
+
+    @staticmethod
+    def _pack(prev: RDD, n: int) -> List[List[int]]:
+        n_parent = prev.num_partitions
+        n = min(n, max(n_parent, 1))
+        if n_parent == 0:
+            return [[] for _ in range(0)]
+        parent_splits = prev.splits()
+        locs = [prev.preferred_locations(s) for s in parent_splits]
+        groups: List[List[int]] = [[] for _ in range(n)]
+        group_loc: List[str | None] = [None] * n
+
+        # Seed distinct locations across groups (coalesced_rdd.rs:515-560).
+        distinct = []
+        seen = set()
+        for ls in locs:
+            for loc in ls:
+                if loc not in seen:
+                    seen.add(loc)
+                    distinct.append(loc)
+        for gi, loc in zip(range(n), distinct):
+            group_loc[gi] = loc
+
+        def best_group(pls: List[str]) -> int:
+            candidates = [
+                gi for gi in range(n) if group_loc[gi] in pls
+            ] if pls else []
+            pool = candidates or range(n)
+            return min(pool, key=lambda gi: len(groups[gi]))
+
+        for pi in range(n_parent):
+            groups[best_group(locs[pi])].append(pi)
+        return groups
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.groups)
+
+    def splits(self) -> List[Split]:
+        return [Split(i, payload=g) for i, g in enumerate(self.groups)]
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        votes = Counter()
+        parent_splits = self.prev.splits()
+        for pi in self.groups[split.index]:
+            for loc in self.prev.preferred_locations(parent_splits[pi]):
+                votes[loc] += 1
+        return [loc for loc, _ in votes.most_common()]
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        parent_splits = self.prev.splits()
+        return itertools.chain.from_iterable(
+            self.prev.iterator(parent_splits[pi], task_context)
+            for pi in self.groups[split.index]
+        )

@@ -1,0 +1,72 @@
+"""N-ary cogroup over heterogeneous parents (reference: src/rdd/co_grouped_rdd.rs).
+
+For each parent: if its partitioner equals the output partitioner the edge is
+narrow (values read directly); otherwise a ShuffleDependency with a
+list-collecting aggregator is registered (reference: co_grouped_rdd.rs:102-127,
+compute at :206-249). Yields (K, (list_0, ..., list_{n-1})).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from vega_tpu.aggregator import Aggregator
+from vega_tpu.dependency import Dependency, OneToOneDependency, ShuffleDependency
+from vega_tpu.partitioner import Partitioner
+from vega_tpu.rdd.base import RDD
+from vega_tpu.shuffle.fetcher import ShuffleFetcher
+from vega_tpu.split import Split
+
+
+class CoGroupedRDD(RDD):
+    def __init__(self, parents: List[RDD], partitioner: Partitioner):
+        ctx = parents[0].context
+        deps: List[Dependency] = []
+        shuffle_ids: List[int] = []  # parallel to parents; -1 => narrow
+        for parent in parents:
+            if parent.partitioner is not None and parent.partitioner == partitioner:
+                deps.append(OneToOneDependency(parent))
+                shuffle_ids.append(-1)
+            else:
+                sid = ctx.new_shuffle_id()
+                deps.append(
+                    ShuffleDependency(
+                        sid, parent, Aggregator.default(), partitioner,
+                        is_cogroup=True,
+                    )
+                )
+                shuffle_ids.append(sid)
+        super().__init__(ctx, deps=deps, partitioner=partitioner)
+        self.parents = parents
+        self.shuffle_ids = shuffle_ids
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioner.num_partitions
+
+    def splits(self) -> List[Split]:
+        return [Split(i) for i in range(self.num_partitions)]
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        n = len(self.parents)
+        groups: dict = {}
+
+        def slot(key):
+            entry = groups.get(key)
+            if entry is None:
+                entry = tuple([] for _ in range(n))
+                groups[key] = entry
+            return entry
+
+        for i, (parent, sid) in enumerate(zip(self.parents, self.shuffle_ids)):
+            if sid < 0:
+                # Narrow: parent is co-partitioned; read its partition directly
+                # (reference: co_grouped_rdd.rs:211-224).
+                for k, v in parent.iterator(split, task_context):
+                    slot(k)[i].append(v)
+            else:
+                # Shuffled: each fetched combiner is already a list of values
+                # (reference: co_grouped_rdd.rs:226-243).
+                for k, vs in ShuffleFetcher.fetch(sid, split.index):
+                    slot(k)[i].extend(vs)
+        return iter(groups.items())

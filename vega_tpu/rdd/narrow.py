@@ -1,0 +1,189 @@
+"""Concrete narrow-dependency RDDs and in-memory sources.
+
+Reference files: src/rdd/parallel_collection_rdd.rs, mapper_rdd.rs,
+flatmapper_rdd.rs, map_partitions_rdd.rs, partitionwise_sampled_rdd.rs,
+zip_rdd.rs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from vega_tpu.dependency import OneToOneDependency
+from vega_tpu.rdd.base import RDD
+from vega_tpu.split import Split
+from vega_tpu.utils.random import RandomSampler
+
+
+class ParallelCollectionRDD(RDD):
+    """Source from an in-memory collection, sliced into num_slices
+    (reference: parallel_collection_rdd.rs:116-145; the split carries its
+    slice, :30-56). Slicing keeps `range` objects lazy, so
+    ctx.range(1_000_000_000) costs O(num_slices), not O(n)."""
+
+    def __init__(self, ctx, data: Sequence, num_slices: int):
+        super().__init__(ctx)
+        if num_slices <= 0:
+            raise ValueError("num_slices must be positive")
+        self._slices = self._slice(data, num_slices)
+
+    @staticmethod
+    def _slice(data: Sequence, num_slices: int) -> List[Sequence]:
+        n = len(data)
+        num_slices = max(1, min(num_slices, max(n, 1)))
+        bounds = [
+            (i * n // num_slices, (i + 1) * n // num_slices)
+            for i in range(num_slices)
+        ]
+        return [data[lo:hi] for lo, hi in bounds]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._slices)
+
+    def splits(self) -> List[Split]:
+        return [Split(i, payload=s) for i, s in enumerate(self._slices)]
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        data = split.payload if split.payload is not None else self._slices[split.index]
+        return iter(data)
+
+
+class MapperRDD(RDD):
+    """Per-element map (reference: mapper_rdd.rs; OneToOne dep :50-56;
+    compute :161-163)."""
+
+    def __init__(self, prev: RDD, f: Callable):
+        super().__init__(prev.context, deps=[OneToOneDependency(prev)])
+        self.prev = prev
+        self.f = f
+        self._pinned = prev.is_pinned  # pin propagates (mapper_rdd.rs:67-70)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.prev.num_partitions
+
+    def splits(self) -> List[Split]:
+        return self.prev.splits()
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        return self.prev.preferred_locations(split)
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        return map(self.f, self.prev.iterator(split, task_context))
+
+
+class FlatMapperRDD(RDD):
+    """Reference: flatmapper_rdd.rs:42-56."""
+
+    def __init__(self, prev: RDD, f: Callable):
+        super().__init__(prev.context, deps=[OneToOneDependency(prev)])
+        self.prev = prev
+        self.f = f
+        self._pinned = prev.is_pinned
+
+    @property
+    def num_partitions(self) -> int:
+        return self.prev.num_partitions
+
+    def splits(self) -> List[Split]:
+        return self.prev.splits()
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        return self.prev.preferred_locations(split)
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        return itertools.chain.from_iterable(
+            map(self.f, self.prev.iterator(split, task_context))
+        )
+
+
+class MapPartitionsRDD(RDD):
+    """f(index, iterator) -> iterator; basis of filter/glom/random_split
+    (reference: map_partitions_rdd.rs:50-65)."""
+
+    def __init__(self, prev: RDD, f: Callable, preserves_partitioning: bool = False):
+        super().__init__(
+            prev.context,
+            deps=[OneToOneDependency(prev)],
+            partitioner=prev.partitioner if preserves_partitioning else None,
+        )
+        self.prev = prev
+        self.f = f
+        self._pinned = prev.is_pinned
+
+    @property
+    def num_partitions(self) -> int:
+        return self.prev.num_partitions
+
+    def splits(self) -> List[Split]:
+        return self.prev.splits()
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        return self.prev.preferred_locations(split)
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        return self.f(split.index, self.prev.iterator(split, task_context))
+
+
+class PartitionwiseSampledRDD(RDD):
+    """Reference: partitionwise_sampled_rdd.rs:129-133."""
+
+    def __init__(self, prev: RDD, sampler: RandomSampler,
+                 preserves_partitioning: bool = True):
+        super().__init__(
+            prev.context,
+            deps=[OneToOneDependency(prev)],
+            partitioner=prev.partitioner if preserves_partitioning else None,
+        )
+        self.prev = prev
+        self.sampler = sampler
+
+    @property
+    def num_partitions(self) -> int:
+        return self.prev.num_partitions
+
+    def splits(self) -> List[Split]:
+        return self.prev.splits()
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        return self.prev.preferred_locations(split)
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        return self.sampler.sample(
+            self.prev.iterator(split, task_context), split.index
+        )
+
+
+class ZippedPartitionsRDD(RDD):
+    """Pairwise zip of co-indexed partitions (reference: zip_rdd.rs:119-150).
+
+    Like the reference (and Spark), requires equal partition counts; stops at
+    the shorter partition of each pair."""
+
+    def __init__(self, ctx, first: RDD, second: RDD):
+        if first.num_partitions != second.num_partitions:
+            raise ValueError(
+                "zip requires equal partition counts: "
+                f"{first.num_partitions} != {second.num_partitions}"
+            )
+        super().__init__(
+            ctx,
+            deps=[OneToOneDependency(first), OneToOneDependency(second)],
+        )
+        self.first = first
+        self.second = second
+
+    @property
+    def num_partitions(self) -> int:
+        return self.first.num_partitions
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        return self.first.preferred_locations(split)
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        return zip(
+            self.first.iterator(split, task_context),
+            self.second.iterator(split, task_context),
+        )

@@ -1,0 +1,47 @@
+"""Reduce side of a shuffle (reference: src/rdd/shuffled_rdd.rs).
+
+ShuffledRDD yields (K, C) pairs: fetch every map output bucket for this
+reduce partition and merge_combiners into one dict
+(reference: shuffled_rdd.rs:149-170; splits come from the partitioner's
+partition count, :102-110).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from vega_tpu.aggregator import Aggregator
+from vega_tpu.dependency import ShuffleDependency
+from vega_tpu.partitioner import Partitioner
+from vega_tpu.rdd.base import RDD
+from vega_tpu.shuffle.fetcher import ShuffleFetcher
+from vega_tpu.split import Split
+
+
+class ShuffledRDD(RDD):
+    def __init__(self, parent: RDD, aggregator: Aggregator,
+                 partitioner: Partitioner):
+        shuffle_id = parent.context.new_shuffle_id()
+        dep = ShuffleDependency(shuffle_id, parent, aggregator, partitioner)
+        super().__init__(parent.context, deps=[dep], partitioner=partitioner)
+        self.parent = parent
+        self.aggregator = aggregator
+        self.shuffle_dep = dep
+        self.shuffle_id = shuffle_id
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioner.num_partitions
+
+    def splits(self) -> List[Split]:
+        return [Split(i) for i in range(self.num_partitions)]
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        merge_combiners = self.aggregator.merge_combiners
+        combiners: dict = {}
+        for k, c in ShuffleFetcher.fetch(self.shuffle_id, split.index):
+            if k in combiners:
+                combiners[k] = merge_combiners(combiners[k], c)
+            else:
+                combiners[k] = c
+        return iter(combiners.items())

@@ -1,0 +1,79 @@
+"""Union of RDDs (reference: src/rdd/union_rdd.rs).
+
+Two variants, chosen exactly as the reference does (union_rdd.rs:115-154):
+  * non-unique partitioner -> concatenate all parents' partitions with
+    RangeDependency edges (:115-134);
+  * all parents share one partitioner -> PartitionerAware union that zips the
+    co-indexed partitions and keeps the partitioner (:135-154), with
+    preferred-location voting (:218-261).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import Counter
+from typing import Iterator, List
+
+from vega_tpu.dependency import OneToOneDependency, RangeDependency
+from vega_tpu.rdd.base import RDD
+from vega_tpu.split import Split
+
+
+class UnionRDD(RDD):
+    def __init__(self, ctx, rdds: List[RDD]):
+        if not rdds:
+            raise ValueError("union of zero RDDs")
+        first_part = rdds[0].partitioner
+        self.partitioner_aware = first_part is not None and all(
+            r.partitioner == first_part for r in rdds
+        )
+        if self.partitioner_aware:
+            deps = [OneToOneDependency(r) for r in rdds]
+            partitioner = first_part
+        else:
+            deps = []
+            pos = 0
+            for r in rdds:
+                deps.append(RangeDependency(r, 0, pos, r.num_partitions))
+                pos += r.num_partitions
+            partitioner = None
+        super().__init__(ctx, deps=deps, partitioner=partitioner)
+        self.rdds = rdds
+
+    @property
+    def num_partitions(self) -> int:
+        if self.partitioner_aware:
+            return self.rdds[0].num_partitions
+        return sum(r.num_partitions for r in self.rdds)
+
+    def splits(self) -> List[Split]:
+        if self.partitioner_aware:
+            return [Split(i) for i in range(self.num_partitions)]
+        out = []
+        idx = 0
+        for ri, r in enumerate(self.rdds):
+            for pi in range(r.num_partitions):
+                out.append(Split(idx, payload=(ri, pi)))
+                idx += 1
+        return out
+
+    def preferred_locations(self, split: Split) -> List[str]:
+        if self.partitioner_aware:
+            # Majority vote over parents' preferences (union_rdd.rs:218-261).
+            votes = Counter()
+            for r in self.rdds:
+                for loc in r.preferred_locations(Split(split.index)):
+                    votes[loc] += 1
+            return [loc for loc, _ in votes.most_common()]
+        ri, pi = split.payload
+        return self.rdds[ri].preferred_locations(self.rdds[ri].splits()[pi])
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        if self.partitioner_aware:
+            return itertools.chain.from_iterable(
+                r.iterator(r.splits()[split.index], task_context)
+                for r in self.rdds
+            )
+        ri, pi = split.payload
+        parent = self.rdds[ri]
+        return parent.iterator(parent.splits()[pi], task_context)

@@ -1,0 +1,13 @@
+from vega_tpu.scheduler.task import TaskContext, ResultTask, ShuffleMapTask
+from vega_tpu.scheduler.stage import Stage
+from vega_tpu.scheduler.dag import DAGScheduler
+from vega_tpu.scheduler.local_backend import LocalBackend
+
+__all__ = [
+    "TaskContext",
+    "ResultTask",
+    "ShuffleMapTask",
+    "Stage",
+    "DAGScheduler",
+    "LocalBackend",
+]

@@ -1,0 +1,428 @@
+"""DAG scheduler: cut stages at shuffle boundaries, run them bottom-up.
+
+Reference: src/scheduler/base_scheduler.rs (shared DAG logic), job.rs
+(JobTracker), local_scheduler.rs / distributed_scheduler.rs (event loops).
+The two reference schedulers share one trait; vega_tpu factors the same split
+differently — one DAGScheduler, pluggable TaskBackend (local thread pool,
+distributed executor fleet, or the device backend that runs whole stages as
+single SPMD programs, SURVEY.md §7 "two-plane scheduler").
+
+Improvements over the reference, each flagged inline:
+  * event loop blocks on a queue instead of polling every 50ms
+    (cf. base_scheduler.rs:457-468);
+  * FetchFailed is actually raised and recovered (cf. SURVEY.md §5 — the
+    reference built the path but nothing emits it, and generic errors panic);
+  * max_failures is enforced (plumbed-but-unused in the reference,
+    local_scheduler.rs:29,57).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from vega_tpu.dependency import NarrowDependency, ShuffleDependency
+from vega_tpu.env import Env
+from vega_tpu.errors import FetchFailedError, TaskError, VegaError
+from vega_tpu.scheduler import events as ev
+from vega_tpu.scheduler.stage import Stage
+from vega_tpu.scheduler.task import (
+    ResultTask,
+    ShuffleMapTask,
+    Task,
+    TaskContext,
+    TaskEndEvent,
+)
+
+log = logging.getLogger("vega_tpu")
+
+
+class TaskBackend:
+    """Executes tasks and reports completions."""
+
+    def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass
+
+    @property
+    def parallelism(self) -> int:
+        return 1
+
+
+class _Job:
+    """Per-job state (reference: scheduler/job.rs:49-97)."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, final_rdd, func, partitions: List[int],
+                 on_task_success: Optional[Callable[[int, Any], None]] = None):
+        self.job_id = next(_Job._ids)
+        self.final_rdd = final_rdd
+        self.func = func
+        self.partitions = partitions
+        self.results: List[Any] = [None] * len(partitions)
+        self.finished: List[bool] = [False] * len(partitions)
+        self.num_finished = 0
+        self.on_task_success = on_task_success
+        self.waiting: Set[Stage] = set()
+        self.running: Set[Stage] = set()
+        self.failed: Set[Stage] = set()
+        self.pending_tasks: Dict[int, Set[int]] = {}  # stage_id -> partitions
+        self.task_attempts: Dict[tuple, int] = {}  # (stage_id, partition) -> tries
+        self.last_fetch_failure: float = 0.0
+
+
+class DAGScheduler:
+    def __init__(self, backend: TaskBackend,
+                 bus: Optional[ev.LiveListenerBus] = None):
+        self.backend = backend
+        self.bus = bus or ev.LiveListenerBus()
+        self._next_stage_id = itertools.count(0)
+        self._shuffle_to_map_stage: Dict[int, Stage] = {}
+        # One job at a time, like the reference's scheduler_lock
+        # (distributed_scheduler.rs:183-187). Jobs from multiple driver
+        # threads serialize here. Reentrant: materializing a checkpoint
+        # (_do_checkpoint) legitimately nests a job inside job setup.
+        self._job_lock = threading.RLock()
+
+    # ------------------------------------------------------------- public API
+    def run_job(self, rdd, func, partitions: Optional[List[int]] = None) -> list:
+        if partitions is None:
+            partitions = list(range(rdd.num_partitions))
+        if not partitions:
+            return []
+        with self._job_lock:
+            return self._run_job_inner(rdd, func, partitions, None)
+
+    def run_job_with_listener(self, rdd, func, partitions,
+                              on_task_success) -> list:
+        with self._job_lock:
+            return self._run_job_inner(rdd, func, partitions, on_task_success)
+
+    def stop(self) -> None:
+        self.backend.stop()
+        self.bus.stop()
+
+    # ---------------------------------------------------------- stage plumbing
+    def _new_stage(self, rdd, shuffle_dep: Optional[ShuffleDependency]) -> Stage:
+        """Reference: base_scheduler.rs:44-70."""
+        env = Env.get()
+        if env.cache_tracker is not None:
+            env.cache_tracker.register_rdd(rdd.rdd_id, rdd.num_partitions)
+        if shuffle_dep is not None and env.map_output_tracker is not None:
+            env.map_output_tracker.register_shuffle(
+                shuffle_dep.shuffle_id, rdd.num_partitions
+            )
+        stage = Stage(
+            next(self._next_stage_id), rdd, shuffle_dep,
+            self._get_parent_stages(rdd),
+        )
+        return stage
+
+    def _get_shuffle_map_stage(self, dep: ShuffleDependency) -> Stage:
+        """Reference: distributed_scheduler.rs:484-509 — map stages are cached
+        per shuffle_id so their outputs are reused across jobs."""
+        stage = self._shuffle_to_map_stage.get(dep.shuffle_id)
+        if stage is None:
+            stage = self._new_stage(dep.rdd, dep)
+            self._shuffle_to_map_stage[dep.shuffle_id] = stage
+        return stage
+
+    def _get_parent_stages(self, rdd) -> List[Stage]:
+        """DFS over deps, cutting at shuffle edges
+        (reference: base_scheduler.rs:124-157)."""
+        parents: List[Stage] = []
+        seen_rdds: Set[int] = set()
+        seen_stage_ids: Set[int] = set()
+
+        def visit(r):
+            if r.rdd_id in seen_rdds:
+                return
+            seen_rdds.add(r.rdd_id)
+            for dep in r.get_dependencies():
+                if isinstance(dep, ShuffleDependency):
+                    stage = self._get_shuffle_map_stage(dep)
+                    if stage.id not in seen_stage_ids:
+                        seen_stage_ids.add(stage.id)
+                        parents.append(stage)
+                else:
+                    visit(dep.rdd)
+
+        visit(rdd)
+        return parents
+
+    def _get_missing_parent_stages(self, stage: Stage) -> List[Stage]:
+        """Reference: base_scheduler.rs:72-122."""
+        missing: List[Stage] = []
+        seen: Set[int] = set()
+        tracker = Env.get().map_output_tracker
+
+        def visit(r):
+            if r.rdd_id in seen:
+                return
+            seen.add(r.rdd_id)
+            for dep in r.get_dependencies():
+                if isinstance(dep, ShuffleDependency):
+                    parent = self._get_shuffle_map_stage(dep)
+                    available = parent.is_available and (
+                        tracker is None or tracker.has_outputs(dep.shuffle_id)
+                    )
+                    if not available and parent not in missing:
+                        missing.append(parent)
+                else:
+                    visit(dep.rdd)
+
+        visit(stage.rdd)
+        return missing
+
+    def _get_preferred_locs(self, rdd, partition: int, depth: int = 0) -> List[str]:
+        """cache locs -> rdd prefs -> narrow-parent recursion
+        (reference: base_scheduler.rs:499-528)."""
+        if depth > 20:
+            return []
+        env = Env.get()
+        if env.cache_tracker is not None and rdd.should_cache:
+            cached = env.cache_tracker.get_cache_locs(rdd.rdd_id, partition)
+            if cached:
+                return cached
+        splits = rdd.splits()
+        if partition < len(splits):
+            prefs = rdd.preferred_locations(splits[partition])
+            if prefs:
+                return prefs
+        for dep in rdd.get_dependencies():
+            if isinstance(dep, NarrowDependency):
+                for parent_part in dep.get_parents(partition):
+                    locs = self._get_preferred_locs(dep.rdd, parent_part, depth + 1)
+                    if locs:
+                        return locs
+        return []
+
+    # ------------------------------------------------------------- event loop
+    def _run_job_inner(self, rdd, func, partitions: List[int],
+                       on_task_success) -> list:
+        t_start = time.time()
+        conf = Env.get().conf
+        rdd._do_checkpoint()
+        job = _Job(rdd, func, partitions, on_task_success)
+        final_stage = self._new_stage(rdd, None)
+        event_queue: "queue.Queue[TaskEndEvent]" = queue.Queue()
+
+        self.bus.post(ev.JobStart(job_id=job.job_id,
+                                  num_stages=1 + len(final_stage.parents)))
+
+        # Fast path: single-partition, no-parent final stage runs inline
+        # (reference: base_scheduler.rs:25-42 local_execution).
+        if not final_stage.parents and len(partitions) == 1:
+            split = rdd.splits()[partitions[0]]
+            tc = TaskContext(final_stage.id, split.index, 0)
+            result = func(tc, rdd.iterator(split, tc))
+            if on_task_success is not None:
+                on_task_success(0, result)
+            self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=True,
+                                    duration_s=time.time() - t_start))
+            return [result]
+
+        stage_starts: Dict[int, float] = {}
+
+        def submit_stage(stage: Stage):
+            """Reference: base_scheduler.rs:347-375."""
+            if stage in job.waiting or stage in job.running:
+                return
+            missing = self._get_missing_parent_stages(stage)
+            if not missing:
+                submit_missing_tasks(stage)
+                job.running.add(stage)
+            else:
+                job.waiting.add(stage)
+                for parent in missing:
+                    submit_stage(parent)
+
+        def submit_missing_tasks(stage: Stage):
+            """Reference: base_scheduler.rs:377-455."""
+            stage_starts.setdefault(stage.id, time.time())
+            pending = job.pending_tasks.setdefault(stage.id, set())
+            tasks: List[Task] = []
+            if stage is final_stage:
+                for out_id, p in enumerate(partitions):
+                    if not job.finished[out_id]:
+                        split = rdd.splits()[p]
+                        tasks.append(ResultTask(
+                            stage.id, rdd, func, p, split, out_id,
+                            self._get_preferred_locs(rdd, p),
+                            pinned=rdd.is_pinned,
+                        ))
+            else:
+                for p in range(stage.num_partitions):
+                    if not stage.output_locs[p]:
+                        split = stage.rdd.splits()[p]
+                        tasks.append(ShuffleMapTask(
+                            stage.id, stage.rdd, stage.shuffle_dep, p, split,
+                            self._get_preferred_locs(stage.rdd, p),
+                            pinned=stage.rdd.is_pinned,
+                        ))
+            self.bus.post(ev.StageSubmitted(
+                stage_id=stage.id, num_tasks=len(tasks),
+                is_shuffle_map=stage.is_shuffle_map,
+            ))
+            for task in tasks:
+                pending.add(task.partition)
+            for task in tasks:
+                self._submit_task(task, event_queue)
+
+        def stage_of(task: Task) -> Optional[Stage]:
+            if task.stage_id == final_stage.id:
+                return final_stage
+            for s in itertools.chain(job.running, job.waiting, job.failed):
+                if s.id == task.stage_id:
+                    return s
+            return self._stage_by_id(task.stage_id)
+
+        def on_success(event: TaskEndEvent):
+            """Reference: base_scheduler.rs:202-345."""
+            task = event.task
+            stage = stage_of(task)
+            if isinstance(task, ResultTask):
+                out_id = task.output_id
+                if not job.finished[out_id]:
+                    job.results[out_id] = event.result
+                    job.finished[out_id] = True
+                    job.num_finished += 1
+                    if job.on_task_success is not None:
+                        job.on_task_success(out_id, event.result)
+            else:  # ShuffleMapTask
+                if stage is None:
+                    return
+                stage.add_output_loc(task.partition, event.result)
+                pending = job.pending_tasks.get(stage.id)
+                if pending is not None:
+                    pending.discard(task.partition)
+                if pending is not None and not pending:
+                    self._finish_map_stage(job, stage, submit_stage,
+                                           submit_missing_tasks, stage_starts)
+
+        def on_failure(event: TaskEndEvent):
+            """Reference: base_scheduler.rs:172-200, plus enforcement the
+            reference lacks."""
+            task = event.task
+            err = event.error
+            if isinstance(err, FetchFailedError):
+                map_stage = self._shuffle_to_map_stage.get(err.shuffle_id)
+                tracker = Env.get().map_output_tracker
+                if map_stage is not None and err.map_id is not None:
+                    map_stage.remove_output_loc(err.map_id, err.server_uri)
+                    if tracker is not None:
+                        try:
+                            tracker.unregister_map_output(
+                                err.shuffle_id, err.map_id, err.server_uri
+                            )
+                        except VegaError:
+                            pass
+                this_stage = stage_of(task)
+                if this_stage is not None:
+                    job.running.discard(this_stage)
+                    job.failed.add(this_stage)
+                if map_stage is not None:
+                    job.running.discard(map_stage)
+                    job.failed.add(map_stage)
+                job.last_fetch_failure = time.time()
+                return
+            key = (task.stage_id, task.partition)
+            tries = job.task_attempts.get(key, 0) + 1
+            job.task_attempts[key] = tries
+            conf_max = Env.get().conf.max_failures
+            if tries < conf_max:
+                log.warning("task %s failed (attempt %d/%d): %s",
+                            task, tries, conf_max, err)
+                task.attempt = tries
+                self._submit_task(task, event_queue)
+            else:
+                raise TaskError(
+                    f"task {task} failed {tries} times; aborting job: {err!r}",
+                    remote_traceback=getattr(err, "remote_traceback", None),
+                ) from err
+
+        try:
+            submit_stage(final_stage)
+            while job.num_finished < len(partitions):
+                try:
+                    event = event_queue.get(timeout=conf.poll_timeout_s)
+                except queue.Empty:
+                    self._maybe_resubmit_failed(job, submit_stage, conf)
+                    continue
+                self.bus.post(ev.TaskEnd(
+                    task_id=event.task.task_id, stage_id=event.task.stage_id,
+                    partition=event.task.partition, success=event.success,
+                ))
+                if event.success:
+                    on_success(event)
+                else:
+                    on_failure(event)
+                self._maybe_resubmit_failed(job, submit_stage, conf)
+            self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=True,
+                                    duration_s=time.time() - t_start))
+            return job.results
+        except BaseException:
+            self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=False,
+                                    duration_s=time.time() - t_start))
+            raise
+
+    # ------------------------------------------------------------- internals
+    def _stage_by_id(self, stage_id: int) -> Optional[Stage]:
+        for stage in self._shuffle_to_map_stage.values():
+            if stage.id == stage_id:
+                return stage
+        return None
+
+    def _finish_map_stage(self, job: _Job, stage: Stage, submit_stage,
+                          submit_missing_tasks, stage_starts) -> None:
+        """All pending tasks of a shuffle-map stage drained
+        (reference: base_scheduler.rs:232-345)."""
+        tracker = Env.get().map_output_tracker
+        if stage.is_available:
+            job.running.discard(stage)
+            job.failed.discard(stage)
+            if tracker is not None:
+                tracker.register_map_outputs(
+                    stage.shuffle_dep.shuffle_id,
+                    [locs[0] if locs else None for locs in stage.output_locs],
+                )
+            self.bus.post(ev.StageCompleted(
+                stage_id=stage.id,
+                duration_s=time.time() - stage_starts.get(stage.id, time.time()),
+            ))
+            # Wake newly-runnable waiting stages.
+            runnable = [
+                s for s in list(job.waiting)
+                if not self._get_missing_parent_stages(s)
+            ]
+            for s in runnable:
+                job.waiting.discard(s)
+                job.running.add(s)
+                submit_missing_tasks(s)
+        else:
+            # Some outputs got invalidated while we ran; resubmit the holes
+            # (reference: base_scheduler.rs:317-334).
+            submit_missing_tasks(stage)
+            job.running.add(stage)
+
+    def _maybe_resubmit_failed(self, job: _Job, submit_stage, conf) -> None:
+        """Reference: local_scheduler.rs:248-256 (resubmit_timeout)."""
+        if not job.failed:
+            return
+        if time.time() - job.last_fetch_failure < conf.resubmit_timeout_s:
+            return
+        to_retry = list(job.failed)
+        job.failed.clear()
+        for stage in to_retry:
+            submit_stage(stage)
+
+    def _submit_task(self, task: Task,
+                     event_queue: "queue.Queue[TaskEndEvent]") -> None:
+        self.backend.submit(task, event_queue.put)

@@ -1,0 +1,160 @@
+"""Scheduler event bus + metrics.
+
+Reference: src/scheduler/live_listener_bus.rs — a Spark-style bus skeleton
+with no registered queues or consumers (SURVEY.md §5). vega_tpu implements the
+real thing: a background dispatch thread, registered listeners, and a built-in
+metrics listener exposing per-job/stage/task wall times (replacing the
+reference's ad-hoc debug logs, context.rs:60-71 / executor.rs:125-164).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("vega_tpu")
+
+
+@dataclasses.dataclass
+class Event:
+    time: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class JobStart(Event):
+    job_id: int = -1
+    num_stages: int = 0
+
+
+@dataclasses.dataclass
+class JobEnd(Event):
+    job_id: int = -1
+    succeeded: bool = True
+    duration_s: float = 0.0
+
+
+@dataclasses.dataclass
+class StageSubmitted(Event):
+    stage_id: int = -1
+    num_tasks: int = 0
+    is_shuffle_map: bool = False
+
+
+@dataclasses.dataclass
+class StageCompleted(Event):
+    stage_id: int = -1
+    duration_s: float = 0.0
+
+
+@dataclasses.dataclass
+class TaskEnd(Event):
+    task_id: int = -1
+    stage_id: int = -1
+    partition: int = -1
+    success: bool = True
+    duration_s: float = 0.0
+    executor: str = "local"
+
+
+class Listener:
+    def on_event(self, event: Event) -> None:
+        raise NotImplementedError
+
+
+class LiveListenerBus:
+    """Reference: live_listener_bus.rs:24-131 (but with real consumers)."""
+
+    def __init__(self):
+        self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._listeners: List[Listener] = []
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._lock = threading.Lock()
+
+    def add_listener(self, listener: Listener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="listener-bus", daemon=True
+            )
+            self._thread.start()
+
+    def post(self, event: Event) -> None:
+        if self._started:
+            self._queue.put(event)
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            self._started = False
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            event = self._queue.get()
+            if event is None:
+                return
+            with self._lock:
+                listeners = list(self._listeners)
+            for listener in listeners:
+                try:
+                    listener.on_event(event)
+                except Exception:
+                    log.exception("listener raised")
+
+
+class MetricsListener(Listener):
+    """Aggregates job/stage/task timings; queryable from the driver."""
+
+    def __init__(self):
+        self.jobs: Dict[int, Dict[str, Any]] = {}
+        self.stages: Dict[int, Dict[str, Any]] = {}
+        self.task_count = 0
+        self.task_failures = 0
+        self.total_task_time_s = 0.0
+        self._lock = threading.Lock()
+
+    def on_event(self, event: Event) -> None:
+        with self._lock:
+            if isinstance(event, JobStart):
+                self.jobs[event.job_id] = {"start": event.time, "stages": event.num_stages}
+            elif isinstance(event, JobEnd):
+                info = self.jobs.setdefault(event.job_id, {})
+                info["duration_s"] = event.duration_s
+                info["succeeded"] = event.succeeded
+            elif isinstance(event, StageSubmitted):
+                self.stages[event.stage_id] = {
+                    "tasks": event.num_tasks,
+                    "shuffle": event.is_shuffle_map,
+                    "start": event.time,
+                }
+            elif isinstance(event, StageCompleted):
+                self.stages.setdefault(event.stage_id, {})["duration_s"] = event.duration_s
+            elif isinstance(event, TaskEnd):
+                self.task_count += 1
+                self.total_task_time_s += event.duration_s
+                if not event.success:
+                    self.task_failures += 1
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "jobs": len(self.jobs),
+                "stages": len(self.stages),
+                "tasks": self.task_count,
+                "task_failures": self.task_failures,
+                "total_task_time_s": round(self.total_task_time_s, 6),
+            }

@@ -1,0 +1,58 @@
+"""Local task backend: a thread pool in the driver process.
+
+Reference: src/scheduler/local_scheduler.rs — tasks run on a tokio blocking
+pool (:336-352) and round-trip through bincode even locally (:345-351) to
+catch unserializable tasks early. vega_tpu mirrors both (the round-trip is
+opt-in via Configuration.serialize_tasks_locally; the numeric tier releases
+the GIL inside XLA so threads parallelize the hot path).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+from vega_tpu import serialization
+from vega_tpu.env import Env
+from vega_tpu.scheduler.dag import TaskBackend
+from vega_tpu.scheduler.task import Task, TaskEndEvent
+
+log = logging.getLogger("vega_tpu")
+
+
+class LocalBackend(TaskBackend):
+    def __init__(self, num_workers: int | None = None,
+                 serialize_tasks: bool | None = None):
+        conf = Env.get().conf
+        self._num_workers = num_workers or conf.num_workers
+        self._serialize = (
+            conf.serialize_tasks_locally
+            if serialize_tasks is None
+            else serialize_tasks
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._num_workers, thread_name_prefix="vega-task"
+        )
+
+    @property
+    def parallelism(self) -> int:
+        return self._num_workers
+
+    def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
+        def run():
+            try:
+                t = task
+                if self._serialize:
+                    # Reference: local_scheduler.rs:345-351.
+                    t = serialization.loads(serialization.dumps(task))
+                result = t.run()
+                callback(TaskEndEvent(task=task, success=True, result=result))
+            except BaseException as exc:  # noqa: BLE001 — report, don't die
+                log.debug("task %s failed", task, exc_info=True)
+                callback(TaskEndEvent(task=task, success=False, error=exc))
+
+        self._pool.submit(run)
+
+    def stop(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,56 @@
+"""Unit of scheduling (reference: src/scheduler/stage.rs).
+
+output_locs[partition] is the list of server URIs holding that map output,
+newest first; the stage is available when every partition has at least one
+location (reference: stage.rs:73-84).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from vega_tpu.dependency import ShuffleDependency
+
+
+class Stage:
+    def __init__(self, stage_id: int, rdd,
+                 shuffle_dep: Optional[ShuffleDependency],
+                 parents: List["Stage"]):
+        self.id = stage_id
+        self.rdd = rdd
+        self.shuffle_dep = shuffle_dep  # None => result stage
+        self.parents = parents
+        self.num_partitions = rdd.num_partitions
+        self.output_locs: List[List[str]] = [[] for _ in range(self.num_partitions)]
+
+    @property
+    def is_shuffle_map(self) -> bool:
+        return self.shuffle_dep is not None
+
+    @property
+    def num_available_outputs(self) -> int:
+        return sum(1 for locs in self.output_locs if locs)
+
+    @property
+    def is_available(self) -> bool:
+        """Reference: stage.rs:73-84."""
+        if not self.is_shuffle_map:
+            return not self.parents
+        return self.num_available_outputs == self.num_partitions
+
+    def add_output_loc(self, partition: int, uri: str) -> None:
+        self.output_locs[partition].insert(0, uri)
+
+    def remove_output_loc(self, partition: int, uri: str) -> None:
+        self.output_locs[partition] = [
+            u for u in self.output_locs[partition] if u != uri
+        ]
+
+    def remove_outputs_on_server(self, uri: str) -> None:
+        """Executor-loss handling (reference: stage.rs:95-109)."""
+        for p in range(self.num_partitions):
+            self.output_locs[p] = [u for u in self.output_locs[p] if u != uri]
+
+    def __repr__(self):
+        kind = "shuffle" if self.is_shuffle_map else "result"
+        return f"Stage(id={self.id}, {kind}, rdd={self.rdd.rdd_id})"

@@ -1,0 +1,93 @@
+"""Task types shipped to executors.
+
+Reference: src/scheduler/task.rs (TaskContext :12-26, TaskOption/TaskResult
+envelope :76-103, run dispatch :105-111), result_task.rs (ResultTask::run
+:159-165), shuffle_map_task.rs (ShuffleMapTask::run :86-91).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+from vega_tpu.dependency import ShuffleDependency
+from vega_tpu.split import Split
+
+
+@dataclasses.dataclass
+class TaskContext:
+    """Reference: task.rs:12-26."""
+
+    stage_id: int
+    split_index: int
+    attempt_id: int
+
+
+_task_ids = iter(range(1, 1 << 62))
+
+
+class Task:
+    """Common task surface (reference: task.rs:28-74)."""
+
+    def __init__(self, stage_id: int, partition: int, split: Split,
+                 preferred_locs: Optional[List[str]] = None,
+                 pinned: bool = False):
+        self.task_id = next(_task_ids)
+        self.stage_id = stage_id
+        self.partition = partition
+        self.split = split
+        self.preferred_locs = preferred_locs or []
+        self.pinned = pinned
+        self.attempt = 0
+
+    def run(self) -> Any:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(id={self.task_id}, "
+                f"stage={self.stage_id}, part={self.partition})")
+
+
+class ResultTask(Task):
+    """Final-stage task: user func over rdd.iterator(split)
+    (reference: result_task.rs:159-165)."""
+
+    def __init__(self, stage_id: int, rdd, func: Callable, partition: int,
+                 split: Split, output_id: int,
+                 preferred_locs: Optional[List[str]] = None,
+                 pinned: bool = False):
+        super().__init__(stage_id, partition, split, preferred_locs, pinned)
+        self.rdd = rdd
+        self.func = func
+        self.output_id = output_id
+
+    def run(self) -> Any:
+        tc = TaskContext(self.stage_id, self.split.index, self.attempt)
+        return self.func(tc, self.rdd.iterator(self.split, tc))
+
+
+class ShuffleMapTask(Task):
+    """Parent-stage task: run the map-side combine, return this executor's
+    shuffle server URI (reference: shuffle_map_task.rs:86-91)."""
+
+    def __init__(self, stage_id: int, rdd, dep: ShuffleDependency,
+                 partition: int, split: Split,
+                 preferred_locs: Optional[List[str]] = None,
+                 pinned: bool = False):
+        super().__init__(stage_id, partition, split, preferred_locs, pinned)
+        self.rdd = rdd
+        self.dep = dep
+
+    def run(self) -> str:
+        tc = TaskContext(self.stage_id, self.split.index, self.attempt)
+        return self.dep.do_shuffle_task(self.split, tc)
+
+
+@dataclasses.dataclass
+class TaskEndEvent:
+    """Completion event (reference: dag_scheduler.rs CompletionEvent :8-31)."""
+
+    task: Task
+    success: bool
+    result: Any = None
+    error: Optional[BaseException] = None

@@ -1,0 +1,84 @@
+"""Portable computation encoding.
+
+The reference's load-bearing trick is serializable closures + trait objects
+(serde_closure / serde_traitobject, src/serializable_traits.rs:281-315): a
+whole RDD lineage with user lambdas ships to executors as bincode bytes in a
+capnp envelope (src/capnp/serialized_data.capnp:1-5).
+
+vega_tpu's equivalent has two tiers:
+  1. Host tier: cloudpickle — closures, lineage objects, partition data all
+     serialize; framed for the wire by the native C++ framing lib
+     (native/framing.cpp) with a Python fallback.
+  2. Device tier: user functions are *traced* into jaxprs at stage-compile
+     time (tpu/plan.py); only the lineage spec travels, never pickled device
+     code. This replaces serde_closure with "portable computation = traced
+     function", per SURVEY.md §7.
+
+All wire payloads go through dumps()/loads() here so the codec is swappable in
+one place.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+
+import cloudpickle
+
+# Protocol 5 enables out-of-band buffers for zero-copy numpy/arrow payloads.
+_PROTO = 5
+
+
+def dumps(obj) -> bytes:
+    return cloudpickle.dumps(obj, protocol=_PROTO)
+
+
+def loads(data: bytes):
+    return pickle.loads(data)
+
+
+def dumps_oob(obj):
+    """Serialize with out-of-band buffers: returns (header_bytes, [buffers]).
+
+    Large numpy arrays are passed as zero-copy PickleBuffers, so partition
+    blocks cross process boundaries without an extra copy (the reference pays
+    a full bincode copy per task, src/local_scheduler.rs:345-351).
+    """
+    buffers = []
+    header = cloudpickle.dumps(obj, protocol=_PROTO, buffer_callback=buffers.append)
+    return header, [b.raw() for b in buffers]
+
+
+def loads_oob(header: bytes, buffers):
+    return pickle.loads(header, buffers=buffers)
+
+
+# ---------------------------------------------------------------------------
+# Length-framing (reference: the one-field capnp envelope serialized_data.capnp)
+# ---------------------------------------------------------------------------
+
+_FRAME = struct.Struct("<Q")
+
+
+def write_frame(stream: io.RawIOBase, payload: bytes) -> None:
+    stream.write(_FRAME.pack(len(payload)))
+    stream.write(payload)
+
+
+def read_frame(stream: io.RawIOBase) -> bytes:
+    head = _read_exact(stream, _FRAME.size)
+    (n,) = _FRAME.unpack(head)
+    return _read_exact(stream, n)
+
+
+def _read_exact(stream, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = stream.read(remaining)
+        if not chunk:
+            raise EOFError(f"stream closed with {remaining} bytes outstanding")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

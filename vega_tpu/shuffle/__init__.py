@@ -1,0 +1,4 @@
+from vega_tpu.shuffle.store import ShuffleStore
+from vega_tpu.shuffle.fetcher import ShuffleFetcher
+
+__all__ = ["ShuffleStore", "ShuffleFetcher"]

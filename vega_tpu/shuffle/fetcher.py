@@ -1,0 +1,83 @@
+"""Reduce-side shuffle fetch.
+
+Reference: src/shuffle/shuffle_fetcher.rs:16-119 — look up each map output's
+server URI from the MapOutputTracker, fetch all (server, map_id) buckets in
+parallel with early abort on failure, and feed (K, C) pairs to the caller.
+
+vega_tpu: "local" URIs read straight from the in-process ShuffleStore; remote
+URIs fetch over the executor's shuffle TCP server
+(distributed/shuffle_server.py). A failed remote fetch raises FetchFailedError
+so the scheduler can actually run its recovery path (unlike the reference,
+where the error path panics — see errors.FetchFailedError docstring).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, List, Tuple
+
+from vega_tpu import serialization
+from vega_tpu.env import Env
+from vega_tpu.errors import FetchFailedError, ShuffleError
+
+log = logging.getLogger("vega_tpu")
+
+
+class ShuffleFetcher:
+    @staticmethod
+    def fetch(shuffle_id: int, reduce_id: int) -> Iterator[Tuple]:
+        """Yield all (K, C) pairs destined for `reduce_id`."""
+        env = Env.get()
+        tracker = env.map_output_tracker
+        if tracker is None:
+            raise ShuffleError("no map output tracker configured")
+        server_uris: List[str] = tracker.get_server_uris(shuffle_id)
+
+        # Group map ids by server so each server is hit by one worker
+        # (reference: shuffle_fetcher.rs:33-53).
+        by_server: dict = {}
+        for map_id, uri in enumerate(server_uris):
+            if uri is None:
+                raise FetchFailedError(None, shuffle_id, map_id, reduce_id,
+                                       "missing map output location")
+            by_server.setdefault(uri, []).append(map_id)
+
+        local_store = env.shuffle_store
+
+        def fetch_from(uri: str) -> List[bytes]:
+            blobs = []
+            for map_id in by_server[uri]:
+                if uri == "local" or (env.shuffle_server is not None
+                                      and uri == env.shuffle_server.uri):
+                    data = local_store.get(shuffle_id, map_id, reduce_id)
+                    if data is None:
+                        raise FetchFailedError(uri, shuffle_id, map_id, reduce_id,
+                                               "bucket missing from local store")
+                else:
+                    from vega_tpu.distributed.shuffle_server import fetch_remote
+
+                    data = fetch_remote(uri, shuffle_id, map_id, reduce_id)
+                blobs.append(data)
+            return blobs
+
+        uris = list(by_server)
+        if len(uris) == 1:
+            blob_lists = [fetch_from(uris[0])]
+        else:
+            with ThreadPoolExecutor(max_workers=min(len(uris), 16)) as pool:
+                blob_lists = list(pool.map(fetch_from, uris))
+
+        for blobs in blob_lists:
+            for blob in blobs:
+                for kv in serialization.loads(blob):
+                    yield kv
+
+    @staticmethod
+    def fetch_into(shuffle_id: int, reduce_id: int,
+                   merge: Callable[[dict, Tuple], None]) -> dict:
+        """Fetch and fold into a combiner dict (reference: shuffled_rdd.rs:149-170)."""
+        out: dict = {}
+        for kv in ShuffleFetcher.fetch(shuffle_id, reduce_id):
+            merge(out, kv)
+        return out

@@ -1,0 +1,97 @@
+"""In-process shuffle output store.
+
+Reference: the global SHUFFLE_CACHE DashMap keyed
+(shuffle_id, map_id, reduce_id) -> serialized bucket bytes (src/env.rs:19,27;
+written by src/dependency.rs:212-223; served over HTTP by
+src/shuffle/shuffle_manager.rs:169-251).
+
+vega_tpu keeps the same keying. In local mode reads hit this dict directly; in
+distributed mode each executor's ShuffleServer (distributed/shuffle_server.py)
+serves GETs out of it, and large buckets spill to the session work dir instead
+of pinning process memory (the reference's on-disk path exists but is
+vestigial — shuffle_manager.rs:62-78 creates dirs it never uses; we actually
+spill).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+Key = Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
+
+# Buckets larger than this spill to disk (bytes).
+SPILL_THRESHOLD = 64 * 1024 * 1024
+
+
+class ShuffleStore:
+    def __init__(self, spill_dir: Optional[str] = None,
+                 spill_threshold: int = SPILL_THRESHOLD):
+        self._mem: Dict[Key, bytes] = {}
+        self._disk: Dict[Key, str] = {}
+        self._lock = threading.Lock()
+        self._spill_dir = spill_dir
+        self._spill_threshold = spill_threshold
+
+    def put(self, shuffle_id: int, map_id: int, reduce_id: int, data: bytes) -> None:
+        key = (shuffle_id, map_id, reduce_id)
+        if self._spill_dir and len(data) > self._spill_threshold:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(
+                self._spill_dir, f"shuffle-{shuffle_id}-{map_id}-{reduce_id}.bin"
+            )
+            with open(path, "wb") as f:
+                f.write(data)
+            with self._lock:
+                self._disk[key] = path
+                self._mem.pop(key, None)
+        else:
+            with self._lock:
+                self._mem[key] = data
+                self._disk.pop(key, None)
+
+    def get(self, shuffle_id: int, map_id: int, reduce_id: int) -> Optional[bytes]:
+        key = (shuffle_id, map_id, reduce_id)
+        with self._lock:
+            data = self._mem.get(key)
+            path = self._disk.get(key)
+        if data is not None:
+            return data
+        if path is not None:
+            with open(path, "rb") as f:
+                return f.read()
+        return None
+
+    def contains(self, shuffle_id: int, map_id: int, reduce_id: int) -> bool:
+        key = (shuffle_id, map_id, reduce_id)
+        with self._lock:
+            return key in self._mem or key in self._disk
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        """Drop all outputs of a shuffle (stage retry / job cleanup)."""
+        with self._lock:
+            for key in [k for k in self._mem if k[0] == shuffle_id]:
+                del self._mem[key]
+            doomed = [k for k in self._disk if k[0] == shuffle_id]
+            paths = [self._disk.pop(k) for k in doomed]
+        for path in paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def clear(self) -> None:
+        with self._lock:
+            paths = list(self._disk.values())
+            self._mem.clear()
+            self._disk.clear()
+        for path in paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def __len__(self):
+        with self._lock:
+            return len(self._mem) + len(self._disk)

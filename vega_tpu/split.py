@@ -1,0 +1,28 @@
+"""Partition descriptors (reference: src/split.rs:8-13).
+
+A Split is an index plus an optional per-RDD payload (e.g. the data slice a
+ParallelCollection split carries, reference:
+src/rdd/parallel_collection_rdd.rs:30-56, or the (s1, s2) pair of a cartesian
+split, src/rdd/cartesian_rdd.rs:86-103).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class Split:
+    __slots__ = ("index", "payload")
+
+    def __init__(self, index: int, payload: Any = None):
+        self.index = index
+        self.payload = payload
+
+    def __repr__(self):
+        return f"Split({self.index})"
+
+    def __eq__(self, other):
+        return isinstance(other, Split) and other.index == self.index
+
+    def __hash__(self):
+        return hash(("Split", self.index))

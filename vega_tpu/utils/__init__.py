@@ -1,0 +1,3 @@
+from vega_tpu.utils.bounded_priority_queue import BoundedPriorityQueue
+
+__all__ = ["BoundedPriorityQueue"]

@@ -1,0 +1,68 @@
+"""Top-K bounded heap for top / take_ordered
+(reference: src/utils/bounded_priority_queue.rs:8-58).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class BoundedPriorityQueue:
+    """Keeps the K smallest items by `key` (min-K). For top-K largest, pass a
+    negating key. Merge two queues with `merge` (used when combining
+    per-partition results on the driver, reference: rdd.rs:1106-1153)."""
+
+    def __init__(self, capacity: int, key: Optional[Callable] = None):
+        self.capacity = capacity
+        self.key = key or (lambda x: x)
+        # Max-heap of (neg-rank...) — store (key, seq, item) with inverted
+        # comparison via heapq on negated ordering trick: keep a max-heap by
+        # pushing wrapped keys.
+        self._heap: List = []  # entries: (_NegKey(key), seq, item)
+        self._seq = 0
+
+    def push(self, item: T) -> None:
+        k = self.key(item)
+        entry = (_NegKey(k), self._seq, item)
+        self._seq += 1
+        if len(self._heap) < self.capacity:
+            heapq.heappush(self._heap, entry)
+        else:
+            # Heap root is the *largest* key (worst of the kept smallest-K).
+            if k < self._heap[0][0].value:
+                heapq.heapreplace(self._heap, entry)
+
+    def extend(self, items: Iterable[T]) -> "BoundedPriorityQueue":
+        for item in items:
+            self.push(item)
+        return self
+
+    def merge(self, other: "BoundedPriorityQueue") -> "BoundedPriorityQueue":
+        for _, _, item in other._heap:
+            self.push(item)
+        return self
+
+    def items_sorted(self) -> List[T]:
+        return [item for _, _, item in
+                sorted(self._heap, key=lambda e: e[0].value)]
+
+    def __len__(self):
+        return len(self._heap)
+
+
+class _NegKey:
+    """Inverts comparison so heapq's min-heap behaves as a max-heap."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __lt__(self, other):
+        return other.value < self.value
+
+    def __eq__(self, other):
+        return other.value == self.value

@@ -1,0 +1,113 @@
+"""Pluggable partition samplers (reference: src/utils/random.rs).
+
+BernoulliSampler / PoissonSampler / BernoulliCellSampler mirror
+random.rs:58-297 including the gap-sampling optimization for small fractions
+(random.rs:123-150: skip ahead geometric(p) elements instead of flipping a
+coin per element). Sample-size -> fraction bounds mirror random.rs:318-358.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+# Below this fraction, gap sampling beats per-element draws
+# (reference: random.rs:36-40).
+GAP_SAMPLING_FRACTION_THRESHOLD = 0.4
+
+
+class RandomSampler:
+    """Reference: random.rs trait RandomSampler (:58-70)."""
+
+    def __init__(self, fraction: float, seed: int | None = None):
+        self.fraction = fraction
+        self.seed = seed
+
+    def sample(self, items: Iterator[T], split_seed: int) -> Iterator[T]:
+        raise NotImplementedError
+
+    def _rng(self, split_seed: int) -> np.random.Generator:
+        base = self.seed if self.seed is not None else 0xC0FFEE
+        return np.random.Generator(np.random.PCG64([base, split_seed]))
+
+
+class BernoulliSampler(RandomSampler):
+    """Sampling without replacement (reference: random.rs:153-219)."""
+
+    def sample(self, items, split_seed):
+        p = self.fraction
+        if p <= 0.0:
+            return
+        rng = self._rng(split_seed)
+        if p >= 1.0:
+            yield from items
+            return
+        if p <= GAP_SAMPLING_FRACTION_THRESHOLD:
+            # Gap sampling (reference: random.rs:123-150).
+            log1mp = math.log1p(-p)
+            skip = int(math.log(rng.random() or 1e-300) / log1mp)
+            for item in items:
+                if skip > 0:
+                    skip -= 1
+                    continue
+                yield item
+                skip = int(math.log(rng.random() or 1e-300) / log1mp)
+        else:
+            for item in items:
+                if rng.random() < p:
+                    yield item
+
+
+class PoissonSampler(RandomSampler):
+    """Sampling with replacement (reference: random.rs:222-297)."""
+
+    def sample(self, items, split_seed):
+        lam = self.fraction
+        if lam <= 0.0:
+            return
+        rng = self._rng(split_seed)
+        for item in items:
+            count = rng.poisson(lam)
+            for _ in range(count):
+                yield item
+
+
+class BernoulliCellSampler(RandomSampler):
+    """Accept items whose draw falls in [lb, ub); basis of random_split
+    (reference: random.rs:80-120)."""
+
+    def __init__(self, lb: float, ub: float, complement: bool = False,
+                 seed: int | None = None):
+        super().__init__(ub - lb, seed)
+        self.lb = lb
+        self.ub = ub
+        self.complement = complement
+
+    def sample(self, items, split_seed):
+        rng = self._rng(split_seed)
+        for item in items:
+            x = rng.random()
+            inside = self.lb <= x < self.ub
+            if inside != self.complement:
+                yield item
+
+
+def compute_fraction_for_sample_size(size: int, total: int,
+                                     with_replacement: bool) -> float:
+    """Oversampling fraction so P(sample >= size) is high
+    (reference: random.rs:318-358)."""
+    if with_replacement:
+        if size < 12:
+            return float(size) / total * (1.0 + 3.0)
+        frac = float(size) / total
+        delta = 1e-4
+        gamma = -math.log(delta) / total
+        return min(1.0, max(1e-10, frac + gamma + math.sqrt(gamma * gamma + 2 * gamma * frac)))
+    frac = float(size) / total
+    delta = 1e-4
+    gamma = -math.log(delta) / total
+    return min(1.0, max(1e-10, frac + gamma + math.sqrt(gamma * gamma + 2 * gamma * frac)))
