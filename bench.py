@@ -1,0 +1,89 @@
+"""Benchmark: the BASELINE.md north-star workload — group_by + join rows/sec.
+
+Workload (BASELINE.json configs 1+2): N (int32, float32) pairs with K distinct
+keys -> reduce_by_key(add) -> inner join against a K-row table. The device
+tier runs it as two fused SPMD programs (exchange + segment reduce; exchange +
+merge join). The baseline is this framework's own host (pure-Python local
+mode) tier on a scaled-down copy of the same pipeline — the stand-in for the
+reference's local-mode CPU throughput (the reference publishes no numbers,
+BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def device_pipeline(ctx, n_rows: int, n_keys: int):
+    kv = ctx.dense_range(n_rows).map(lambda x: (x % n_keys, (x * 0.5)))
+    reduced = kv.reduce_by_key(op="add")
+    table = ctx.dense_from_numpy(
+        np.arange(n_keys, dtype=np.int32),
+        np.arange(n_keys, dtype=np.float32) * 2.0,
+    )
+    joined = reduced.join(table)
+    return joined.count()
+
+
+def host_pipeline(ctx, n_rows: int, n_keys: int, partitions: int = 8):
+    kv = ctx.range(n_rows, num_slices=partitions).map(
+        lambda x: (x % n_keys, x * 0.5)
+    )
+    reduced = kv.reduce_by_key(lambda a, b: a + b, partitions)
+    table = ctx.parallelize(
+        [(int(k), float(k) * 2.0) for k in range(n_keys)], partitions
+    )
+    return reduced.join(table).count()
+
+
+def main():
+    import vega_tpu as v
+
+    n_dev = 20_000_000
+    keys_dev = 1_000_000
+    n_host = 400_000
+    keys_host = 20_000
+
+    ctx = v.Context("local")
+    try:
+        # --- host (CPU local-mode) baseline, scaled down ---
+        t0 = time.time()
+        host_count = host_pipeline(ctx, n_host, keys_host)
+        host_s = time.time() - t0
+        host_rows_per_s = n_host / host_s
+        assert host_count == keys_host
+
+        # --- device tier: warmup (compile) then measure ---
+        warm = device_pipeline(ctx, n_dev // 10, keys_dev // 10)
+        assert warm == keys_dev // 10
+        t0 = time.time()
+        dev_count = device_pipeline(ctx, n_dev, keys_dev)
+        dev_s = time.time() - t0
+        assert dev_count == keys_dev
+        dev_rows_per_s = n_dev / dev_s
+
+        result = {
+            "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "
+                      "1M-key inner join)",
+            "value": round(dev_rows_per_s),
+            "unit": "rows/sec",
+            "vs_baseline": round(dev_rows_per_s / host_rows_per_s, 2),
+            "detail": {
+                "device_rows": n_dev,
+                "device_seconds": round(dev_s, 3),
+                "host_baseline_rows": n_host,
+                "host_baseline_seconds": round(host_s, 3),
+                "host_rows_per_sec": round(host_rows_per_s),
+            },
+        }
+        print(json.dumps(result))
+    finally:
+        ctx.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,35 @@
 """Test harness config.
 
-Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic is
-exercised without TPU hardware (the driver separately dry-run-compiles the
-multi-chip path via __graft_entry__.dryrun_multichip). Env vars must be set
-before jax imports anywhere, hence this top-of-conftest block.
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding is
+exercised without TPU hardware. Some environments (e.g. the axon TPU tunnel)
+preload jax via sitecustomize before conftest runs, so env vars alone are too
+late — but the backend is not *initialized* until first use, so forcing
+jax_platforms through jax.config here still wins. The driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must precede backend initialization (first jax.devices()/jit call).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compilation cache: dense-tier programs compile once per
+# machine, not once per pytest run.
+jax.config.update("jax_compilation_cache_dir", "/tmp/vega_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+assert jax.default_backend() == "cpu", (
+    "tests must run on the CPU backend; TPU init happened before conftest"
+)
+assert jax.device_count() >= 8, "expected 8 virtual CPU devices"
 
 import pytest  # noqa: E402
 

@@ -57,10 +57,7 @@ def hash_key(key: Any) -> int:
         # Hash the bit pattern, not the float, for exact CPU/TPU agreement.
         return splitmix64(struct.unpack("<Q", struct.pack("<d", float(key)))[0])
     if isinstance(key, str):
-        h = 0xCBF29CE484222325
-        for b in key.encode("utf-8"):
-            h = ((h ^ b) * 0x100000001B3) & _MASK
-        return splitmix64(h)
+        key = key.encode("utf-8")
     if isinstance(key, bytes):
         h = 0xCBF29CE484222325
         for b in key:

@@ -64,6 +64,15 @@ class RDD(PairOpsMixin):
         """Reference: rdd.rs:98 — one Split per partition."""
         return [Split(i) for i in range(self.num_partitions)]
 
+    def cached_splits(self) -> List[Split]:
+        """Memoized splits() — scheduler hot paths call this per task; splits
+        are deterministic per RDD so one build per RDD suffices."""
+        cache = getattr(self, "_splits_cache", None)
+        if cache is None:
+            cache = self.splits()
+            self._splits_cache = cache
+        return cache
+
     @property
     def num_partitions(self) -> int:
         raise NotImplementedError
@@ -140,6 +149,10 @@ class RDD(PairOpsMixin):
         return self
 
     def _do_checkpoint(self):
+        """Materialize every checkpoint-marked RDD in this lineage (walked
+        by the scheduler at job start, parents first)."""
+        for dep in self.get_dependencies():
+            dep.rdd._do_checkpoint()
         if self._checkpoint_dir is None or self._checkpointed_rdd is not None:
             return
         if getattr(self, "_checkpointing", False):

@@ -191,7 +191,7 @@ class DAGScheduler:
             cached = env.cache_tracker.get_cache_locs(rdd.rdd_id, partition)
             if cached:
                 return cached
-        splits = rdd.splits()
+        splits = rdd.cached_splits()
         if partition < len(splits):
             prefs = rdd.preferred_locations(splits[partition])
             if prefs:
@@ -220,9 +220,14 @@ class DAGScheduler:
         # Fast path: single-partition, no-parent final stage runs inline
         # (reference: base_scheduler.rs:25-42 local_execution).
         if not final_stage.parents and len(partitions) == 1:
-            split = rdd.splits()[partitions[0]]
-            tc = TaskContext(final_stage.id, split.index, 0)
-            result = func(tc, rdd.iterator(split, tc))
+            try:
+                split = rdd.cached_splits()[partitions[0]]
+                tc = TaskContext(final_stage.id, split.index, 0)
+                result = func(tc, rdd.iterator(split, tc))
+            except BaseException:
+                self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=False,
+                                        duration_s=time.time() - t_start))
+                raise
             if on_task_success is not None:
                 on_task_success(0, result)
             self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=True,
@@ -250,18 +255,20 @@ class DAGScheduler:
             pending = job.pending_tasks.setdefault(stage.id, set())
             tasks: List[Task] = []
             if stage is final_stage:
+                splits = rdd.cached_splits()
                 for out_id, p in enumerate(partitions):
                     if not job.finished[out_id]:
-                        split = rdd.splits()[p]
+                        split = splits[p]
                         tasks.append(ResultTask(
                             stage.id, rdd, func, p, split, out_id,
                             self._get_preferred_locs(rdd, p),
                             pinned=rdd.is_pinned,
                         ))
             else:
+                splits = stage.rdd.cached_splits()
                 for p in range(stage.num_partitions):
                     if not stage.output_locs[p]:
-                        split = stage.rdd.splits()[p]
+                        split = splits[p]
                         tasks.append(ShuffleMapTask(
                             stage.id, stage.rdd, stage.shuffle_dep, p, split,
                             self._get_preferred_locs(stage.rdd, p),
@@ -359,6 +366,7 @@ class DAGScheduler:
                 self.bus.post(ev.TaskEnd(
                     task_id=event.task.task_id, stage_id=event.task.stage_id,
                     partition=event.task.partition, success=event.success,
+                    duration_s=event.duration_s,
                 ))
                 if event.success:
                     on_success(event)

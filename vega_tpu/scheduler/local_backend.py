@@ -41,16 +41,21 @@ class LocalBackend(TaskBackend):
 
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         def run():
+            import time
+
+            t_start = time.time()
             try:
                 t = task
                 if self._serialize:
                     # Reference: local_scheduler.rs:345-351.
                     t = serialization.loads(serialization.dumps(task))
                 result = t.run()
-                callback(TaskEndEvent(task=task, success=True, result=result))
+                callback(TaskEndEvent(task=task, success=True, result=result,
+                                      duration_s=time.time() - t_start))
             except BaseException as exc:  # noqa: BLE001 — report, don't die
                 log.debug("task %s failed", task, exc_info=True)
-                callback(TaskEndEvent(task=task, success=False, error=exc))
+                callback(TaskEndEvent(task=task, success=False, error=exc,
+                                      duration_s=time.time() - t_start))
 
         self._pool.submit(run)
 

@@ -91,3 +91,4 @@ class TaskEndEvent:
     success: bool
     result: Any = None
     error: Optional[BaseException] = None
+    duration_s: float = 0.0

@@ -1,0 +1,147 @@
+"""Columnar partition blocks: the device tier's unit of data.
+
+A Block is the TPU-native replacement for the reference's per-partition item
+iterators (rdd/rdd.rs:179-183): named columns stored as one global array each,
+sharded row-wise over the mesh, plus a per-shard valid-row count. Static
+per-shard capacity keeps every shape XLA-compilable; raggedness lives in
+`counts`, never in shapes (SURVEY.md §7 hard part 1).
+
+Layout: each column is [n_shards * capacity, ...] sharded on axis 0; rows
+[s*capacity, s*capacity + counts[s]) are shard s's valid rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vega_tpu.tpu import mesh as mesh_lib
+
+KEY = "k"  # canonical key column
+VALUE = "v"  # canonical value column
+
+
+@dataclasses.dataclass
+class Block:
+    cols: Dict[str, jax.Array]  # each [n_shards * capacity, ...]
+    counts: jax.Array  # int32[n_shards], valid rows per shard
+    capacity: int  # per-shard row capacity (static)
+    mesh: object  # jax.sharding.Mesh
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.size
+
+    @property
+    def num_rows(self) -> int:
+        return int(np.sum(jax.device_get(self.counts)))
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.cols)
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        """Gather valid rows to host, shard order preserved."""
+        counts = np.asarray(jax.device_get(self.counts))
+        host_cols = {name: np.asarray(jax.device_get(col))
+                     for name, col in self.cols.items()}
+        out: Dict[str, List[np.ndarray]] = {n: [] for n in self.cols}
+        for s in range(self.n_shards):
+            lo = s * self.capacity
+            c = int(counts[s])
+            for name in self.cols:
+                out[name].append(host_cols[name][lo:lo + c])
+        return {n: np.concatenate(parts) if parts else np.empty((0,))
+                for n, parts in out.items()}
+
+    def shard_rows(self, shard: int) -> Dict[str, np.ndarray]:
+        counts = np.asarray(jax.device_get(self.counts))
+        lo = shard * self.capacity
+        c = int(counts[shard])
+        return {
+            name: np.asarray(jax.device_get(col[lo:lo + c]))
+            for name, col in self.cols.items()
+        }
+
+
+def _round_capacity(c: int) -> int:
+    """Round per-shard capacity up to the next power of two (>=128).
+
+    Lane-friendly (TPU tiling wants multiples of 128) AND shape-stable:
+    pow2 buckets mean different logical sizes hit the same compiled
+    program shapes, so the structural program cache (dense_rdd.py) and
+    XLA's jit cache stay hot across pipelines of similar scale."""
+    c = max(c, 128)
+    return 1 << (c - 1).bit_length()
+
+
+def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
+               capacity: Optional[int] = None) -> Block:
+    """Build a row-sharded Block from host columns (equal lengths)."""
+    mesh = mesh or mesh_lib.default_mesh()
+    n_shards = mesh.size
+    names = list(columns)
+    n = len(columns[names[0]]) if names else 0
+    per = -(-n // n_shards) if n else 0
+    cap = _round_capacity(capacity or max(per, 1))
+    counts = np.zeros(n_shards, dtype=np.int32)
+    cols = {}
+    for name in names:
+        src = np.asarray(columns[name])
+        dst = np.zeros((n_shards * cap,) + src.shape[1:], dtype=src.dtype)
+        for s in range(n_shards):
+            lo, hi = s * per, min((s + 1) * per, n)
+            c = max(0, hi - lo)
+            counts[s] = c
+            if c:
+                dst[s * cap:s * cap + c] = src[lo:hi]
+        cols[name] = jax.device_put(dst, mesh_lib.shard_spec(mesh))
+    counts_arr = jax.device_put(counts, mesh_lib.shard_spec(mesh))
+    return Block(cols=cols, counts=counts_arr, capacity=cap, mesh=mesh)
+
+
+def block_range(n: int, mesh=None, dtype=jnp.int32) -> Block:
+    """Lazy iota block: shard s holds [s*per, s*per+count_s) — the device
+    analogue of ctx.range (reference: context.rs:422-442), built on device
+    with no host materialization."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh or mesh_lib.default_mesh()
+    n_shards = mesh.size
+    per = -(-n // n_shards)
+    cap = _round_capacity(per)
+    counts_host = np.array(
+        [max(0, min(per, n - s * per)) for s in range(n_shards)],
+        dtype=np.int32,
+    )
+
+    def build(shard_id):
+        # shard_id: int32[1] per shard under shard_map
+        base = shard_id[0] * per
+        vals = base + jax.lax.iota(dtype, cap)
+        return vals
+
+    shard_ids = jax.device_put(
+        np.arange(n_shards, dtype=np.int32), mesh_lib.shard_spec(mesh)
+    )
+    build_sharded = jax.jit(
+        jax.shard_map(
+            build, mesh=mesh, in_specs=P(mesh_lib.SHARD_AXIS),
+            out_specs=P(mesh_lib.SHARD_AXIS),
+        )
+    )
+    vals = build_sharded(shard_ids)
+    counts = jax.device_put(counts_host, mesh_lib.shard_spec(mesh))
+    return Block(cols={VALUE: vals}, counts=counts, capacity=cap, mesh=mesh)
+
+
+def single_column(values, mesh=None) -> Block:
+    return from_numpy({VALUE: np.asarray(values)}, mesh)
+
+
+def pair_block(keys, values, mesh=None) -> Block:
+    return from_numpy({KEY: np.asarray(keys), VALUE: np.asarray(values)}, mesh)

@@ -1,0 +1,324 @@
+"""Shard-local device algorithms for the dense tier.
+
+These functions run *inside* jax.shard_map over the "shards" mesh axis: every
+array is the per-shard view ([capacity, ...] columns, int32[1] count). They
+replace the reference's shuffle planes with XLA-native equivalents
+(SURVEY.md §7):
+
+  reference map-side combine (dependency.rs:164-229)  -> bucket_by_hash + local segment pre-reduce
+  HTTP pull shuffle (shuffle_manager.rs/shuffle_fetcher.rs) -> lax.all_to_all over ICI
+  reduce-side merge (shuffled_rdd.rs:149-170)          -> sort + segment reduction
+  cogroup/join merge (co_grouped_rdd.rs:206-249)       -> sort-merge join
+
+Everything is static-shape: raggedness is (count, validity-mask), never a
+dynamic dimension (SURVEY.md §7 hard part 1). Capacity overflow is detected
+on device and surfaced as a flag the driver checks, then retries with a
+larger capacity (the moral equivalent of MoE capacity-factor overflow).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from vega_tpu.tpu.mesh import SHARD_AXIS
+
+Cols = Dict[str, jax.Array]
+
+# ---------------------------------------------------------------------------
+# hashing / masks / compaction
+# ---------------------------------------------------------------------------
+
+
+def hash32(col: jax.Array) -> jax.Array:
+    """lowbias32 finalizer over a column's bit pattern (device analogue of
+    partitioner.hash_key; 32-bit because TPUs have no native int64).
+
+    Bucket placement need not match the host tier bit-for-bit — only final
+    RDD *results* must match (BASELINE.md parity) — so the device tier uses
+    the cheapest good mixer."""
+    if col.dtype in (jnp.float32,):
+        x = lax.bitcast_convert_type(col, jnp.uint32)
+    elif col.dtype in (jnp.float64, jnp.int64, jnp.uint64):
+        x64 = lax.bitcast_convert_type(col.astype(jnp.float64), jnp.uint64) \
+            if jnp.issubdtype(col.dtype, jnp.floating) else col.astype(jnp.uint64)
+        x = (x64 ^ (x64 >> jnp.uint64(32))).astype(jnp.uint32)
+    else:
+        x = col.astype(jnp.uint32)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def valid_mask(capacity: int, count: jax.Array) -> jax.Array:
+    return lax.iota(jnp.int32, capacity) < count
+
+
+def compact(cols: Cols, keep: jax.Array, out_capacity: int) -> Tuple[Cols, jax.Array]:
+    """Move rows where keep=True to the front; returns (cols, new_count).
+    Stable (preserves row order), static-shape."""
+    order = jnp.argsort(~keep, stable=True)
+    idx = order[:out_capacity] if out_capacity <= keep.shape[0] else jnp.pad(
+        order, (0, out_capacity - keep.shape[0])
+    )
+    out = {n: jnp.take(c, idx, axis=0) for n, c in cols.items()}
+    return out, jnp.sum(keep).astype(jnp.int32)
+
+
+def gather_rows(cols: Cols, idx: jax.Array) -> Cols:
+    return {n: jnp.take(c, idx, axis=0) for n, c in cols.items()}
+
+
+# ---------------------------------------------------------------------------
+# exchange: the device shuffle
+# ---------------------------------------------------------------------------
+
+
+def bucket_exchange(
+    cols: Cols,
+    count: jax.Array,  # int32[] per-shard valid count
+    bucket: jax.Array,  # int32[capacity] target shard per row
+    n_shards: int,
+    slot_capacity: int,  # C: max rows this shard sends to any one target
+    out_capacity: int,  # per-shard capacity of the received block
+) -> Tuple[Cols, jax.Array, jax.Array]:
+    """All-to-all by bucket id. Returns (cols, new_count, overflow_flag).
+
+    Map side: stable-sort rows by target bucket, slice into n_shards slots of
+    slot_capacity rows each. Wire: one lax.all_to_all per column over ICI.
+    Reduce side: mask + compact received rows. This is the entire reference
+    shuffle data plane (SURVEY.md §2.5) as one fused XLA program."""
+    capacity = bucket.shape[0]
+    mask = valid_mask(capacity, count)
+    bucket = jnp.where(mask, bucket, n_shards)  # invalid rows -> ghost bucket
+
+    order = jnp.argsort(bucket, stable=True)
+    sorted_bucket = jnp.take(bucket, order)
+    sorted_cols = gather_rows(cols, order)
+
+    # rows per target + start offset of each target's run
+    counts_to = jnp.bincount(sorted_bucket, length=n_shards + 1)[:n_shards]
+    starts = jnp.searchsorted(sorted_bucket, jnp.arange(n_shards))
+    overflow_send = jnp.any(counts_to > slot_capacity)
+
+    # Build [n_shards, slot_capacity] send buffers per column.
+    slot_rows = starts[:, None] + jnp.arange(slot_capacity)[None, :]
+    slot_valid = jnp.arange(slot_capacity)[None, :] < counts_to[:, None]
+    slot_rows = jnp.clip(slot_rows, 0, capacity - 1)
+
+    send_counts = jnp.minimum(counts_to, slot_capacity).astype(jnp.int32)
+    recv_counts = lax.all_to_all(
+        send_counts, SHARD_AXIS, split_axis=0, concat_axis=0
+    )
+
+    received: Cols = {}
+    for name, col in sorted_cols.items():
+        buf = jnp.take(col, slot_rows, axis=0)  # [n_shards, C, ...]
+        zero = jnp.zeros((), dtype=col.dtype)
+        expand = slot_valid.reshape(slot_valid.shape + (1,) * (buf.ndim - 2))
+        buf = jnp.where(expand, buf, zero)
+        got = lax.all_to_all(buf, SHARD_AXIS, split_axis=0, concat_axis=0)
+        received[name] = got.reshape((n_shards * slot_capacity,) + got.shape[2:])
+
+    recv_valid = (
+        jnp.arange(slot_capacity)[None, :] < recv_counts[:, None]
+    ).reshape(-1)
+    new_count = jnp.sum(recv_counts).astype(jnp.int32)
+    overflow_recv = new_count > out_capacity
+    out_cols, _ = compact(received, recv_valid, out_capacity)
+    return out_cols, new_count, overflow_send | overflow_recv
+
+
+# ---------------------------------------------------------------------------
+# sorted-run segment operations (the reduce side)
+# ---------------------------------------------------------------------------
+
+
+def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
+                   descending: bool = False) -> Cols:
+    """Stable sort valid rows by one column; invalid rows sink to the end."""
+    key = cols[key_name]
+    capacity = key.shape[0]
+    mask = valid_mask(capacity, count)
+    if descending:
+        order = jnp.argsort(
+            jnp.where(mask, -_orderable(key), _orderable_max(key)), stable=True
+        )
+    else:
+        order = jnp.argsort(
+            jnp.where(mask, _orderable(key), _orderable_max(key)), stable=True
+        )
+    return gather_rows(cols, order)
+
+
+def _orderable(key: jax.Array) -> jax.Array:
+    """Map a column to an order-preserving integer/float domain."""
+    return key
+
+
+def _orderable_max(key: jax.Array):
+    if jnp.issubdtype(key.dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype=key.dtype)
+    return jnp.array(jnp.iinfo(key.dtype).max, dtype=key.dtype)
+
+
+def segment_reduce_sorted(
+    cols: Cols,
+    count: jax.Array,
+    key_name: str,
+    combine: Callable,  # (value_cols_a, value_cols_b) -> value_cols
+    presorted: bool = False,
+) -> Tuple[Cols, jax.Array]:
+    """Generic reduce_by_key over a shard: sort by key, then a segmented
+    associative scan with an arbitrary traceable combiner; the last row of
+    each segment carries the reduction. Returns compacted (cols, count).
+
+    This is reference hot loop 2 (shuffled_rdd.rs:154-164 merge_combiners
+    into a HashMap) recast as sort + scan so it vectorizes on the VPU instead
+    of chasing hash buckets."""
+    capacity = cols[key_name].shape[0]
+    if not presorted:
+        cols = sort_by_column(cols, count, key_name)
+    mask = valid_mask(capacity, count)
+    keys = cols[key_name]
+    first = jnp.concatenate([
+        jnp.ones((1,), jnp.bool_),
+        keys[1:] != keys[:-1],
+    ])
+    value_cols = {n: c for n, c in cols.items() if n != key_name}
+
+    def seg_combine(a, b):
+        va, fa = a
+        vb, fb = b
+        merged = combine(va, vb)
+        out = jax.tree.map(
+            lambda m, y: jnp.where(
+                fb.reshape(fb.shape + (1,) * (m.ndim - 1)), y, m
+            ),
+            merged, vb,
+        )
+        return out, fa | fb
+
+    scanned, _ = lax.associative_scan(seg_combine, (value_cols, first))
+    # Segment end = next row starts a new segment, or this is the last valid row.
+    idx = lax.iota(jnp.int32, capacity)
+    next_first = jnp.concatenate([first[1:], jnp.ones((1,), jnp.bool_)])
+    is_end = mask & (next_first | (idx == count - 1))
+    out = dict(scanned)
+    out[key_name] = keys
+    return compact(out, is_end, capacity)
+
+
+_FAST_SEGMENT_OPS = {
+    "add": jax.ops.segment_sum,
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+    "prod": jax.ops.segment_prod,
+}
+
+
+def segment_reduce_named(
+    cols: Cols, count: jax.Array, key_name: str, op: str,
+    presorted: bool = False,
+) -> Tuple[Cols, jax.Array]:
+    """Fast path for the common monoids via XLA segment ops."""
+    seg_op = _FAST_SEGMENT_OPS[op]
+    capacity = cols[key_name].shape[0]
+    if not presorted:
+        cols = sort_by_column(cols, count, key_name)
+    mask = valid_mask(capacity, count)
+    keys = cols[key_name]
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), keys[1:] != keys[:-1]]
+    ) & mask
+    seg_ids = jnp.cumsum(first.astype(jnp.int32)) - 1
+    seg_ids = jnp.where(mask, seg_ids, capacity - 1)
+    n_segments = jnp.sum(first).astype(jnp.int32)
+    out: Cols = {}
+    for name, col in cols.items():
+        if name == key_name:
+            continue
+        if op == "add" or op == "prod":
+            neutral = jnp.zeros((), col.dtype) if op == "add" else jnp.ones((), col.dtype)
+            masked = jnp.where(
+                mask.reshape(mask.shape + (1,) * (col.ndim - 1)), col, neutral
+            )
+        else:
+            masked = col
+        out[name] = seg_op(masked, seg_ids, num_segments=capacity)
+    # Key of segment i = key at the i-th segment start.
+    start_rows = jnp.nonzero(first, size=capacity, fill_value=capacity - 1)[0]
+    out[key_name] = jnp.take(keys, start_rows)
+    seg_valid = lax.iota(jnp.int32, capacity) < n_segments
+    comp, _ = compact(out, seg_valid, capacity)
+    return comp, n_segments
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+
+def merge_join_unique_right(
+    left: Cols, left_count: jax.Array,
+    right: Cols, right_count: jax.Array,
+    key_name: str,
+    out_capacity: int,
+) -> Tuple[Cols, jax.Array]:
+    """Inner join, right side must have unique keys (probe via binary search).
+    Output = every matching left row + the matched right value columns;
+    static shapes end-to-end (output <= left capacity).
+
+    The general dup x dup case routes through group-exchange + host (or the
+    device cogroup), matching the reference's CoGroupedRDD semantics."""
+    lcap = left[key_name].shape[0]
+    rcap = right[key_name].shape[0]
+    lmask = valid_mask(lcap, left_count)
+    right = sort_by_column(right, right_count, key_name)
+    rkeys = right[key_name]
+    rmask = valid_mask(rcap, right_count)
+    sentinel = _orderable_max(rkeys)
+    rkeys = jnp.where(rmask, rkeys, sentinel)
+    # Detect duplicate right keys: adjacent equal valid keys after the sort.
+    dup_right = jnp.any((rkeys[1:] == rkeys[:-1]) & rmask[1:] & rmask[:-1])
+
+    lkeys = left[key_name]
+    pos = jnp.searchsorted(rkeys, lkeys)
+    pos = jnp.clip(pos, 0, rcap - 1)
+    matched = lmask & (jnp.take(rkeys, pos) == lkeys) & (
+        pos < right_count
+    )
+    out = dict(left)
+    for name, col in right.items():
+        if name == key_name:
+            continue
+        out[f"r_{name}"] = jnp.take(col, pos, axis=0)
+    cols, count = compact(out, matched, out_capacity)
+    return cols, count, dup_right
+
+
+# ---------------------------------------------------------------------------
+# misc per-shard reductions
+# ---------------------------------------------------------------------------
+
+
+def masked_reduce(col: jax.Array, count: jax.Array, op: str) -> jax.Array:
+    mask = valid_mask(col.shape[0], count)
+    m = mask.reshape(mask.shape + (1,) * (col.ndim - 1))
+    if op == "add":
+        return jnp.sum(jnp.where(m, col, 0), axis=0)
+    if op == "min":
+        return jnp.min(jnp.where(m, col, _orderable_max(col)), axis=0)
+    if op == "max":
+        if jnp.issubdtype(col.dtype, jnp.floating):
+            lo = jnp.array(-jnp.inf, col.dtype)
+        else:
+            lo = jnp.array(jnp.iinfo(col.dtype).min, col.dtype)
+        return jnp.max(jnp.where(m, col, lo), axis=0)
+    raise ValueError(f"unknown reduction {op}")

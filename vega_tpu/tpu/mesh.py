@@ -1,0 +1,58 @@
+"""Device mesh management.
+
+The reference's parallel substrate is an executor fleet reached over TCP
+(SURVEY.md §2.5); vega_tpu's is a jax.sharding.Mesh. One axis, "shards",
+spans every addressable device: dense-RDD partitions map 1:1 onto mesh
+shards, shuffles ride all_to_all over ICI, and multi-host meshes come from
+jax.distributed (the DCN analogue of the reference's multi-host deployment,
+context.rs:209-303).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+_lock = threading.Lock()
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """Build a 1-D mesh over the first n devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def default_mesh() -> Mesh:
+    global _default_mesh
+    with _lock:
+        if _default_mesh is None:
+            _default_mesh = make_mesh()
+        return _default_mesh
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    with _lock:
+        _default_mesh = mesh
+
+
+def shard_spec(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the mesh axis (axis 0 of every column)."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -100,13 +100,9 @@ def compute_fraction_for_sample_size(size: int, total: int,
                                      with_replacement: bool) -> float:
     """Oversampling fraction so P(sample >= size) is high
     (reference: random.rs:318-358)."""
-    if with_replacement:
-        if size < 12:
-            return float(size) / total * (1.0 + 3.0)
-        frac = float(size) / total
-        delta = 1e-4
-        gamma = -math.log(delta) / total
-        return min(1.0, max(1e-10, frac + gamma + math.sqrt(gamma * gamma + 2 * gamma * frac)))
+    if with_replacement and size < 12:
+        # Small Poisson means need a larger multiplier (random.rs:322-330).
+        return float(size) / total * 4.0
     frac = float(size) / total
     delta = 1e-4
     gamma = -math.log(delta) / total
