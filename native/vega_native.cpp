@@ -1,0 +1,450 @@
+// vega_tpu native runtime: the host-tier shuffle hot loops in C++.
+//
+// The reference implements its entire runtime in native code (Rust); the
+// performance-critical pieces for the host tier are the map-side combine
+// loop (reference: src/dependency.rs:164-229 — per-element hash + bucket +
+// upsert) and the shuffle bucket serialization (bincode there). This module
+// implements both for the dominant numeric case:
+//
+//   bucket_reduce_pairs : hash-bucket + combine (i64 keys, i64|f64 values)
+//                         in one pass over a Python sequence of pairs
+//   bucket_pairs        : hash-bucket without combine (group_by path)
+//   merge_encoded       : reduce-side merge of encoded buckets
+//                         (reference: src/rdd/shuffled_rdd.rs:149-170)
+//   encode/decode_pairs : compact wire codec for packed rows — replaces
+//                         pickle for numeric shuffle buckets
+//   hash_i64            : splitmix64 over a raw int64 buffer, bit-identical
+//                         to vega_tpu.partitioner.splitmix64 (parity oracle)
+//
+// Integer values accumulate in int64 (exact); if accumulation overflows
+// int64 the bucket set demotes to double semantics (the same rounding the
+// float path has). Wire rows are 16 bytes: i64 key + 8 value bytes holding
+// either an f64 or an i64, selected by the bucket set's is_int flag.
+//
+// Built as a CPython extension (no pybind11 dependency); loaded lazily by
+// vega_tpu/native.py; every caller has a pure-Python fallback (including a
+// struct-based decoder for these frames), so absence of a compiler degrades
+// performance, not correctness.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMask = 0xFFFFFFFFFFFFFFFFull;
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+enum Op : int { OP_ADD = 0, OP_MIN = 1, OP_MAX = 2, OP_PROD = 3 };
+
+static inline double apply_op_d(int op, double a, double b) {
+  switch (op) {
+    case OP_ADD: return a + b;
+    case OP_MIN: return a < b ? a : b;
+    case OP_MAX: return a > b ? a : b;
+    case OP_PROD: return a * b;
+  }
+  return a;
+}
+
+// Int combine with overflow detection; returns false on overflow.
+static inline bool apply_op_i(int op, int64_t a, int64_t b, int64_t* out) {
+  switch (op) {
+    case OP_ADD: return !__builtin_add_overflow(a, b, out);
+    case OP_MIN: *out = a < b ? a : b; return true;
+    case OP_MAX: *out = a > b ? a : b; return true;
+    case OP_PROD: return !__builtin_mul_overflow(a, b, out);
+  }
+  *out = a;
+  return true;
+}
+
+// Dual accumulator: doubles always, int64 exactly while it stays exact.
+struct Acc {
+  double d;
+  int64_t i;
+};
+
+struct Row {
+  int64_t key;
+  int64_t bits;  // f64 or i64 payload, per the frame's is_int flag
+};
+
+static inline int64_t d2bits(double d) {
+  int64_t b;
+  std::memcpy(&b, &d, 8);
+  return b;
+}
+
+static inline double bits2d(int64_t b) {
+  double d;
+  std::memcpy(&d, &b, 8);
+  return d;
+}
+
+// ---- helpers ---------------------------------------------------------------
+
+// Extract (i64 key, value) from one pair. Returns false when the pair is not
+// numeric (caller falls back to Python; a pending Python error means a real
+// failure).
+static inline bool extract_pair(PyObject* item, int64_t* key, double* d,
+                                int64_t* i, bool* value_is_int) {
+  if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) return false;
+  PyObject* k = PyTuple_GET_ITEM(item, 0);
+  PyObject* v = PyTuple_GET_ITEM(item, 1);
+  if (!PyLong_CheckExact(k)) return false;
+  int overflow = 0;
+  *key = PyLong_AsLongLongAndOverflow(k, &overflow);
+  if (overflow != 0) return false;
+  if (PyFloat_CheckExact(v)) {
+    *d = PyFloat_AS_DOUBLE(v);
+    *i = 0;
+    *value_is_int = false;
+    return true;
+  }
+  if (PyLong_CheckExact(v)) {
+    int64_t lv = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow != 0) return false;
+    *d = static_cast<double>(lv);
+    *i = lv;
+    *value_is_int = true;
+    return true;
+  }
+  return false;
+}
+
+static PyObject* rows_to_bytes(const std::vector<Row>& rows) {
+  PyObject* out = PyBytes_FromStringAndSize(
+      nullptr, static_cast<Py_ssize_t>(rows.size() * sizeof(Row)));
+  if (out == nullptr) return nullptr;
+  std::memcpy(PyBytes_AS_STRING(out), rows.data(), rows.size() * sizeof(Row));
+  return out;
+}
+
+static PyObject* pair_list_from_accs(
+    const std::unordered_map<int64_t, Acc>& combined, bool as_int) {
+  PyObject* out = PyList_New(static_cast<Py_ssize_t>(combined.size()));
+  if (out == nullptr) return nullptr;
+  Py_ssize_t idx = 0;
+  for (const auto& kv : combined) {
+    PyObject* key = PyLong_FromLongLong(kv.first);
+    PyObject* value = as_int ? PyLong_FromLongLong(kv.second.i)
+                             : PyFloat_FromDouble(kv.second.d);
+    if (key == nullptr || value == nullptr) {
+      Py_XDECREF(key);
+      Py_XDECREF(value);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject* pair = PyTuple_Pack(2, key, value);
+    Py_DECREF(key);
+    Py_DECREF(value);
+    if (pair == nullptr) { Py_DECREF(out); return nullptr; }
+    PyList_SET_ITEM(out, idx++, pair);
+  }
+  return out;
+}
+
+// ---- module functions ------------------------------------------------------
+
+// bucket_reduce_pairs(iterable, n_buckets, op) -> (list[bytes], is_int) | None
+static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
+  PyObject* iterable;
+  Py_ssize_t n_buckets;
+  int op;
+  if (!PyArg_ParseTuple(args, "Oni", &iterable, &n_buckets, &op)) return nullptr;
+  if (n_buckets <= 0) {
+    PyErr_SetString(PyExc_ValueError, "n_buckets must be positive");
+    return nullptr;
+  }
+
+  std::vector<std::unordered_map<int64_t, Acc>> buckets(
+      static_cast<size_t>(n_buckets));
+  PyObject* iter = PyObject_GetIter(iterable);
+  if (iter == nullptr) return nullptr;
+
+  bool all_int = true;
+  PyObject* item;
+  while ((item = PyIter_Next(iter)) != nullptr) {
+    int64_t key;
+    double dv;
+    int64_t iv;
+    bool value_is_int;
+    if (!extract_pair(item, &key, &dv, &iv, &value_is_int)) {
+      Py_DECREF(item);
+      Py_DECREF(iter);
+      if (PyErr_Occurred()) return nullptr;
+      Py_RETURN_NONE;  // not numeric -> caller uses the Python path
+    }
+    Py_DECREF(item);
+    all_int = all_int && value_is_int;
+    uint64_t h = splitmix64(static_cast<uint64_t>(key) & kMask);
+    auto& bucket = buckets[h % static_cast<uint64_t>(n_buckets)];
+    auto it = bucket.find(key);
+    if (it == bucket.end()) {
+      bucket.emplace(key, Acc{dv, iv});
+    } else {
+      it->second.d = apply_op_d(op, it->second.d, dv);
+      if (all_int && !apply_op_i(op, it->second.i, iv, &it->second.i)) {
+        all_int = false;  // int64 overflow -> double semantics
+      }
+    }
+  }
+  Py_DECREF(iter);
+  if (PyErr_Occurred()) return nullptr;
+
+  PyObject* result = PyList_New(n_buckets);
+  if (result == nullptr) return nullptr;
+  std::vector<Row> rows;
+  for (Py_ssize_t b = 0; b < n_buckets; ++b) {
+    rows.clear();
+    rows.reserve(buckets[b].size());
+    for (const auto& kv : buckets[b]) {
+      rows.push_back({kv.first,
+                      all_int ? kv.second.i : d2bits(kv.second.d)});
+    }
+    PyObject* blob = rows_to_bytes(rows);
+    if (blob == nullptr) { Py_DECREF(result); return nullptr; }
+    PyList_SET_ITEM(result, b, blob);
+  }
+  PyObject* out = Py_BuildValue("(Oi)", result, all_int ? 1 : 0);
+  Py_DECREF(result);
+  return out;
+}
+
+// bucket_pairs(iterable, n_buckets) -> (list[bytes], is_int) | None
+static PyObject* bucket_pairs(PyObject*, PyObject* args) {
+  PyObject* iterable;
+  Py_ssize_t n_buckets;
+  if (!PyArg_ParseTuple(args, "On", &iterable, &n_buckets)) return nullptr;
+  if (n_buckets <= 0) {
+    PyErr_SetString(PyExc_ValueError, "n_buckets must be positive");
+    return nullptr;
+  }
+  std::vector<std::vector<Acc>> vals(static_cast<size_t>(n_buckets));
+  std::vector<std::vector<int64_t>> keys(static_cast<size_t>(n_buckets));
+  PyObject* iter = PyObject_GetIter(iterable);
+  if (iter == nullptr) return nullptr;
+  bool all_int = true;
+  PyObject* item;
+  while ((item = PyIter_Next(iter)) != nullptr) {
+    int64_t key;
+    double dv;
+    int64_t iv;
+    bool value_is_int;
+    if (!extract_pair(item, &key, &dv, &iv, &value_is_int)) {
+      Py_DECREF(item);
+      Py_DECREF(iter);
+      if (PyErr_Occurred()) return nullptr;
+      Py_RETURN_NONE;
+    }
+    Py_DECREF(item);
+    all_int = all_int && value_is_int;
+    uint64_t h = splitmix64(static_cast<uint64_t>(key) & kMask);
+    size_t b = h % static_cast<uint64_t>(n_buckets);
+    keys[b].push_back(key);
+    vals[b].push_back({dv, iv});
+  }
+  Py_DECREF(iter);
+  if (PyErr_Occurred()) return nullptr;
+
+  PyObject* result = PyList_New(n_buckets);
+  if (result == nullptr) return nullptr;
+  std::vector<Row> rows;
+  for (Py_ssize_t b = 0; b < n_buckets; ++b) {
+    rows.clear();
+    rows.reserve(keys[b].size());
+    for (size_t r = 0; r < keys[b].size(); ++r) {
+      rows.push_back({keys[b][r],
+                      all_int ? vals[b][r].i : d2bits(vals[b][r].d)});
+    }
+    PyObject* blob = rows_to_bytes(rows);
+    if (blob == nullptr) { Py_DECREF(result); return nullptr; }
+    PyList_SET_ITEM(result, b, blob);
+  }
+  PyObject* out = Py_BuildValue("(Oi)", result, all_int ? 1 : 0);
+  Py_DECREF(result);
+  return out;
+}
+
+// merge_encoded(list[(bytes, is_int)], op) -> list[(int, float|int)]
+// Reduce-side merge across buckets with per-blob value typing; the result is
+// int-typed iff every input blob was int-typed and no combine overflowed.
+static PyObject* merge_encoded(PyObject*, PyObject* args) {
+  PyObject* blobs;
+  int op;
+  if (!PyArg_ParseTuple(args, "Oi", &blobs, &op)) return nullptr;
+  PyObject* seq = PySequence_Fast(blobs, "expected a sequence of (bytes, int)");
+  if (seq == nullptr) return nullptr;
+
+  std::unordered_map<int64_t, Acc> combined;
+  bool all_int = true;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t idx = 0; idx < n; ++idx) {
+    PyObject* entry = PySequence_Fast_GET_ITEM(seq, idx);
+    PyObject* blob;
+    int blob_is_int;
+    if (!PyArg_ParseTuple(entry, "Oi", &blob, &blob_is_int)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    char* data;
+    Py_ssize_t size;
+    if (PyBytes_AsStringAndSize(blob, &data, &size) < 0) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    all_int = all_int && (blob_is_int != 0);
+    size_t count = static_cast<size_t>(size) / sizeof(Row);
+    const Row* rows = reinterpret_cast<const Row*>(data);
+    for (size_t r = 0; r < count; ++r) {
+      double dv = blob_is_int ? static_cast<double>(rows[r].bits)
+                              : bits2d(rows[r].bits);
+      int64_t iv = blob_is_int ? rows[r].bits : 0;
+      auto it = combined.find(rows[r].key);
+      if (it == combined.end()) {
+        combined.emplace(rows[r].key, Acc{dv, iv});
+      } else {
+        it->second.d = apply_op_d(op, it->second.d, dv);
+        if (all_int && !apply_op_i(op, it->second.i, iv, &it->second.i)) {
+          all_int = false;
+        }
+      }
+    }
+  }
+  Py_DECREF(seq);
+  return pair_list_from_accs(combined, all_int);
+}
+
+// decode_pairs(bytes, is_int) -> list[(int, float|int)]
+static PyObject* decode_pairs(PyObject*, PyObject* args) {
+  PyObject* blob;
+  int is_int;
+  if (!PyArg_ParseTuple(args, "Op", &blob, &is_int)) return nullptr;
+  char* data;
+  Py_ssize_t size;
+  if (PyBytes_AsStringAndSize(blob, &data, &size) < 0) return nullptr;
+  size_t count = static_cast<size_t>(size) / sizeof(Row);
+  const Row* rows = reinterpret_cast<const Row*>(data);
+  PyObject* out = PyList_New(static_cast<Py_ssize_t>(count));
+  if (out == nullptr) return nullptr;
+  for (size_t r = 0; r < count; ++r) {
+    PyObject* key = PyLong_FromLongLong(rows[r].key);
+    PyObject* value = is_int ? PyLong_FromLongLong(rows[r].bits)
+                             : PyFloat_FromDouble(bits2d(rows[r].bits));
+    if (key == nullptr || value == nullptr) {
+      Py_XDECREF(key);
+      Py_XDECREF(value);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject* pair = PyTuple_Pack(2, key, value);
+    Py_DECREF(key);
+    Py_DECREF(value);
+    if (pair == nullptr) { Py_DECREF(out); return nullptr; }
+    PyList_SET_ITEM(out, static_cast<Py_ssize_t>(r), pair);
+  }
+  return out;
+}
+
+// encode_pairs(iterable) -> (bytes, is_int) | None
+static PyObject* encode_pairs(PyObject*, PyObject* args) {
+  PyObject* iterable;
+  if (!PyArg_ParseTuple(args, "O", &iterable)) return nullptr;
+  PyObject* iter = PyObject_GetIter(iterable);
+  if (iter == nullptr) return nullptr;
+  std::vector<int64_t> ks;
+  std::vector<Acc> vs;
+  bool all_int = true;
+  PyObject* item;
+  while ((item = PyIter_Next(iter)) != nullptr) {
+    int64_t key;
+    double dv;
+    int64_t iv;
+    bool value_is_int;
+    if (!extract_pair(item, &key, &dv, &iv, &value_is_int)) {
+      Py_DECREF(item);
+      Py_DECREF(iter);
+      if (PyErr_Occurred()) return nullptr;
+      Py_RETURN_NONE;
+    }
+    Py_DECREF(item);
+    all_int = all_int && value_is_int;
+    ks.push_back(key);
+    vs.push_back({dv, iv});
+  }
+  Py_DECREF(iter);
+  if (PyErr_Occurred()) return nullptr;
+  std::vector<Row> rows;
+  rows.reserve(ks.size());
+  for (size_t r = 0; r < ks.size(); ++r) {
+    rows.push_back({ks[r], all_int ? vs[r].i : d2bits(vs[r].d)});
+  }
+  PyObject* blob = rows_to_bytes(rows);
+  if (blob == nullptr) return nullptr;
+  PyObject* out = Py_BuildValue("(Oi)", blob, all_int ? 1 : 0);
+  Py_DECREF(blob);
+  return out;
+}
+
+// hash_i64(buffer, n_buckets) -> bytes (int64 bucket ids, same length)
+static PyObject* hash_i64(PyObject*, PyObject* args) {
+  Py_buffer view;
+  Py_ssize_t n_buckets;
+  if (!PyArg_ParseTuple(args, "y*n", &view, &n_buckets)) return nullptr;
+  if (n_buckets <= 0 || view.len % 8 != 0) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "need int64 buffer and n_buckets > 0");
+    return nullptr;
+  }
+  size_t n = static_cast<size_t>(view.len) / 8;
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, view.len);
+  if (out == nullptr) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  const int64_t* keys = static_cast<const int64_t*>(view.buf);
+  int64_t* dst = reinterpret_cast<int64_t*>(PyBytes_AS_STRING(out));
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = static_cast<int64_t>(
+        splitmix64(static_cast<uint64_t>(keys[i])) %
+        static_cast<uint64_t>(n_buckets));
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+static PyMethodDef kMethods[] = {
+    {"bucket_reduce_pairs", bucket_reduce_pairs, METH_VARARGS,
+     "One-pass hash-bucket + combine over (int, number) pairs."},
+    {"bucket_pairs", bucket_pairs, METH_VARARGS,
+     "Hash-bucket (int, number) pairs without combining."},
+    {"merge_encoded", merge_encoded, METH_VARARGS,
+     "Merge encoded (bytes, is_int) buckets with a named op."},
+    {"decode_pairs", decode_pairs, METH_VARARGS,
+     "Decode packed rows to a list of pairs."},
+    {"encode_pairs", encode_pairs, METH_VARARGS,
+     "Encode (int, number) pairs to packed rows."},
+    {"hash_i64", hash_i64, METH_VARARGS,
+     "splitmix64 % n_buckets over an int64 buffer."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_vega_native",
+    "vega_tpu native shuffle hot loops", -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__vega_native(void) { return PyModule_Create(&kModule); }

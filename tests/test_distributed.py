@@ -1,0 +1,79 @@
+"""Multi-process distributed-mode tests: real driver/executor processes over
+TCP, real cross-process shuffle fetches.
+
+The reference has NO automated distributed tests (SURVEY.md §4 — only a
+manual docker-compose cluster); these tests are the automated equivalent:
+every job here crosses process boundaries through the full task protocol
+(backend dispatch -> worker TCP -> shuffle server fetch -> tracker RPC).
+"""
+
+import time
+
+import pytest
+
+import vega_tpu as v
+from vega_tpu.errors import TaskError
+
+
+@pytest.fixture(scope="module")
+def dist_ctx():
+    context = v.Context("distributed", num_workers=2)
+    yield context
+    context.stop()
+
+
+def test_narrow_job(dist_ctx):
+    rdd = dist_ctx.parallelize(list(range(100)), 4).map(lambda x: x * 2)
+    assert sum(rdd.collect()) == 9900
+
+
+def test_shuffle_job(dist_ctx):
+    pairs = dist_ctx.parallelize([(i % 5, i) for i in range(100)], 4)
+    result = dict(pairs.reduce_by_key(lambda a, b: a + b, 3).collect())
+    expected = {}
+    for i in range(100):
+        expected[i % 5] = expected.get(i % 5, 0) + i
+    assert result == expected
+
+
+def test_join_across_processes(dist_ctx):
+    a = dist_ctx.parallelize([(1, "a"), (2, "b"), (3, "c")], 2)
+    b = dist_ctx.parallelize([(1, "x"), (2, "y")], 2)
+    assert sorted(a.join(b).collect()) == [(1, ("a", "x")), (2, ("b", "y"))]
+
+
+def test_remote_task_error_carries_traceback(dist_ctx):
+    def boom(x):
+        raise ValueError(f"bad item {x}")
+
+    with pytest.raises(TaskError) as excinfo:
+        dist_ctx.parallelize([1, 2, 3], 2).map(boom).collect()
+    assert "bad item" in str(excinfo.value.__cause__ or excinfo.value)
+
+
+def test_broadcast_across_processes(dist_ctx):
+    table = dist_ctx.broadcast({i: i * i for i in range(50)})
+    result = dist_ctx.parallelize(list(range(10)), 2).map(
+        lambda x: table.value[x]
+    ).collect()
+    assert result == [i * i for i in range(10)]
+
+
+def test_executor_loss_recovery(dist_ctx):
+    """Kill an executor whose shuffle outputs are registered; the next job
+    over the same shuffle must fetch-fail, resubmit the map stage on the
+    survivor, and still produce correct results (the recovery path the
+    reference never exercises — SURVEY.md §5)."""
+    pairs = dist_ctx.parallelize([(i % 4, 1) for i in range(40)], 4)
+    shuffled = pairs.reduce_by_key(lambda a, b: a + b, 4)
+    assert dict(shuffled.collect()) == {0: 10, 1: 10, 2: 10, 3: 10}
+
+    backend = dist_ctx._backend
+    victim = next(iter(backend._executors.values()))
+    victim.process.kill()
+    victim.process.wait()
+    time.sleep(0.2)
+
+    assert dict(shuffled.collect()) == {0: 10, 1: 10, 2: 10, 3: 10}
+    # fresh work still schedules on the survivor
+    assert dist_ctx.parallelize(list(range(20)), 4).map(lambda x: x + 1).count() == 20

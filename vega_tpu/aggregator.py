@@ -15,17 +15,22 @@ C = TypeVar("C")
 
 
 class Aggregator(Generic[K, V, C]):
-    __slots__ = ("create_combiner", "merge_value", "merge_combiners")
+    __slots__ = ("create_combiner", "merge_value", "merge_combiners", "op_name")
 
     def __init__(
         self,
         create_combiner: Callable[[V], C],
         merge_value: Callable[[C, V], C],
         merge_combiners: Callable[[C, C], C],
+        op_name: str | None = None,
     ):
         self.create_combiner = create_combiner
         self.merge_value = merge_value
         self.merge_combiners = merge_combiners
+        # Recognized monoid ('add'/'min'/'max'/'prod'): unlocks the native
+        # C++ bucket-combine (vega_tpu/native.py) and the device tier's
+        # segment fast path. None means "opaque closure".
+        self.op_name = op_name
 
     @staticmethod
     def default() -> "Aggregator":

@@ -21,6 +21,19 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("vega_tpu")
 
+# Frame tag for natively-encoded shuffle buckets (packed 16-byte rows +
+# value-int flag); anything else in the store is a pickled list of pairs.
+NATIVE_MAGIC = b"VN01"
+
+_SENTINEL = object()
+
+
+def _is_numeric_pair(item) -> bool:
+    return (
+        type(item) is tuple and len(item) == 2
+        and type(item[0]) is int and type(item[1]) in (int, float)
+    )
+
 
 class Dependency:
     __slots__ = ("rdd",)
@@ -115,12 +128,56 @@ class ShuffleDependency(Dependency):
         env = Env.get()
         n_out = self.partitioner.num_partitions
         agg = self.aggregator
+
+        # Native fast path: recognized monoid + hash partitioning -> the C++
+        # one-pass bucket-combine over numeric pairs (native/vega_native.cpp;
+        # the splitmix64 bucketing is bit-identical to HashPartitioner).
+        from vega_tpu.partitioner import HashPartitioner
+
+        source = None
+        if agg.op_name is not None and type(self.partitioner) is HashPartitioner:
+            from vega_tpu import native
+
+            nat = native.get()
+            if nat is not None:
+                # Probe the first element in Python so a clearly non-numeric
+                # partition skips the native attempt without consuming the
+                # iterator; a partition that *starts* numeric but turns mixed
+                # mid-stream is recomputed below (rare; partition compute is
+                # deterministic by contract — same as lineage recompute).
+                import itertools as _it
+
+                it = self.rdd.iterator(split, task_context)
+                first = next(it, _SENTINEL)
+                if first is _SENTINEL:
+                    source = iter(())
+                elif _is_numeric_pair(first):
+                    result = nat.bucket_reduce_pairs(
+                        _it.chain([first], it), n_out,
+                        native.OP_BY_NAME[agg.op_name],
+                    )
+                    if result is not None:
+                        blobs, all_int = result
+                        flag = b"\x01" if all_int else b"\x00"
+                        for reduce_id, blob in enumerate(blobs):
+                            env.shuffle_store.put(
+                                self.shuffle_id, split.index, reduce_id,
+                                NATIVE_MAGIC + flag + blob,
+                            )
+                        return (env.shuffle_server.uri
+                                if env.shuffle_server else "local")
+                    source = self.rdd.iterator(split, task_context)  # mixed
+                else:
+                    source = _it.chain([first], it)
+
+        if source is None:
+            source = self.rdd.iterator(split, task_context)
         get_partition = self.partitioner.get_partition
         create = agg.create_combiner
         merge = agg.merge_value
 
         buckets = [dict() for _ in range(n_out)]
-        for k, v in self.rdd.iterator(split, task_context):
+        for k, v in source:
             bucket = buckets[get_partition(k)]
             if k in bucket:
                 bucket[k] = merge(bucket[k], v)

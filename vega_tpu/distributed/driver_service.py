@@ -1,0 +1,165 @@
+"""Driver-hosted control-plane service: tracker RPC + worker registration.
+
+Reference: the driver hosts two TCP services — MapOutputTracker
+(src/map_output_tracker.rs:95-166) and CacheTracker (src/cache_tracker.rs:141-182)
+— which clients poll with 1ms-sleep busy-wait loops (:122-132). vega_tpu
+serves both trackers (plus registration/heartbeat, which the reference lacks)
+from one framed-TCP service, and blocking queries wait on the driver-side
+condition variable instead of polling.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, Optional
+
+from vega_tpu.cache_tracker import CacheTracker
+from vega_tpu.distributed import protocol
+from vega_tpu.errors import NetworkError
+from vega_tpu.map_output_tracker import MapOutputTracker
+
+log = logging.getLogger("vega_tpu")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        svc: DriverService = self.server.service  # type: ignore[attr-defined]
+        try:
+            while True:
+                msg_type, payload = protocol.recv_msg(sock)
+                try:
+                    reply = svc.dispatch(msg_type, payload)
+                    protocol.send_msg(sock, "ok", reply)
+                except Exception as e:  # noqa: BLE001 — report to client
+                    log.exception("driver service error on %s", msg_type)
+                    protocol.send_msg(sock, "error", repr(e))
+        except NetworkError:
+            pass
+
+
+class DriverService:
+    """RPC facade over the driver's in-process trackers."""
+
+    def __init__(self, map_output_tracker: MapOutputTracker,
+                 cache_tracker: CacheTracker,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.map_output_tracker = map_output_tracker
+        self.cache_tracker = cache_tracker
+        self.workers: Dict[str, dict] = {}  # executor_id -> info
+        self._lock = threading.Lock()
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server.service = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="driver-service", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def uri(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def dispatch(self, msg_type: str, payload):
+        if msg_type == "register_worker":
+            with self._lock:
+                self.workers[payload["executor_id"]] = dict(
+                    payload, last_seen=time.time()
+                )
+            log.info("worker registered: %s", payload["executor_id"])
+            return True
+        if msg_type == "heartbeat":
+            with self._lock:
+                info = self.workers.get(payload)
+                if info is not None:
+                    info["last_seen"] = time.time()
+            return True
+        if msg_type == "get_server_uris":
+            shuffle_id, timeout = payload
+            return self.map_output_tracker.get_server_uris(shuffle_id, timeout)
+        if msg_type == "has_outputs":
+            return self.map_output_tracker.has_outputs(payload)
+        if msg_type == "generation":
+            return self.map_output_tracker.generation
+        if msg_type == "cache_add_host":
+            rdd_id, partition, host = payload
+            self.cache_tracker.add_host(rdd_id, partition, host)
+            return True
+        if msg_type == "cache_get_locs":
+            rdd_id, partition = payload
+            return self.cache_tracker.get_cache_locs(rdd_id, partition)
+        raise ValueError(f"unknown message type: {msg_type}")
+
+    def live_workers(self, max_age: float = 30.0) -> Dict[str, dict]:
+        now = time.time()
+        with self._lock:
+            return {
+                wid: info for wid, info in self.workers.items()
+                if now - info["last_seen"] < max_age
+            }
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteTrackerClient:
+    """Worker-side MapOutputTracker facade: blocking RPC to the driver
+    (replaces the reference's 1ms busy-wait client,
+    map_output_tracker.rs:68-93,227-244)."""
+
+    def __init__(self, driver_uri: str):
+        self.driver_host, self.driver_port = protocol.parse_uri(driver_uri)
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            sock = protocol.connect(self.driver_host, self.driver_port)
+            self._local.sock = sock
+        return sock
+
+    def _call(self, msg_type: str, payload=None):
+        try:
+            sock = self._sock()
+            protocol.send_msg(sock, msg_type, payload)
+            reply_type, reply = protocol.recv_msg(sock)
+        except NetworkError:
+            self._local.sock = None
+            raise
+        if reply_type == "error":
+            raise NetworkError(f"driver error for {msg_type}: {reply}")
+        return reply
+
+    # MapOutputTracker interface used by ShuffleFetcher
+    def get_server_uris(self, shuffle_id: int, timeout: float = 60.0):
+        return self._call("get_server_uris", (shuffle_id, timeout))
+
+    def has_outputs(self, shuffle_id: int) -> bool:
+        return self._call("has_outputs", shuffle_id)
+
+    @property
+    def generation(self) -> int:
+        return self._call("generation")
+
+    # CacheTracker subset used by get_or_compute on workers
+    def add_host(self, rdd_id: int, partition: int, host: str) -> None:
+        self._call("cache_add_host", (rdd_id, partition, host))
+
+    def get_cache_locs(self, rdd_id: int, partition: int):
+        return self._call("cache_get_locs", (rdd_id, partition))
+
+    def register_worker(self, info: dict) -> None:
+        self._call("register_worker", info)
+
+    def heartbeat(self, executor_id: str) -> None:
+        self._call("heartbeat", executor_id)

@@ -1,0 +1,94 @@
+"""Length-framed message protocol for the control/data planes.
+
+Reference: every reference message is bincode bytes wrapped in a one-field
+Cap'n Proto struct for length framing (src/capnp/serialized_data.capnp:1-5,
+SURVEY.md §2.5). vega_tpu frames with an 8-byte little-endian length prefix
+(vega_tpu/serialization.py) and pickles the payload; the native C++ framing
+(native/) accelerates bulk shuffle payloads.
+
+Message shape: (msg_type: str, payload) tuples, request/response per
+connection round.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Optional, Tuple
+
+from vega_tpu import serialization
+from vega_tpu.errors import NetworkError
+
+CONNECT_TIMEOUT = 10.0
+IO_TIMEOUT = 120.0
+
+
+def connect(host: str, port: int, timeout: float = CONNECT_TIMEOUT) -> socket.socket:
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(IO_TIMEOUT)
+        # Latency matters for small control messages.
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+    except OSError as e:
+        raise NetworkError(f"connect to {host}:{port} failed: {e}") from e
+
+
+class _SockStream:
+    """Adapts a socket to the read/write interface the framing helpers use."""
+
+    __slots__ = ("sock",)
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def read(self, n: int) -> bytes:
+        try:
+            return self.sock.recv(min(n, 1 << 20))
+        except OSError as e:
+            raise NetworkError(f"socket read failed: {e}") from e
+
+    def write(self, data: bytes) -> int:
+        try:
+            self.sock.sendall(data)
+            return len(data)
+        except OSError as e:
+            raise NetworkError(f"socket write failed: {e}") from e
+
+
+def send_msg(sock: socket.socket, msg_type: str, payload: Any = None) -> None:
+    serialization.write_frame(_SockStream(sock), serialization.dumps((msg_type, payload)))
+
+
+def recv_msg(sock: socket.socket) -> Tuple[str, Any]:
+    try:
+        data = serialization.read_frame(_SockStream(sock))
+    except EOFError as e:
+        raise NetworkError("connection closed mid-message") from e
+    return serialization.loads(data)
+
+
+def send_bytes(sock: socket.socket, data: bytes) -> None:
+    serialization.write_frame(_SockStream(sock), data)
+
+
+def recv_bytes(sock: socket.socket) -> bytes:
+    try:
+        return serialization.read_frame(_SockStream(sock))
+    except EOFError as e:
+        raise NetworkError("connection closed mid-message") from e
+
+
+def request(host: str, port: int, msg_type: str, payload: Any = None,
+            timeout: float = CONNECT_TIMEOUT) -> Any:
+    """One-shot request/response round."""
+    with connect(host, port, timeout) as sock:
+        send_msg(sock, msg_type, payload)
+        reply_type, reply = recv_msg(sock)
+        if reply_type == "error":
+            raise NetworkError(f"remote error for {msg_type}: {reply}")
+        return reply
+
+
+def parse_uri(uri: str) -> Tuple[str, int]:
+    host, _, port = uri.rpartition(":")
+    return host, int(port)

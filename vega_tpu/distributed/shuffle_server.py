@@ -1,0 +1,128 @@
+"""Per-executor shuffle data server (pull-based).
+
+Reference: a hyper HTTP/2 server per process serving
+GET /shuffle/{shuffle_id}/{input_id}/{reduce_id} from the in-memory cache
+plus a /status healthcheck (src/shuffle/shuffle_manager.rs:169-251).
+
+vega_tpu serves the same keying over the framed-TCP protocol instead of
+HTTP — one round trip, zero header overhead, and the payload path stays
+zero-copy (bytes in, bytes out of the ShuffleStore). A `status` message
+doubles as the healthcheck (shuffle_manager.rs:34-52's status checker).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from vega_tpu.distributed import protocol
+from vega_tpu.errors import FetchFailedError, NetworkError
+
+log = logging.getLogger("vega_tpu")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        store = self.server.shuffle_store  # type: ignore[attr-defined]
+        try:
+            while True:
+                msg_type, payload = protocol.recv_msg(sock)
+                if msg_type == "get":
+                    shuffle_id, map_id, reduce_id = payload
+                    data = store.get(shuffle_id, map_id, reduce_id)
+                    if data is None:
+                        protocol.send_msg(sock, "missing", payload)
+                    else:
+                        protocol.send_msg(sock, "ok", None)
+                        protocol.send_bytes(sock, data)
+                elif msg_type == "status":
+                    protocol.send_msg(sock, "ok", {"entries": len(store)})
+                else:
+                    protocol.send_msg(sock, "error", f"unknown {msg_type}")
+                    return
+        except NetworkError:
+            pass  # client hung up — per-connection loop ends
+
+
+class ShuffleServer:
+    def __init__(self, shuffle_store, host: str = "127.0.0.1", port: int = 0):
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server.shuffle_store = shuffle_store  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="shuffle-server", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def uri(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# Per-process connection pool: reduce tasks fetch many buckets from the same
+# server; reuse one socket per (thread, server) instead of reconnecting
+# (the reference reconnects per HTTP request batch, shuffle_fetcher.rs:55-100).
+_pool = threading.local()
+
+
+def _pooled_connection(uri: str) -> socket.socket:
+    conns = getattr(_pool, "conns", None)
+    if conns is None:
+        conns = _pool.conns = {}
+    sock = conns.get(uri)
+    if sock is None:
+        host, port = protocol.parse_uri(uri)
+        sock = protocol.connect(host, port)
+        conns[uri] = sock
+    return sock
+
+
+def _drop_connection(uri: str) -> None:
+    conns = getattr(_pool, "conns", {})
+    sock = conns.pop(uri, None)
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def fetch_remote(uri: str, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
+    """Fetch one bucket; raises FetchFailedError so the DAG scheduler can
+    run its recovery path (unlike the reference, where a failed fetch
+    panics the event loop — SURVEY.md §5)."""
+    key = (shuffle_id, map_id, reduce_id)
+    try:
+        sock = _pooled_connection(uri)
+        protocol.send_msg(sock, "get", key)
+        reply_type, _ = protocol.recv_msg(sock)
+        if reply_type == "missing":
+            _drop_connection(uri)
+            raise FetchFailedError(uri, shuffle_id, map_id, reduce_id,
+                                   "server has no such bucket")
+        return protocol.recv_bytes(sock)
+    except NetworkError as e:
+        _drop_connection(uri)
+        raise FetchFailedError(uri, shuffle_id, map_id, reduce_id, str(e)) from e
+
+
+def check_status(uri: str, timeout: float = 5.0) -> Optional[dict]:
+    """Healthcheck (reference: shuffle_manager.rs /status)."""
+    try:
+        host, port = protocol.parse_uri(uri)
+        return protocol.request(host, port, "status", timeout=timeout)
+    except NetworkError:
+        return None

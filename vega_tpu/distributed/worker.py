@@ -1,0 +1,174 @@
+"""Executor worker process.
+
+Reference: src/executor.rs — a TCP listener accepting one task per
+connection, deserializing (capnp -> bincode), running on a blocking pool and
+writing the result back on the same stream (:58-106), plus a second listener
+for shutdown signals (:175-215).
+
+vega_tpu keeps the same one-task-per-connection, one-thread-per-task shape
+(natural backpressure, per-task error isolation, and no pool-starvation
+between reduce tasks and the map tasks they wait on), and folds the signal
+channel into the same listener (message types instead of a second port).
+Workers self-register with the driver service and heartbeat — the
+executor-liveness machinery the reference lacks (its executor loss is
+'connect retried 5x then panic', SURVEY.md §5).
+
+Run:  python -m vega_tpu.distributed.worker --driver HOST:PORT \
+          [--host 127.0.0.1] [--port 0] [--executor-id ID]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import socketserver
+import sys
+import threading
+import time
+import traceback
+from vega_tpu import serialization
+from vega_tpu.distributed import protocol
+from vega_tpu.distributed.driver_service import RemoteTrackerClient
+from vega_tpu.distributed.shuffle_server import ShuffleServer
+from vega_tpu.env import Configuration, DeploymentMode, Env
+from vega_tpu.errors import NetworkError
+
+log = logging.getLogger("vega_tpu")
+
+
+class _TaskHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        worker: Worker = self.server.worker  # type: ignore[attr-defined]
+        try:
+            msg_type, payload = protocol.recv_msg(sock)
+        except NetworkError:
+            return
+        if msg_type == "shutdown":
+            # Reference: Signal::ShutDownGracefully (executor.rs:218-223).
+            protocol.send_msg(sock, "ok", None)
+            worker.request_shutdown()
+            return
+        if msg_type == "ping":
+            protocol.send_msg(sock, "ok", worker.executor_id)
+            return
+        if msg_type != "task":
+            protocol.send_msg(sock, "error", f"unknown {msg_type}")
+            return
+        # One task per connection, one thread per in-flight task (reference:
+        # executor.rs:86-91 spawn_blocking). Running directly on the handler
+        # thread — not a bounded pool — matters: a reduce task can block
+        # waiting for recomputed map outputs, and a bounded pool would let it
+        # starve the very map task that unblocks it.
+        t0 = time.time()
+        try:
+            task = serialization.loads(payload)
+            result = task.run()
+            reply = serialization.dumps(("success", result, time.time() - t0))
+            protocol.send_msg(sock, "result", None)
+            protocol.send_bytes(sock, reply)
+        except BaseException as exc:  # noqa: BLE001 — ship error to driver
+            log.debug("task failed", exc_info=True)
+            try:
+                reply = serialization.dumps(
+                    ("error", exc, traceback.format_exc())
+                )
+            except Exception:  # unpicklable exception
+                reply = serialization.dumps(
+                    ("error", RuntimeError(repr(exc)), traceback.format_exc())
+                )
+            try:
+                protocol.send_msg(sock, "result", None)
+                protocol.send_bytes(sock, reply)
+            except NetworkError:
+                pass
+
+
+class Worker:
+    def __init__(self, driver_uri: str, host: str = "127.0.0.1",
+                 port: int = 0, executor_id: str | None = None):
+        self.executor_id = executor_id or f"exec-{os.getpid()}"
+        conf = Configuration.from_environ()
+        conf.deployment_mode = DeploymentMode.DISTRIBUTED
+        env = Env.reset(conf, is_driver=False)
+        env.executor_id = self.executor_id
+
+        from vega_tpu.shuffle.store import ShuffleStore
+
+        tracker = RemoteTrackerClient(driver_uri)
+        env.map_output_tracker = tracker
+        env.cache_tracker = tracker
+        env.shuffle_store = ShuffleStore(spill_dir=env.work_dir())
+        env.shuffle_server = ShuffleServer(env.shuffle_store, host)
+
+        self.tracker = tracker
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _TaskHandler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server.worker = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._shutdown = threading.Event()
+
+        tracker.register_worker({
+            "executor_id": self.executor_id,
+            "host": host,
+            "task_uri": f"{host}:{self.port}",
+            "shuffle_uri": env.shuffle_server.uri,
+            "pid": os.getpid(),
+        })
+
+    @property
+    def task_uri(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    def serve_forever(self, heartbeat_s: float = 5.0) -> None:
+        threading.Thread(
+            target=self._server.serve_forever, name="task-server", daemon=True
+        ).start()
+        while not self._shutdown.wait(heartbeat_s):
+            try:
+                self.tracker.heartbeat(self.executor_id)
+            except NetworkError:
+                log.warning("driver unreachable; shutting down")
+                break
+        self.stop()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._server.shutdown()
+        self._server.server_close()
+        env = Env.get()
+        if env.shuffle_server is not None:
+            env.shuffle_server.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="vega_tpu executor worker")
+    parser.add_argument("--driver", required=True, help="driver service HOST:PORT")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--executor-id", default=None)
+    parser.add_argument("--log-level", default="WARNING")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format=f"%(asctime)s {args.executor_id or 'worker'} %(levelname)s %(message)s",
+    )
+    worker = Worker(args.driver, args.host, args.port, args.executor_id)
+    # Announce the bound port for spawners reading our stdout.
+    print(f"VEGA_WORKER_READY {worker.executor_id} {worker.task_uri}", flush=True)
+    worker.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,10 +33,21 @@ class FetchFailedError(ShuffleError):
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.reduce_id = reduce_id
+        self.message = message
         super().__init__(
             message
             or f"fetch failed: shuffle={shuffle_id} map={map_id} "
             f"reduce={reduce_id} from {server_uri}"
+        )
+
+    def __reduce__(self):
+        # Default exception pickling calls cls(*args) with args=(message,),
+        # which doesn't match this signature — tasks ship this error across
+        # processes, so reconstruct explicitly.
+        return (
+            FetchFailedError,
+            (self.server_uri, self.shuffle_id, self.map_id, self.reduce_id,
+             self.message),
         )
 
 

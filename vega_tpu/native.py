@@ -1,0 +1,93 @@
+"""Loader for the native C++ shuffle runtime (native/vega_native.cpp).
+
+Builds on demand with the in-tree Makefile if the shared object is missing
+(g++ is part of the toolchain); every caller has a pure-Python fallback, so
+absence of a compiler degrades performance, not correctness.
+
+Named ops shared with the device tier's segment fast paths.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("vega_tpu")
+
+OP_ADD, OP_MIN, OP_MAX, OP_PROD = 0, 1, 2, 3
+OP_BY_NAME = {"add": OP_ADD, "min": OP_MIN, "max": OP_MAX, "prod": OP_PROD}
+
+_PY_OPS = {
+    "add": lambda a, b: a + b,
+    "min": min,
+    "max": max,
+    "prod": lambda a, b: a * b,
+}
+
+
+def decode_pairs_py(blob: bytes, is_int: bool):
+    """Pure-Python decoder for the native 16-byte row frames (i64 key +
+    i64/f64 payload) — keeps heterogeneous clusters correct when one side
+    lacks the compiled module."""
+    import struct
+
+    fmt = "<qq" if is_int else "<qd"
+    return [(k, v) for k, v in struct.iter_unpack(fmt, blob)]
+
+
+def merge_encoded_py(flagged_blobs, op_name: str):
+    """Pure-Python equivalent of _vega_native.merge_encoded."""
+    op = _PY_OPS[op_name]
+    combined: dict = {}
+    for blob, is_int in flagged_blobs:
+        for k, v in decode_pairs_py(blob, bool(is_int)):
+            combined[k] = op(combined[k], v) if k in combined else v
+    return list(combined.items())
+
+_lock = threading.Lock()
+_native = None
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    makefile_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                                "native")
+    if not os.path.isfile(os.path.join(makefile_dir, "Makefile")):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", makefile_dir],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        log.info("native build failed (pure-Python fallback in use): %s", e)
+        return False
+
+
+def get():
+    """Return the _vega_native module, or None if unavailable."""
+    global _native, _load_attempted
+    if _native is not None or _load_attempted:
+        return _native
+    with _lock:
+        if _native is not None or _load_attempted:
+            return _native
+        _load_attempted = True
+        try:
+            from vega_tpu import _vega_native  # type: ignore[attr-defined]
+
+            _native = _vega_native
+        except ImportError:
+            if _try_build():
+                try:
+                    from vega_tpu import _vega_native  # type: ignore
+
+                    _native = _vega_native
+                except ImportError:
+                    _native = None
+        if _native is not None:
+            log.info("native shuffle runtime loaded")
+    return _native

@@ -56,9 +56,19 @@ class PairOpsMixin:
         return ShuffledRDD(self, agg, partitioner)
 
     def reduce_by_key(self, func: Callable, partitioner_or_num: Any = None):
-        """Reference: pair_rdd.rs:54-80."""
+        """Reference: pair_rdd.rs:54-80. Recognized monoids (add/min/max/
+        prod) are tagged so numeric partitions take the native C++
+        bucket-combine instead of the per-element Python loop."""
+        from vega_tpu.rdd.shuffled import ShuffledRDD
+
+        partitioner = _resolve_partitioner(self, partitioner_or_num)
+        if not (self.partitioner is not None and self.partitioner == partitioner):
+            op_name = _infer_named_op(func)
+            if op_name is not None:
+                agg = Aggregator(lambda v: v, func, func, op_name=op_name)
+                return ShuffledRDD(self, agg, partitioner)
         return self.combine_by_key(
-            lambda v: v, func, func, partitioner_or_num
+            lambda v: v, func, func, partitioner
         )
 
     def fold_by_key(self, zero, func: Callable, partitioner_or_num: Any = None):
@@ -253,6 +263,47 @@ class PairOpsMixin:
             )
             return results[0]
         return self.filter(lambda kv: kv[0] == key).values().collect()
+
+
+def _canonical_monoid_codes():
+    """co_code of the canonical monoid lambdas for this interpreter."""
+    return {
+        (lambda a, b: a + b).__code__.co_code: "add",
+        (lambda a, b: a * b).__code__.co_code: "prod",
+    }
+
+
+_MONOID_CODES = _canonical_monoid_codes()
+
+
+def _infer_named_op(func: Callable):
+    """Recognize the standard monoids SOUNDLY — only exact identities:
+    operator.add/mul, builtin min/max, and lambdas whose bytecode equals the
+    canonical `lambda a, b: a + b` / `a * b` (no free variables, no consts,
+    no attribute lookups). Probing on sample values was rejected in review:
+    any commutative function agreeing with a monoid at the probe points
+    (e.g. lambda x, y: min(x + y, 100)) would be silently misclassified."""
+    import operator
+
+    if func is operator.add:
+        return "add"
+    if func is operator.mul:
+        return "prod"
+    if func is min:
+        return "min"
+    if func is max:
+        return "max"
+    code = getattr(func, "__code__", None)
+    if (
+        code is not None
+        and code.co_argcount == 2
+        and not code.co_freevars
+        and not code.co_names
+        and code.co_consts in ((), (None,))
+        and getattr(func, "__closure__", None) is None
+    ):
+        return _MONOID_CODES.get(code.co_code)
+    return None
 
 
 def _resolve_partitioner(rdd, partitioner_or_num, others=()) -> Partitioner:

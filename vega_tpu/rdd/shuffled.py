@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Iterator, List
 
+from vega_tpu import serialization
 from vega_tpu.aggregator import Aggregator
 from vega_tpu.dependency import ShuffleDependency
 from vega_tpu.partitioner import Partitioner
@@ -37,11 +38,35 @@ class ShuffledRDD(RDD):
         return [Split(i) for i in range(self.num_partitions)]
 
     def compute(self, split: Split, task_context=None) -> Iterator:
+        from vega_tpu.dependency import NATIVE_MAGIC
+
         merge_combiners = self.aggregator.merge_combiners
+        blobs = ShuffleFetcher.fetch_blobs(self.shuffle_id, split.index)
+        native_blobs = [b for b in blobs if b[:4] == NATIVE_MAGIC]
         combiners: dict = {}
-        for k, c in ShuffleFetcher.fetch(self.shuffle_id, split.index):
-            if k in combiners:
-                combiners[k] = merge_combiners(combiners[k], c)
+
+        if native_blobs:
+            # Native merge (C++ hash-map; reference hot loop 2 equivalent,
+            # shuffled_rdd.rs:154-164); pure-Python merge when this process
+            # lacks the compiled module (heterogeneous cluster).
+            from vega_tpu import native
+
+            nat = native.get()
+            flagged = [(b[5:], 1 if b[4] == 1 else 0) for b in native_blobs]
+            if nat is not None:
+                op = native.OP_BY_NAME[self.aggregator.op_name]
+                combiners = dict(nat.merge_encoded(flagged, op))
             else:
-                combiners[k] = c
+                combiners = dict(native.merge_encoded_py(
+                    flagged, self.aggregator.op_name
+                ))
+
+        for blob in blobs:
+            if blob[:4] == NATIVE_MAGIC:
+                continue
+            for k, c in serialization.loads(blob):
+                if k in combiners:
+                    combiners[k] = merge_combiners(combiners[k], c)
+                else:
+                    combiners[k] = c
         return iter(combiners.items())

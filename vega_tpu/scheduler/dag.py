@@ -320,6 +320,7 @@ class DAGScheduler:
             task = event.task
             err = event.error
             if isinstance(err, FetchFailedError):
+                log.info("fetch failure: %s", err)
                 map_stage = self._shuffle_to_map_stage.get(err.shuffle_id)
                 tracker = Env.get().map_output_tracker
                 if map_stage is not None and err.map_id is not None:
@@ -428,6 +429,7 @@ class DAGScheduler:
             return
         to_retry = list(job.failed)
         job.failed.clear()
+        log.info("resubmitting failed stages: %s", to_retry)
         for stage in to_retry:
             submit_stage(stage)
 

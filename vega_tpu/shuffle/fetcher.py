@@ -26,8 +26,9 @@ log = logging.getLogger("vega_tpu")
 
 class ShuffleFetcher:
     @staticmethod
-    def fetch(shuffle_id: int, reduce_id: int) -> Iterator[Tuple]:
-        """Yield all (K, C) pairs destined for `reduce_id`."""
+    def fetch_blobs(shuffle_id: int, reduce_id: int) -> List[bytes]:
+        """Fetch the raw serialized buckets for `reduce_id` (native-framed or
+        pickled); callers that can merge natively avoid the decode."""
         env = Env.get()
         tracker = env.map_output_tracker
         if tracker is None:
@@ -67,11 +68,25 @@ class ShuffleFetcher:
         else:
             with ThreadPoolExecutor(max_workers=min(len(uris), 16)) as pool:
                 blob_lists = list(pool.map(fetch_from, uris))
+        return [blob for blobs in blob_lists for blob in blobs]
 
-        for blobs in blob_lists:
-            for blob in blobs:
-                for kv in serialization.loads(blob):
-                    yield kv
+    @staticmethod
+    def fetch(shuffle_id: int, reduce_id: int) -> Iterator[Tuple]:
+        """Yield all (K, C) pairs destined for `reduce_id`."""
+        from vega_tpu.dependency import NATIVE_MAGIC
+
+        for blob in ShuffleFetcher.fetch_blobs(shuffle_id, reduce_id):
+            if blob[:4] == NATIVE_MAGIC:
+                from vega_tpu import native
+
+                nat = native.get()
+                value_is_int = blob[4] == 1
+                if nat is not None:
+                    yield from nat.decode_pairs(blob[5:], value_is_int)
+                else:
+                    yield from native.decode_pairs_py(blob[5:], value_is_int)
+            else:
+                yield from serialization.loads(blob)
 
     @staticmethod
     def fetch_into(shuffle_id: int, reduce_id: int,

@@ -947,31 +947,10 @@ class _SortByKeyRDD(_ExchangeRDD):
 
 
 def _infer_named_op(func) -> Optional[str]:
-    """Recognize the standard monoids so user lambdas hit the segment fast
-    path: probe func on tiny concrete values."""
-    try:
-        import operator
+    """Sound monoid recognition shared with the host tier (exact identities
+    only — see vega_tpu/rdd/pair.py:_infer_named_op). Unrecognized
+    associative functions still run correctly via the segmented
+    associative-scan path; this only selects the faster XLA segment op."""
+    from vega_tpu.rdd.pair import _infer_named_op as _host_infer
 
-        if func in (operator.add,):
-            return "add"
-        # Two probe pairs so no op is misclassified by a coincidental value.
-        probes = [(3.0, 5.0), (2.0, 7.0)]
-        results = []
-        for x, y in probes:
-            fwd = float(func(jnp.float32(x), jnp.float32(y)))
-            rev = float(func(jnp.float32(y), jnp.float32(x)))
-            if fwd != rev:
-                return None  # not commutative -> trace it
-            results.append(fwd)
-        expected = {
-            "add": [8.0, 9.0],
-            "min": [3.0, 2.0],
-            "max": [5.0, 7.0],
-            "prod": [15.0, 14.0],
-        }
-        for name, want in expected.items():
-            if results == want:
-                return name
-    except Exception:  # noqa: BLE001 — not a simple monoid; trace it instead
-        return None
-    return None
+    return _host_infer(func)
