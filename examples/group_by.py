@@ -1,0 +1,38 @@
+"""Keyed grouping (reference example: examples/group_by.rs) — both tiers.
+
+Host tier: arbitrary Python pairs through the hash shuffle.
+Device tier: the same workload as fused XLA programs on the mesh
+(BASELINE config 1: group_by over (i64, f64) pairs).
+"""
+
+import time
+
+import numpy as np
+
+import vega_tpu as v
+
+
+def host_tier(ctx, n=100_000, keys=100):
+    pairs = ctx.range(n, num_slices=8).map(lambda i: (i % keys, float(i % 7)))
+    grouped = pairs.group_by_key(8)
+    sizes = sorted((k, len(vs)) for k, vs in grouped.collect())
+    print("host group sizes (first 3):", sizes[:3])
+
+
+def device_tier(ctx, n=1_000_000, keys=1_000):
+    t0 = time.time()
+    pairs = ctx.dense_range(n).map(lambda i: (i % keys, (i % 7) * 1.0))
+    totals = pairs.reduce_by_key(op="add")
+    out = totals.collect()
+    print(f"device reduce_by_key: {len(out)} keys in {time.time()-t0:.2f}s "
+          f"(first: {sorted(out)[:2]})")
+
+
+def main():
+    with v.Context("local") as ctx:
+        host_tier(ctx)
+        device_tier(ctx)
+
+
+if __name__ == "__main__":
+    main()

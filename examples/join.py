@@ -1,0 +1,37 @@
+"""Inner join (reference example: examples/join.rs) — both tiers.
+
+BASELINE config 2: two-RDD inner join.
+"""
+
+import numpy as np
+
+import vega_tpu as v
+
+
+def main():
+    with v.Context("local") as ctx:
+        # host tier (reference join.rs shape: (id, name) x (id, addr))
+        col1 = ctx.parallelize(
+            [(1, ("A", 10)), (2, ("B", 20)), (3, ("C", 30)), (4, ("D", 40)),
+             (5, ("E", 50))], 2,
+        )
+        col2 = ctx.parallelize(
+            [(1, "apple"), (5, "elderberry"), (3, "cherry"), (7, "grape")], 2,
+        )
+        print("host join:", sorted(col1.join(col2).collect()))
+
+        # device tier: fact table x dimension table
+        facts = ctx.dense_from_numpy(
+            np.arange(100_000, dtype=np.int32) % 1000,
+            np.arange(100_000, dtype=np.float32),
+        )
+        dims = ctx.dense_from_numpy(
+            np.arange(1000, dtype=np.int32),
+            np.arange(1000, dtype=np.float32) * 100,
+        )
+        joined = facts.join(dims)
+        print("device join rows:", joined.count())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Basic RDD pipeline (reference example: examples/make_rdd.rs).
+
+Build an in-memory RDD, apply a narrow map, collect on the driver.
+"""
+
+import vega_tpu as v
+
+
+def main():
+    with v.Context("local") as ctx:
+        col = ctx.parallelize(list(range(10)), num_slices=32)
+        vec_iter = col.map(lambda i: 2 * i).collect()
+        print(vec_iter)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Set difference (reference example: examples/subtract.rs)."""
+
+import vega_tpu as v
+
+
+def main():
+    with v.Context("local") as ctx:
+        first = ctx.parallelize([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 4)
+        second = ctx.parallelize([3, 4, 5, 6], 2)
+        print(sorted(first.subtract(second).collect()))
+
+
+if __name__ == "__main__":
+    main()

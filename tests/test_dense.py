@@ -232,3 +232,54 @@ def test_program_cache_reuse(dctx):
     r2 = run()
     assert r1 == r2
     assert len(_PROGRAM_CACHE) == size_after_first  # no new programs compiled
+
+
+def test_dense_topk_actions(dctx):
+    r = dctx.dense_range(5_000)
+    assert r.top(3) == [4999, 4998, 4997]
+    assert r.take_ordered(4) == [0, 1, 2, 3]
+    # pair / custom key falls back to host semantics
+    pairs = dctx.dense_range(100).map(lambda x: (x % 5, x))
+    assert pairs.top(1, key=lambda kv: kv[1])[0][1] == 99
+
+
+def test_dense_stats_histogram(dctx):
+    r = dctx.dense_range(1_000)
+    s = r.stats()
+    assert s["count"] == 1_000
+    assert s["mean"] == pytest.approx(499.5)
+    assert s["min"] == 0.0 and s["max"] == 999.0
+    edges, counts = r.histogram(4)
+    assert sum(counts) == 1_000
+    assert counts == [250, 250, 250, 250]
+
+
+def test_dense_sample(dctx):
+    r = dctx.dense_range(10_000)
+    s = r.sample(False, 0.2, seed=7)
+    c = s.count()
+    assert 1_700 < c < 2_300
+    # deterministic per seed
+    assert s.count() == c
+    s2 = dctx.dense_range(10_000).sample(False, 0.2, seed=7)
+    assert s2.count() == c
+
+
+def test_dense_union(dctx):
+    a = dctx.dense_range(100)
+    b = dctx.dense_range(50).map(lambda x: x + 1_000)
+    u = a.union(b)
+    assert u.count() == 150
+    got = sorted(u.collect())
+    assert got[:100] == list(range(100))
+    assert got[100:] == list(range(1_000, 1_050))
+    # unioned data flows through a shuffle correctly
+    tot = dict(u.map(lambda x: (x % 2, x)).reduce_by_key(op="add").collect())
+    expected = {0: sum(x for x in got if x % 2 == 0),
+                1: sum(x for x in got if x % 2 == 1)}
+    assert tot == expected
+
+
+def test_dense_count_by_value(dctx):
+    r = dctx.dense_from_numpy(np.array([5, 5, 7, 9, 9, 9], dtype=np.int32))
+    assert r.count_by_value() == {5: 2, 7: 1, 9: 3}

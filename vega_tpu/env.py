@@ -61,6 +61,10 @@ class Configuration:
     # Max task retries before failing the job (reference plumbs max_failures
     # but never enforces it, local_scheduler.rs:29,57 — we enforce it).
     max_failures: int = 4
+    # Dense-tier shuffle collective: "all_to_all" (one fused collective,
+    # [n_shards x slot] peak buffer) or "ring" (n-1 ppermute steps, one-slot
+    # peak buffer — for big blocks on big meshes). See tpu/ring.py.
+    dense_exchange: str = "all_to_all"
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -69,7 +73,7 @@ class Configuration:
         pref = "VEGA_TPU_"
         if env.get(pref + "DEPLOYMENT_MODE"):
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
-        for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL"):
+        for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

@@ -38,6 +38,7 @@ from vega_tpu.rdd.base import RDD
 from vega_tpu.split import Split
 from vega_tpu.tpu import block as block_lib
 from vega_tpu.tpu import kernels
+from vega_tpu.tpu import pallas_kernels
 from vega_tpu.tpu import mesh as mesh_lib
 from vega_tpu.tpu.block import KEY, VALUE, Block
 
@@ -173,7 +174,9 @@ class DenseRDD(RDD):
             return super().map_values(f)
 
     # --- shuffles -----------------------------------------------------------
-    def reduce_by_key(self, func=None, partitioner_or_num=None, *, op: Optional[str] = None):
+    def reduce_by_key(self, func=None, partitioner_or_num=None, *,
+                      op: Optional[str] = None,
+                      exchange: Optional[str] = None):
         """Device shuffle: pre-combine, all_to_all, segment-reduce.
         `op` in {'add','min','max','prod'} takes the XLA segment fast path;
         a traceable binary `func` uses the segmented associative scan.
@@ -188,9 +191,11 @@ class DenseRDD(RDD):
             if inferred is not None:
                 op = inferred
         if op is not None:
-            return _ReduceByKeyRDD(self, op=op, func=None)
+            return _with_exchange(_ReduceByKeyRDD(self, op=op, func=None),
+                                  exchange)
         try:
-            return _ReduceByKeyRDD(self, op=None, func=func)
+            return _with_exchange(_ReduceByKeyRDD(self, op=None, func=func),
+                                  exchange)
         except _NotTraceable as e:
             log.info("dense reduce_by_key fell back to host tier: %s", e)
             return super().reduce_by_key(func, partitioner_or_num)
@@ -202,30 +207,34 @@ class DenseRDD(RDD):
         ones = self.map_values(lambda _v: jnp.int32(1))
         return ones.reduce_by_key(op="add")
 
-    def group_by_key(self, partitioner_or_num=None):
+    def group_by_key(self, partitioner_or_num=None,
+                     exchange: Optional[str] = None):
         """Device group_by_key: exchange by key hash, sort within shard.
         The result block holds sorted runs; collect() reassembles the
         (key, [values]) lists on the host — the dense analogue of the
         reference's Vec-collecting aggregator (aggregator.rs:33-53)."""
         if not self.is_pair:
             raise VegaError("group_by_key on non-pair DenseRDD")
-        return _GroupByKeyRDD(self)
+        return _with_exchange(_GroupByKeyRDD(self), exchange)
 
-    def join(self, other, partitioner_or_num=None):
+    def join(self, other, partitioner_or_num=None,
+             exchange: Optional[str] = None):
         """Device sort-merge join (right side unique keys). Falls back to the
         host cogroup-based join when `other` is not dense or right keys are
         not unique (checked on device, cheap)."""
         if isinstance(other, DenseRDD) and self.is_pair and other.is_pair:
-            return _JoinRDD(self, other)
+            return _with_exchange(_JoinRDD(self, other), exchange)
         return super().join(other, partitioner_or_num)
 
     def sort_by_key(self, ascending: bool = True, num_partitions=None,
-                    sample_size_hint: int = 4096):
+                    sample_size_hint: int = 4096,
+                    exchange: Optional[str] = None):
         """Distributed sample sort: driver samples keys, computes range
         bounds, range-exchange, local sort (BASELINE config 5)."""
         if not self.is_pair:
             raise VegaError("sort_by_key on non-pair DenseRDD")
-        return _SortByKeyRDD(self, ascending, sample_size_hint)
+        return _with_exchange(_SortByKeyRDD(self, ascending, sample_size_hint),
+                              exchange)
 
     def distinct(self, num_partitions=None):
         if self.is_pair:
@@ -327,6 +336,180 @@ class DenseRDD(RDD):
         if op == "min":
             return partials.min(axis=0).item()
         return partials.max(axis=0).item()
+
+    def sample(self, with_replacement: bool, fraction: float,
+               seed: Optional[int] = None):
+        """Device-side Bernoulli sampling (per-shard threefry stream,
+        host-tier analogue: utils/random.py BernoulliSampler). Poisson
+        (with-replacement) sampling falls back to the host tier."""
+        if with_replacement:
+            return RDD.sample(self, True, fraction, seed)
+        return _SampleRDD(self, fraction, seed or 0)
+
+    def union(self, other):
+        """Dense-dense union: per-shard block concatenation in one program;
+        anything else falls back to the host UnionRDD."""
+        if isinstance(other, DenseRDD) and \
+                dict(self._schema()) == dict(other._schema()):
+            return _DenseUnionRDD(self, other)
+        return RDD.union(self, other)
+
+    def count_by_value(self) -> dict:
+        """Device count_by_value: value->key exchange + segment count
+        (host semantics: rdd.rs:450-464)."""
+        if self.is_pair:
+            return RDD.count_by_value(self)
+        keyed = _MapRDD(self, lambda x: (x, jnp.int32(1)))
+        return dict(_ReduceByKeyRDD(keyed, op="add", func=None).collect())
+
+    def take_ordered(self, n: int, key=None) -> list:
+        """Smallest n via per-shard lax.top_k + driver merge (host analogue:
+        BoundedPriorityQueue, rdd.rs:1124-1153). Custom key functions fall
+        back to the host path."""
+        if key is not None or self.is_pair:
+            return RDD.take_ordered(self, n, key)
+        return self._device_topk(n, largest=False)
+
+    def top(self, n: int, key=None) -> list:
+        if key is not None or self.is_pair:
+            return RDD.top(self, n, key)
+        return self._device_topk(n, largest=True)
+
+    def _device_topk(self, n: int, largest: bool) -> list:
+        blk = self.block()
+        k = min(n, blk.capacity)
+
+        def shard_topk(vals, counts):
+            mask = kernels.valid_mask(vals.shape[0], counts[0])
+            if largest:
+                if jnp.issubdtype(vals.dtype, jnp.floating):
+                    lo = jnp.array(-jnp.inf, vals.dtype)
+                else:
+                    lo = jnp.array(jnp.iinfo(vals.dtype).min, vals.dtype)
+                masked = jnp.where(mask, vals, lo)
+                best, _ = lax.top_k(masked, k)
+            else:
+                hi = kernels._orderable_max(vals)
+                masked = jnp.where(mask, vals, hi)
+                if jnp.issubdtype(vals.dtype, jnp.floating):
+                    best = -lax.top_k(-masked, k)[0]
+                else:
+                    # Bitwise complement is an overflow-free order flip for
+                    # ints (arithmetic negation wraps on iinfo.min).
+                    best = ~lax.top_k(~masked, k)[0]
+            n_valid = jnp.minimum(counts[0], k)
+            return best, n_valid.reshape(1)
+
+        prog = _cached_program(
+            ("topk", self.mesh, k, largest),
+            lambda: _shard_program(self.mesh, shard_topk, 2, (_SPEC, _SPEC)),
+        )
+        best, n_valid = prog(blk.cols[VALUE], blk.counts)
+        best = np.asarray(jax.device_get(best)).reshape(blk.n_shards, k)
+        n_valid = np.asarray(jax.device_get(n_valid))
+        candidates = np.concatenate(
+            [best[s, : n_valid[s]] for s in range(blk.n_shards)]
+        ) if blk.n_shards else np.empty((0,))
+        candidates = np.sort(candidates)
+        if largest:
+            candidates = candidates[::-1]
+        return candidates[:n].tolist()
+
+    def stats(self) -> dict:
+        """count/mean/stdev/min/max in one device pass (host analogue:
+        rdd.rs-adjacent stats; see base.py)."""
+        import math
+
+        blk = self.block()
+        if self.is_pair:
+            return RDD.stats(self)
+
+        def shard_stats(vals, counts):
+            count = counts[0]
+            v = vals.astype(jnp.float32)
+            s = kernels.masked_reduce(v, count, "add")
+            ss = kernels.masked_reduce(v * v, count, "add")
+            mn = kernels.masked_reduce(v, count, "min")
+            mx = kernels.masked_reduce(v, count, "max")
+            # Count stays integer (float32 is exact only to 2^24 — a v5e-8
+            # shard of the 1B-row target holds ~125M rows).
+            return counts.reshape(1), jnp.stack([s, ss, mn, mx]).reshape(1, 4)
+
+        prog = _cached_program(
+            ("stats", self.mesh),
+            lambda: _shard_program(self.mesh, shard_stats, 2, (_SPEC, _SPEC)),
+        )
+        int_counts, parts = prog(blk.cols[VALUE], blk.counts)
+        int_counts = np.asarray(jax.device_get(int_counts)).reshape(-1)
+        parts = np.asarray(jax.device_get(parts))
+        n = int(int_counts.sum())
+        s = float(parts[:, 0].sum())
+        ss = float(parts[:, 1].sum())
+        valid = int_counts > 0
+        mn = float(parts[valid, 2].min()) if valid.any() else float("inf")
+        mx = float(parts[valid, 3].max()) if valid.any() else float("-inf")
+        mean = s / n if n else float("nan")
+        var = max(0.0, ss / n - mean * mean) if n else float("nan")
+        return {"count": n, "mean": mean,
+                "stdev": math.sqrt(var) if n else float("nan"),
+                "min": mn, "max": mx}
+
+    def _min_max(self):
+        """Fused single-pass min+max (one device program, not two)."""
+        blk = self.block()
+
+        def shard_mm(vals, counts):
+            count = counts[0]
+            mn = kernels.masked_reduce(vals, count, "min")
+            mx = kernels.masked_reduce(vals, count, "max")
+            return jnp.stack([mn, mx]).reshape(1, 2), counts.reshape(1)
+
+        prog = _cached_program(
+            ("minmax", self.mesh),
+            lambda: _shard_program(self.mesh, shard_mm, 2, (_SPEC, _SPEC)),
+        )
+        parts, int_counts = prog(blk.cols[VALUE], blk.counts)
+        parts = np.asarray(jax.device_get(parts))
+        valid = np.asarray(jax.device_get(int_counts)).reshape(-1) > 0
+        if not valid.any():
+            raise VegaError("min/max of empty DenseRDD")
+        return parts[valid, 0].min().item(), parts[valid, 1].max().item()
+
+    def histogram(self, buckets):
+        """Device histogram: bucketize + per-shard bincount + driver sum."""
+        if self.is_pair:
+            return RDD.histogram(self, buckets)
+        if isinstance(buckets, int):
+            lo, hi = self._min_max()
+            if lo == hi:
+                return [lo, hi], [self.count()]
+            step = (hi - lo) / buckets
+            edges = [lo + i * step for i in range(buckets)] + [hi]
+        else:
+            edges = list(buckets)
+        n_bins = len(edges) - 1
+        blk = self.block()
+        edges_dev = jnp.asarray(edges, dtype=jnp.float32)
+
+        def shard_hist(bnds, vals, counts):
+            v = vals.astype(jnp.float32)
+            mask = kernels.valid_mask(v.shape[0], counts[0])
+            mask = mask & (v >= bnds[0]) & (v <= bnds[-1])
+            idx = jnp.clip(jnp.searchsorted(bnds, v, side="right") - 1,
+                           0, n_bins - 1)
+            idx = jnp.where(mask, idx, n_bins)
+            return jnp.bincount(idx, length=n_bins + 1)[:n_bins].reshape(1, -1)
+
+        prog = _cached_program(
+            ("hist", self.mesh, n_bins),
+            lambda: _shard_program(
+                self.mesh, shard_hist, (_REPL, _SPEC, _SPEC), _SPEC
+            ),
+        )
+        parts = np.asarray(jax.device_get(
+            prog(edges_dev, blk.cols[VALUE], blk.counts)
+        ))
+        return edges, parts.sum(axis=0).tolist()
 
     def take(self, n: int) -> list:
         # Pull shard by shard until satisfied; avoids full collect.
@@ -599,9 +782,38 @@ def _exchange_capacities(counts: np.ndarray, n_shards: int,
     return slot, out
 
 
+def _with_exchange(node, exchange: Optional[str]):
+    if exchange is not None:
+        node.exchange_mode = exchange
+    return node
+
+
+def _get_exchange(mode: str):
+    if mode == "ring":
+        from vega_tpu.tpu.ring import ring_exchange
+
+        return ring_exchange
+    return kernels.bucket_exchange
+
+
 class _ExchangeRDD(DenseRDD):
     """Common driver loop: run the fused exchange program, check overflow
-    flags, retry with grown capacities (capacity-factor pattern)."""
+    flags, retry with grown capacities (capacity-factor pattern). The
+    collective implementation (all_to_all vs ring ppermute) comes from
+    Configuration.dense_exchange or the node's exchange_mode attribute."""
+
+    @property
+    def exchange_mode(self) -> str:
+        mode = getattr(self, "_exchange_mode", None)
+        if mode is None:
+            from vega_tpu.env import Env
+
+            mode = getattr(Env.get().conf, "dense_exchange", "all_to_all")
+        return mode
+
+    @exchange_mode.setter
+    def exchange_mode(self, mode: str) -> None:
+        self._exchange_mode = mode
 
     def _run_exchange(self, build_program, counts: np.ndarray):
         n = self.mesh.size
@@ -657,6 +869,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         n = self.mesh.size
         names = list(blk.cols)
         counts_host = np.asarray(jax.device_get(blk.counts))
+        exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -664,8 +877,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 count = counts[0]
                 # map-side combine (reference: dependency.rs:176-223)
                 cols, count = self._segment_reduce(cols, count, presorted=False)
-                bucket = (kernels.hash32(cols[KEY]) % jnp.uint32(n)).astype(jnp.int32)
-                cols, count, overflow = kernels.bucket_exchange(
+                bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
                 # reduce-side merge (reference: shuffled_rdd.rs:149-170)
@@ -675,7 +888,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 ) + (overflow.reshape(1),)
 
             key = ("rbk", self.mesh, tuple(names), n, slot, out_cap,
-                   self._op or _fp(self._func))
+                   self.exchange_mode, self._op or _fp(self._func))
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -706,13 +919,14 @@ class _GroupByKeyRDD(_ExchangeRDD):
         n = self.mesh.size
         names = list(blk.cols)
         counts_host = np.asarray(jax.device_get(blk.counts))
+        exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
-                bucket = (kernels.hash32(cols[KEY]) % jnp.uint32(n)).astype(jnp.int32)
-                cols, count, overflow = kernels.bucket_exchange(
+                bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
                 cols = kernels.sort_by_column(cols, count, KEY)
@@ -720,7 +934,8 @@ class _GroupByKeyRDD(_ExchangeRDD):
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
-            key = ("gbk", self.mesh, tuple(names), n, slot, out_cap)
+            key = ("gbk", self.mesh, tuple(names), n, slot, out_cap,
+                   self.exchange_mode)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -782,17 +997,18 @@ class _JoinRDD(_ExchangeRDD):
         n = self.mesh.size
         l_counts = np.asarray(jax.device_get(lblk.counts))
         r_counts = np.asarray(jax.device_get(rblk.counts))
+        exchange = _get_exchange(self.exchange_mode)
 
         def build(slot_pair, out_cap):
             def prog_fn(lc, lk, lv, rc, rk, rv):
                 lcols, lcount = {KEY: lk, VALUE: lv}, lc[0]
                 rcols, rcount = {KEY: rk, VALUE: rv}, rc[0]
-                lb = (kernels.hash32(lcols[KEY]) % jnp.uint32(n)).astype(jnp.int32)
-                rb = (kernels.hash32(rcols[KEY]) % jnp.uint32(n)).astype(jnp.int32)
-                lcols, lcount, lof = kernels.bucket_exchange(
+                lb = pallas_kernels.hash_bucket(lcols[KEY], n)
+                rb = pallas_kernels.hash_bucket(rcols[KEY], n)
+                lcols, lcount, lof = exchange(
                     lcols, lcount, lb, n, slot_pair, out_cap
                 )
-                rcols, rcount, rof = kernels.bucket_exchange(
+                rcols, rcount, rof = exchange(
                     rcols, rcount, rb, n, slot_pair, out_cap
                 )
                 joined, jcount, dup = kernels.merge_join_unique_right(
@@ -805,7 +1021,8 @@ class _JoinRDD(_ExchangeRDD):
                 )
 
             prog = _cached_program(
-                ("join", self.mesh, n, slot_pair, out_cap),
+                ("join", self.mesh, n, slot_pair, out_cap,
+                 self.exchange_mode),
                 lambda: _shard_program(self.mesh, prog_fn, 6, (_SPEC,) * 6),
             )
             return prog, (
@@ -907,6 +1124,7 @@ class _SortByKeyRDD(_ExchangeRDD):
                 jax.device_get(blk.cols[KEY][:1])).dtype)
         bounds_dev = jnp.asarray(bounds)
         ascending = self.ascending
+        exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
             def prog_fn(bnds, counts, *col_arrays):
@@ -917,7 +1135,7 @@ class _SortByKeyRDD(_ExchangeRDD):
                     bucket = jnp.searchsorted(bnds, keys).astype(jnp.int32)
                 else:
                     bucket = jnp.searchsorted(-bnds, -keys).astype(jnp.int32)
-                cols, count, overflow = kernels.bucket_exchange(
+                cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
                 cols = kernels.sort_by_column(
@@ -928,7 +1146,7 @@ class _SortByKeyRDD(_ExchangeRDD):
                 ) + (overflow.reshape(1),)
 
             key = ("sort", self.mesh, tuple(names), n, slot, out_cap,
-                   ascending)
+                   ascending, self.exchange_mode)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -941,6 +1159,83 @@ class _SortByKeyRDD(_ExchangeRDD):
                           *[blk.cols[nm] for nm in names])
 
         outs, out_cap = self._run_exchange(build, counts_host)
+        counts, col_arrays = outs[0], outs[1:]
+        return Block(cols=dict(zip(names, col_arrays)), counts=counts,
+                     capacity=out_cap, mesh=self.mesh)
+
+
+class _SampleRDD(_NarrowRDD):
+    """Per-shard Bernoulli sampling with a threefry stream folded by shard id
+    (deterministic per (seed, shard))."""
+
+    def __init__(self, parent: DenseRDD, fraction: float, seed: int):
+        super().__init__(parent, parent._schema())
+        self._fraction = float(fraction)
+        self._seed = int(seed)
+        self._user_fn = ("sample", self._fraction, self._seed)
+
+    def _shard_fn(self, cols, count):
+        cap = next(iter(cols.values())).shape[0]
+        # Per-shard stream: fold the shard's first-row global position in.
+        shard_tag = count * 0 + lax.axis_index(mesh_lib.SHARD_AXIS)
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed), shard_tag)
+        u = jax.random.uniform(key, (cap,))
+        keep = (u < self._fraction) & kernels.valid_mask(cap, count)
+        return kernels.compact(cols, keep, cap)
+
+
+class _DenseUnionRDD(DenseRDD):
+    """Per-shard concatenation of two same-schema dense RDDs."""
+
+    def __init__(self, first: DenseRDD, second: DenseRDD):
+        super().__init__(first.context, first.mesh, [first, second])
+        self.first = first
+        self.second = second
+
+    def _schema(self):
+        return self.first._schema()
+
+    def _materialize(self) -> Block:
+        a = self.first.block()
+        b = self.second.block()
+        names = [n for n, _ in self._schema()]
+        out_cap = block_lib._round_capacity(a.capacity + b.capacity)
+
+        def shard_concat(ac, bc, *cols):
+            half = len(names)
+            a_cols = dict(zip(names, cols[:half]))
+            b_cols = dict(zip(names, cols[half:]))
+            a_count, b_count = ac[0], bc[0]
+            out = {}
+            for name in names:
+                col_a, col_b = a_cols[name], b_cols[name]
+                pad = out_cap - col_a.shape[0] - col_b.shape[0]
+                merged = jnp.concatenate([
+                    col_a, col_b,
+                    jnp.zeros((pad,) + col_a.shape[1:], col_a.dtype),
+                ])
+                out[name] = merged
+            # mark validity: rows [0,a_count) and [cap_a, cap_a+b_count)
+            idx = lax.iota(jnp.int32, out_cap)
+            keep = (idx < a_count) | (
+                (idx >= a.capacity) & (idx < a.capacity + b_count)
+            )
+            return kernels.compact(out, keep, out_cap) + tuple()
+
+        def prog_fn(ac, bc, *cols):
+            out, count = shard_concat(ac, bc, *cols)
+            return (count.reshape(1),) + tuple(out[n] for n in names)
+
+        prog = _cached_program(
+            ("dense_union", self.mesh, tuple(names), a.capacity, b.capacity,
+             out_cap),
+            lambda: _shard_program(
+                self.mesh, prog_fn, 2 + 2 * len(names),
+                (_SPEC,) * (1 + len(names)),
+            ),
+        )
+        outs = prog(a.counts, b.counts,
+                    *[a.cols[n] for n in names], *[b.cols[n] for n in names])
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
                      capacity=out_cap, mesh=self.mesh)

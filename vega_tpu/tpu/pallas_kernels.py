@@ -1,0 +1,70 @@
+"""Pallas TPU kernels for the dense tier's hot scalar ops.
+
+The exchange pipeline's non-sort cost is hashing + bucketing every key
+(tpu/kernels.py hash32). XLA fuses these elementwise ops well, but routing
+them through Pallas keeps the whole hash+bucket step in one VMEM-resident
+kernel (no intermediate HBM round trips between the four mixer stages) and
+establishes the kernel plumbing richer kernels can extend.
+
+Kernels run compiled on TPU and in interpreter mode elsewhere (tests run
+interpret=True on CPU). All shapes are padded to the (8, 128) f32/i32 tile
+internally; callers see flat arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 128
+_SUBLANES = 8
+_TILE = _LANES * _SUBLANES
+
+
+def _hash_bucket_kernel(keys_ref, out_ref, *, n_buckets: int):
+    """lowbias32 finalizer + modulo bucketing, one VMEM block at a time."""
+    x = keys_ref[:].astype(jnp.uint32)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    out_ref[:] = (x % jnp.uint32(n_buckets)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def hash_bucket_pallas(keys: jax.Array, n_buckets: int,
+                       interpret: bool = False) -> jax.Array:
+    """bucket = lowbias32(key) % n_buckets via one Pallas kernel.
+
+    Bit-identical to kernels.hash32(...) % n_buckets for int32 keys (the
+    device-tier bucketing contract)."""
+    n = keys.shape[0]
+    padded = -(-n // _TILE) * _TILE
+    grid = padded // _TILE
+    keys2d = jnp.pad(keys, (0, padded - n)).reshape(-1, _LANES)
+
+    out = pl.pallas_call(
+        functools.partial(_hash_bucket_kernel, n_buckets=n_buckets),
+        out_shape=jax.ShapeDtypeStruct(keys2d.shape, jnp.int32),
+        grid=(grid,),
+        # index_map yields BLOCK indices (block i covers rows
+        # [i*_SUBLANES, (i+1)*_SUBLANES) of the 2D view).
+        in_specs=[pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(keys2d)
+    return out.reshape(-1)[:n]
+
+
+def hash_bucket(keys: jax.Array, n_buckets: int) -> jax.Array:
+    """Platform-dispatched bucketing: Pallas on TPU, plain XLA elsewhere
+    (pallas interpret mode is for tests, not production CPU)."""
+    from vega_tpu.tpu import kernels
+
+    if keys.dtype == jnp.int32 and jax.default_backend() == "tpu":
+        return hash_bucket_pallas(keys, n_buckets)
+    return (kernels.hash32(keys) % jnp.uint32(n_buckets)).astype(jnp.int32)

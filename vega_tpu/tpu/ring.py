@@ -1,0 +1,116 @@
+"""Ring exchange: peak-memory-bounded alternative to the all_to_all shuffle.
+
+bucket_exchange (kernels.py) materializes an [n_shards, slot_capacity] send
+buffer per column — peak memory grows linearly with mesh size, which is the
+HBM hazard for large blocks on big meshes. The ring exchange instead
+processes ONE peer per step: select the rows destined for peer (i+s) mod n,
+ppermute them s hops around the ring, and append what arrives — peak extra
+memory is a single [slot_capacity] buffer per column regardless of mesh
+size, at the cost of n-1 sequential collective steps.
+
+This is the same ring-pipelining pattern ring attention uses for long
+sequences (block exchange over ppermute instead of one big collective),
+applied to keyed-data shuffles; lane-adjacent shifts ride neighbor ICI
+links on a physical ring/torus.
+
+Select per shuffle with the exchange="ring" keyword
+(DenseRDD.reduce_by_key/group_by_key/join/sort_by_key) or globally via
+Configuration.dense_exchange / VEGA_TPU_DENSE_EXCHANGE=ring.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from vega_tpu.tpu import kernels
+from vega_tpu.tpu.mesh import SHARD_AXIS
+
+Cols = Dict[str, jax.Array]
+
+
+def ring_exchange(
+    cols: Cols,
+    count: jax.Array,
+    bucket: jax.Array,
+    n_shards: int,
+    slot_capacity: int,
+    out_capacity: int,
+) -> Tuple[Cols, jax.Array, jax.Array]:
+    """Drop-in replacement for kernels.bucket_exchange (same contract:
+    returns (cols, new_count, overflow_flag))."""
+    capacity = bucket.shape[0]
+    mask = kernels.valid_mask(capacity, count)
+    bucket = jnp.where(mask, bucket, n_shards)
+
+    order = jnp.argsort(bucket, stable=True)
+    sorted_bucket = jnp.take(bucket, order)
+    sorted_cols = kernels.gather_rows(cols, order)
+
+    counts_to = jnp.bincount(sorted_bucket, length=n_shards + 1)[:n_shards]
+    starts = jnp.searchsorted(sorted_bucket, jnp.arange(n_shards))
+    overflow = jnp.any(counts_to > slot_capacity)
+
+    my_id = lax.axis_index(SHARD_AXIS)
+
+    out_cols: Cols = {
+        name: jnp.zeros((out_capacity,) + col.shape[1:], col.dtype)
+        for name, col in cols.items()
+    }
+    write_pos = jnp.zeros((), jnp.int32)
+
+    def take_slot(target):
+        """[slot_capacity] rows destined for `target` + their count."""
+        start = jnp.take(starts, target)
+        n_rows = jnp.minimum(jnp.take(counts_to, target),
+                             slot_capacity).astype(jnp.int32)
+        rows = start + jnp.arange(slot_capacity)
+        rows = jnp.clip(rows, 0, capacity - 1)
+        slot = {name: jnp.take(col, rows, axis=0)
+                for name, col in sorted_cols.items()}
+        valid = jnp.arange(slot_capacity) < n_rows
+        slot = {
+            name: jnp.where(
+                valid.reshape(valid.shape + (1,) * (c.ndim - 1)), c,
+                jnp.zeros((), c.dtype),
+            )
+            for name, c in slot.items()
+        }
+        return slot, n_rows
+
+    def append(out_cols, write_pos, slot, n_rows):
+        idx = write_pos + jnp.arange(slot_capacity)
+        in_range = jnp.arange(slot_capacity) < n_rows
+        idx = jnp.where(in_range, idx, out_capacity)  # OOB rows dropped
+        new = {
+            name: out.at[idx].set(slot[name], mode="drop")
+            for name, out in out_cols.items()
+        }
+        return new, write_pos + n_rows
+
+    # Step 0: my own bucket stays local.
+    slot, n_rows = take_slot(my_id)
+    out_cols, write_pos = append(out_cols, write_pos, slot, n_rows)
+
+    # Steps 1..n-1: send to peer (i+s) mod n via an s-hop shifted ppermute.
+    # The loop is unrolled (perm must be static); each step's live buffer is
+    # one [slot_capacity] slot per column.
+    for s in range(1, n_shards):
+        perm = [(i, (i + s) % n_shards) for i in range(n_shards)]
+        target = (my_id + s) % n_shards
+        slot, n_rows = take_slot(target)
+        recv = {
+            name: lax.ppermute(c, SHARD_AXIS, perm)
+            for name, c in slot.items()
+        }
+        recv_rows = lax.ppermute(n_rows, SHARD_AXIS, perm)
+        out_cols, write_pos = append(out_cols, write_pos, recv, recv_rows)
+
+    total_in = write_pos
+    # Rows destined for me but truncated by slot_capacity at any sender are
+    # invisible here; senders flag that via `overflow` (any counts_to > slot).
+    overflow = overflow | (total_in > out_capacity)
+    return out_cols, total_in.astype(jnp.int32), overflow
