@@ -875,9 +875,13 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
-                # map-side combine (reference: dependency.rs:176-223)
-                cols, count = self._segment_reduce(cols, count, presorted=False)
-                bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                if n > 1:
+                    # map-side combine (reference: dependency.rs:176-223);
+                    # pointless on one shard — the reduce side sorts anyway.
+                    cols, count = self._segment_reduce(cols, count,
+                                                       presorted=False)
+                bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
+                          if n > 1 else jnp.zeros_like(cols[KEY]))
                 cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
@@ -925,7 +929,8 @@ class _GroupByKeyRDD(_ExchangeRDD):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
-                bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
+                          if n > 1 else jnp.zeros_like(cols[KEY]))
                 cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
@@ -1003,8 +1008,12 @@ class _JoinRDD(_ExchangeRDD):
             def prog_fn(lc, lk, lv, rc, rk, rv):
                 lcols, lcount = {KEY: lk, VALUE: lv}, lc[0]
                 rcols, rcount = {KEY: rk, VALUE: rv}, rc[0]
-                lb = pallas_kernels.hash_bucket(lcols[KEY], n)
-                rb = pallas_kernels.hash_bucket(rcols[KEY], n)
+                if n > 1:
+                    lb = pallas_kernels.hash_bucket(lcols[KEY], n)
+                    rb = pallas_kernels.hash_bucket(rcols[KEY], n)
+                else:
+                    lb = jnp.zeros_like(lcols[KEY])
+                    rb = jnp.zeros_like(rcols[KEY])
                 lcols, lcount, lof = exchange(
                     lcols, lcount, lb, n, slot_pair, out_cap
                 )
@@ -1131,7 +1140,9 @@ class _SortByKeyRDD(_ExchangeRDD):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
                 keys = cols[KEY]
-                if ascending:
+                if n == 1:
+                    bucket = jnp.zeros_like(keys, shape=keys.shape).astype(jnp.int32)
+                elif ascending:
                     bucket = jnp.searchsorted(bnds, keys).astype(jnp.int32)
                 else:
                     bucket = jnp.searchsorted(-bnds, -keys).astype(jnp.int32)

@@ -80,6 +80,15 @@ def gather_rows(cols: Cols, idx: jax.Array) -> Cols:
 # ---------------------------------------------------------------------------
 
 
+def passthrough_exchange(cols: Cols, count: jax.Array, capacity: int,
+                         out_capacity: int):
+    """Single-shard fast path shared by every exchange implementation: the
+    bucket/sort/collective is a no-op; just re-capacity the block."""
+    mask = valid_mask(capacity, count)
+    out, new_count = compact(cols, mask, out_capacity)
+    return out, new_count, new_count > out_capacity
+
+
 def bucket_exchange(
     cols: Cols,
     count: jax.Array,  # int32[] per-shard valid count
@@ -95,6 +104,8 @@ def bucket_exchange(
     Reduce side: mask + compact received rows. This is the entire reference
     shuffle data plane (SURVEY.md §2.5) as one fused XLA program."""
     capacity = bucket.shape[0]
+    if n_shards == 1:
+        return passthrough_exchange(cols, count, capacity, out_capacity)
     mask = valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)  # invalid rows -> ghost bucket
 

@@ -23,6 +23,43 @@ _lock = threading.Lock()
 _default_mesh: Optional[Mesh] = None
 
 
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Join a multi-host device mesh via jax.distributed.
+
+    The DCN analogue of the reference's multi-host deployment
+    (context.rs:209-303 ssh bootstrap): every host runs the same program,
+    jax.distributed glues their local chips into one global device set, and
+    default_mesh() then spans all of them — collectives ride ICI within a
+    slice and DCN across slices, inserted by XLA from the same shard_map
+    programs. No code changes anywhere else: exchanges are mesh-size
+    agnostic.
+
+    Args default from the standard env vars (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID) or the TPU metadata service.
+    """
+    import os
+
+    kwargs = {}
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(
+            num_processes
+            if num_processes is not None
+            else os.environ["JAX_NUM_PROCESSES"]
+        )
+    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(
+            process_id if process_id is not None
+            else os.environ["JAX_PROCESS_ID"]
+        )
+    jax.distributed.initialize(**kwargs)
+    set_default_mesh(None)  # rebuild over the now-global device set
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     """Build a 1-D mesh over the first n devices (default: all)."""
     devices = jax.devices()

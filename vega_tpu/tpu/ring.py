@@ -43,6 +43,9 @@ def ring_exchange(
     """Drop-in replacement for kernels.bucket_exchange (same contract:
     returns (cols, new_count, overflow_flag))."""
     capacity = bucket.shape[0]
+    if n_shards == 1:
+        return kernels.passthrough_exchange(cols, count, capacity,
+                                            out_capacity)
     mask = kernels.valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)
 
