@@ -283,3 +283,40 @@ def test_dense_union(dctx):
 def test_dense_count_by_value(dctx):
     r = dctx.dense_from_numpy(np.array([5, 5, 7, 9, 9, 9], dtype=np.int32))
     assert r.count_by_value() == {5: 2, 7: 1, 9: 3}
+
+
+def test_dense_cogroup(dctx):
+    a = dctx.dense_from_numpy(np.array([1, 1, 2, 3], dtype=np.int32),
+                              np.array([10, 11, 20, 30], dtype=np.int32))
+    b = dctx.dense_from_numpy(np.array([1, 4], dtype=np.int32),
+                              np.array([100, 400], dtype=np.int32))
+    grouped = dict(a.cogroup(b).collect())
+    assert sorted(grouped[1][0]) == [10, 11]
+    assert grouped[1][1] == [100]
+    assert grouped[2] == ([20], [])
+    assert grouped[4] == ([], [400])
+    # host ops compose on top of the dense cogroup
+    joined = sorted(
+        a.cogroup(b).flat_map_values(
+            lambda g: [(l, r) for l in g[0] for r in g[1]]
+        ).collect()
+    )
+    assert joined == [(1, (10, 100)), (1, (11, 100))]
+
+
+def test_dense_cogroup_parity_with_host(dctx):
+    rng = np.random.RandomState(5)
+    ak, av = rng.randint(0, 30, 500), rng.randint(0, 1000, 500)
+    bk, bv = rng.randint(0, 30, 300), rng.randint(0, 1000, 300)
+    dev = {
+        k: (sorted(l), sorted(r))
+        for k, (l, r) in dctx.dense_from_numpy(ak, av)
+        .cogroup(dctx.dense_from_numpy(bk, bv)).collect()
+    }
+    host = {
+        k: (sorted(l), sorted(r))
+        for k, (l, r) in dctx.parallelize(list(zip(ak.tolist(), av.tolist())), 4)
+        .cogroup(dctx.parallelize(list(zip(bk.tolist(), bv.tolist())), 4))
+        .collect()
+    }
+    assert dev == host

@@ -69,14 +69,19 @@ class Block:
 
 
 def _round_capacity(c: int) -> int:
-    """Round per-shard capacity up to the next power of two (>=128).
+    """Round per-shard capacity to a shape-stable bucket.
 
-    Lane-friendly (TPU tiling wants multiples of 128) AND shape-stable:
-    pow2 buckets mean different logical sizes hit the same compiled
-    program shapes, so the structural program cache (dense_rdd.py) and
-    XLA's jit cache stay hot across pipelines of similar scale."""
+    Below 1M rows: next power of two (>=128) — few distinct shapes, so the
+    structural program cache (dense_rdd.py) and XLA's jit cache stay hot
+    across small pipelines. Above 1M: next multiple of 1M — pow2 would
+    waste up to ~2x memory and sort work exactly where blocks are large
+    (big jobs have few distinct shapes anyway). Both are multiples of 128
+    (TPU lane width)."""
     c = max(c, 128)
-    return 1 << (c - 1).bit_length()
+    if c <= (1 << 20):
+        return 1 << (c - 1).bit_length()
+    step = 1 << 20
+    return -(-c // step) * step
 
 
 def from_numpy(columns: Dict[str, np.ndarray], mesh=None,

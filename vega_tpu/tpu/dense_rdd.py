@@ -18,6 +18,12 @@ the host tier through compute()/iterator() interop.
 Raggedness: every block has static per-shard capacity; validity is
 (count, mask). Exchange capacities are estimated, checked on device, and
 retried with exact histogram-based sizes on overflow.
+
+Related public work: DrJAX (arXiv:2403.07128) expresses MapReduce primitives
+as JAX transforms the same way the dense tier lowers RDD ops to shard_map
+programs; Exoshuffle (arXiv:2203.05072) argues for application-level,
+pluggable shuffles — here the exchange implementation is a per-op plugin
+(all_to_all | ring).
 """
 
 from __future__ import annotations
@@ -225,6 +231,21 @@ class DenseRDD(RDD):
         if isinstance(other, DenseRDD) and self.is_pair and other.is_pair:
             return _with_exchange(_JoinRDD(self, other), exchange)
         return super().join(other, partitioner_or_num)
+
+    def cogroup(self, *others, partitioner_or_num=None):
+        """Dense-dense cogroup: both sides exchange + sort on device (hash
+        placement is shared, so co-keyed rows land on the same shard); only
+        the ragged (k, ([lvs], [rvs])) assembly happens on the host.
+        Reference semantics: pair_rdd.rs:123-155 / co_grouped_rdd.rs."""
+        if (len(others) == 1 and isinstance(others[0], DenseRDD)
+                and self.is_pair and others[0].is_pair
+                and partitioner_or_num is None
+                and others[0].mesh == self.mesh):
+            # An explicit partitioner request or a mesh mismatch must honor
+            # host-path semantics (and mismatched meshes would pair
+            # unrelated shards) — fall through to the host cogroup.
+            return _DenseCoGroupRDD(self, others[0])
+        return super().cogroup(*others, partitioner_or_num=partitioner_or_num)
 
     def sort_by_key(self, ascending: bool = True, num_partitions=None,
                     sample_size_hint: int = 4096,
@@ -760,24 +781,27 @@ def dense_from_block(ctx, blk: Block) -> DenseRDD:
 # ---------------------------------------------------------------------------
 
 
-def _pow2(c: int) -> int:
-    return 1 << max(7, (c - 1).bit_length())  # >=128, shape-stable
+def _cap_round(c: int) -> int:
+    """Shape-stable capacity rounding (pow2 under 1M, 1M-multiples above —
+    see block._round_capacity)."""
+    return block_lib._round_capacity(c)
 
 
 def _exchange_capacities(counts: np.ndarray, n_shards: int,
                          attempt: int) -> Tuple[int, int]:
-    """Heuristic slot/out capacities with growth on retry; pow2-rounded so
-    repeated pipelines at similar scale reuse compiled programs."""
+    """Heuristic slot/out capacities with growth on retry, rounded to
+    shape-stable buckets so repeated pipelines at similar scale reuse
+    compiled programs."""
     max_count = int(counts.max()) if counts.size else 1
     total = int(counts.sum())
     grow = 2 ** attempt
     slot = min(
-        _pow2(max_count),
-        _pow2((math.ceil(max_count / max(n_shards, 1)) * 2 + 64) * grow),
+        _cap_round(max_count),
+        _cap_round((math.ceil(max_count / max(n_shards, 1)) * 2 + 64) * grow),
     )
     out = min(
-        _pow2(total),
-        _pow2((math.ceil(total / max(n_shards, 1)) * 2 + 64) * grow),
+        _cap_round(total),
+        _cap_round((math.ceil(total / max(n_shards, 1)) * 2 + 64) * grow),
     )
     return slot, out
 
@@ -816,16 +840,38 @@ class _ExchangeRDD(DenseRDD):
         self._exchange_mode = mode
 
     def _run_exchange(self, build_program, counts: np.ndarray):
+        import time as _time
+
+        from vega_tpu.scheduler import events as ev
+
         n = self.mesh.size
-        for attempt in range(5):
-            slot, out_cap = _exchange_capacities(counts, n, attempt)
-            prog, args = build_program(slot, out_cap)
-            *outs, overflow = prog(*args)
-            if not bool(np.any(np.asarray(jax.device_get(overflow)))):
-                return outs, out_cap
-            log.info("exchange overflow (slot=%d out=%d), retrying", slot, out_cap)
-        raise VegaError("exchange capacity overflow after retries — key skew "
-                        "exceeds capacity growth; repartition or use host tier")
+        bus = getattr(self.context, "bus", None)
+        t_start = _time.time()
+        if bus is not None:
+            # Dense stages bypass the task scheduler (one SPMD launch);
+            # surface them on the same event bus for observability. One
+            # Submitted/Completed pair per exchange, retries included.
+            bus.post(ev.StageSubmitted(
+                stage_id=-self.rdd_id, num_tasks=n, is_shuffle_map=True,
+            ))
+        try:
+            for attempt in range(5):
+                slot, out_cap = _exchange_capacities(counts, n, attempt)
+                prog, args = build_program(slot, out_cap)
+                *outs, overflow = prog(*args)
+                if not bool(np.any(np.asarray(jax.device_get(overflow)))):
+                    return outs, out_cap
+                log.info("exchange overflow (slot=%d out=%d), retrying",
+                         slot, out_cap)
+            raise VegaError(
+                "exchange capacity overflow after retries — key skew "
+                "exceeds capacity growth; repartition or use host tier"
+            )
+        finally:
+            if bus is not None:
+                bus.post(ev.StageCompleted(
+                    stage_id=-self.rdd_id, duration_s=_time.time() - t_start,
+                ))
 
 
 class _ReduceByKeyRDD(_ExchangeRDD):
@@ -956,28 +1002,14 @@ class _GroupByKeyRDD(_ExchangeRDD):
                      capacity=out_cap, mesh=self.mesh)
 
     def collect(self) -> list:
-        cols = self.block().to_numpy()
-        keys, vals = cols[KEY], cols[VALUE]
-        out = []
         # keys are sorted within each shard; shards don't overlap (hash
         # partitioned), so grouping is a single pass per shard run.
-        if len(keys) == 0:
-            return out
-        boundaries = np.flatnonzero(keys[1:] != keys[:-1]) + 1
-        groups = np.split(vals, boundaries)
-        group_keys = keys[np.concatenate([[0], boundaries])]
-        return [(k.item(), g.tolist()) for k, g in zip(group_keys, groups)]
+        cols = self.block().to_numpy()
+        return list(_sorted_runs(cols[KEY], cols[VALUE]))
 
     def compute(self, split: Split, task_context=None):
         rows = self.block().shard_rows(split.index)
-        keys, vals = rows[KEY], rows[VALUE]
-        if len(keys) == 0:
-            return
-        boundaries = np.flatnonzero(keys[1:] != keys[:-1]) + 1
-        groups = np.split(vals, boundaries)
-        group_keys = keys[np.concatenate([[0], boundaries])]
-        for k, g in zip(group_keys, groups):
-            yield (k.item(), g.tolist())
+        yield from _sorted_runs(rows[KEY], rows[VALUE])
 
 
 class _DupRightKeys(Exception):
@@ -1050,11 +1082,17 @@ class _JoinRDD(_ExchangeRDD):
         )
 
     def _host_join(self):
-        # Fallback for duplicate right-side keys: the host cogroup join
-        # (general dup x dup semantics, reference: pair_rdd.rs:104-121).
+        # Fallback for duplicate right-side keys: dense cogroup (exchange
+        # still on device) + host-side dup x dup expansion
+        # (reference: pair_rdd.rs:104-121).
         if self._host_fallback is None:
-            self._host_fallback = RDD.join(self.left.to_rdd(),
-                                           self.right.to_rdd())
+            cg = _DenseCoGroupRDD(self.left, self.right)
+
+            def emit(groups):
+                lvs, rvs = groups
+                return [(lv, rv) for lv in lvs for rv in rvs]
+
+            self._host_fallback = cg.flat_map_values(emit)
         return self._host_fallback
 
     def block(self) -> Block:
@@ -1193,6 +1231,57 @@ class _SampleRDD(_NarrowRDD):
         u = jax.random.uniform(key, (cap,))
         keep = (u < self._fraction) & kernels.valid_mask(cap, count)
         return kernels.compact(cols, keep, cap)
+
+
+def _sorted_runs(keys: np.ndarray, vals: np.ndarray):
+    """(key, [values]) pairs from a key-sorted run (shared by group_by_key
+    collect/compute and cogroup)."""
+    if len(keys) == 0:
+        return
+    bounds = np.flatnonzero(keys[1:] != keys[:-1]) + 1
+    groups = np.split(vals, bounds)
+    group_keys = keys[np.concatenate([[0], bounds])]
+    for k, g in zip(group_keys, groups):
+        yield k.item(), g.tolist()
+
+
+class _DenseCoGroupRDD(RDD):
+    """Host-facing view over two device-grouped blocks: each side runs the
+    dense group-by-key exchange (same hash -> same shard), and compute()
+    merges the two sorted runs per shard into (k, (l_values, r_values)).
+
+    Because this is a plain RDD with a partitioner-consistent layout, every
+    host pair op (join variants, flat_map_values, ...) composes on top."""
+
+    def __init__(self, left: DenseRDD, right: DenseRDD):
+        from vega_tpu.dependency import OneToOneDependency
+
+        self.left_grouped = _GroupByKeyRDD(left)
+        self.right_grouped = _GroupByKeyRDD(right)
+        super().__init__(left.context, deps=[
+            OneToOneDependency(self.left_grouped),
+            OneToOneDependency(self.right_grouped),
+        ])
+        self.mesh = left.mesh
+
+    @property
+    def num_partitions(self) -> int:
+        return self.mesh.size
+
+    def compute(self, split: Split, task_context=None):
+        lrows = self.left_grouped.block().shard_rows(split.index)
+        rrows = self.right_grouped.block().shard_rows(split.index)
+
+        lmap = dict(_sorted_runs(lrows[KEY], lrows[VALUE]))
+        rmap = dict(_sorted_runs(rrows[KEY], rrows[VALUE]))
+        for k in lmap.keys() | rmap.keys():
+            yield (k, (lmap.get(k, []), rmap.get(k, [])))
+
+    def collect(self) -> list:
+        out = []
+        for s in range(self.num_partitions):
+            out.extend(self.compute(Split(s)))
+        return out
 
 
 class _DenseUnionRDD(DenseRDD):

@@ -11,7 +11,9 @@ size, at the cost of n-1 sequential collective steps.
 This is the same ring-pipelining pattern ring attention uses for long
 sequences (block exchange over ppermute instead of one big collective),
 applied to keyed-data shuffles; lane-adjacent shifts ride neighbor ICI
-links on a physical ring/torus.
+links on a physical ring/torus. Cf. "Memory-efficient array redistribution
+through portable collective communication" (arXiv:2112.01075), which builds
+redistributions from the same bounded-footprint collective steps.
 
 Select per shuffle with the exchange="ring" keyword
 (DenseRDD.reduce_by_key/group_by_key/join/sort_by_key) or globally via
