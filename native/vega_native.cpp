@@ -155,6 +155,17 @@ static PyObject* pair_list_from_accs(
   return out;
 }
 
+
+// Value-kind homogeneity tracker: the wire format types a whole bucket set
+// as int OR float. A partition mixing int and float values must fall back
+// to the pickle path to preserve per-value types (group_by returns the
+// values themselves). kind: 0=unset, 1=int, 2=float; returns false on mix.
+static inline bool track_kind(int* kind, bool value_is_int) {
+  int k = value_is_int ? 1 : 2;
+  if (*kind == 0) { *kind = k; return true; }
+  return *kind == k;
+}
+
 // ---- module functions ------------------------------------------------------
 
 // bucket_reduce_pairs(iterable, n_buckets, op) -> (list[bytes], is_int) | None
@@ -174,17 +185,19 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
   if (iter == nullptr) return nullptr;
 
   bool all_int = true;
+  int kind = 0;
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
     double dv;
     int64_t iv;
     bool value_is_int;
-    if (!extract_pair(item, &key, &dv, &iv, &value_is_int)) {
+    if (!extract_pair(item, &key, &dv, &iv, &value_is_int) ||
+        !track_kind(&kind, value_is_int)) {
       Py_DECREF(item);
       Py_DECREF(iter);
       if (PyErr_Occurred()) return nullptr;
-      Py_RETURN_NONE;  // not numeric -> caller uses the Python path
+      Py_RETURN_NONE;  // non-numeric or mixed int/float -> Python path
     }
     Py_DECREF(item);
     all_int = all_int && value_is_int;
@@ -236,17 +249,19 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
   PyObject* iter = PyObject_GetIter(iterable);
   if (iter == nullptr) return nullptr;
   bool all_int = true;
+  int kind = 0;
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
     double dv;
     int64_t iv;
     bool value_is_int;
-    if (!extract_pair(item, &key, &dv, &iv, &value_is_int)) {
+    if (!extract_pair(item, &key, &dv, &iv, &value_is_int) ||
+        !track_kind(&kind, value_is_int)) {
       Py_DECREF(item);
       Py_DECREF(iter);
       if (PyErr_Occurred()) return nullptr;
-      Py_RETURN_NONE;
+      Py_RETURN_NONE;  // non-numeric or mixed int/float -> Python path
     }
     Py_DECREF(item);
     all_int = all_int && value_is_int;
@@ -366,17 +381,19 @@ static PyObject* encode_pairs(PyObject*, PyObject* args) {
   std::vector<int64_t> ks;
   std::vector<Acc> vs;
   bool all_int = true;
+  int kind = 0;
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
     double dv;
     int64_t iv;
     bool value_is_int;
-    if (!extract_pair(item, &key, &dv, &iv, &value_is_int)) {
+    if (!extract_pair(item, &key, &dv, &iv, &value_is_int) ||
+        !track_kind(&kind, value_is_int)) {
       Py_DECREF(item);
       Py_DECREF(iter);
       if (PyErr_Occurred()) return nullptr;
-      Py_RETURN_NONE;
+      Py_RETURN_NONE;  // non-numeric or mixed int/float -> Python path
     }
     Py_DECREF(item);
     all_int = all_int && value_is_int;

@@ -1,0 +1,44 @@
+"""Hosts-file parsing (reference: src/hosts.rs tests, hosts.rs:41-64)."""
+
+import pytest
+
+from vega_tpu.errors import VegaError
+from vega_tpu.hosts import Hosts
+
+
+def test_parse_basic():
+    h = Hosts.parse("""
+# cluster
+master = 10.0.0.1
+slaves = 10.0.0.2, 10.0.0.3:2, 10.0.0.4
+""")
+    assert h.master == "10.0.0.1"
+    assert h.slaves == ["10.0.0.2", "10.0.0.3", "10.0.0.3", "10.0.0.4"]
+
+
+def test_parse_empty_and_comments():
+    h = Hosts.parse("# nothing\n\n")
+    assert h.master == "127.0.0.1"
+    assert h.slaves == []
+
+
+def test_parse_errors():
+    with pytest.raises(VegaError):
+        Hosts.parse("not a key value line")
+    with pytest.raises(VegaError):
+        Hosts.parse("slaves = host:xyz")
+    with pytest.raises(VegaError):
+        Hosts.parse("unknown = 1")
+
+
+def test_load_missing_file(tmp_path):
+    h = Hosts.load(str(tmp_path / "nope.conf"))
+    assert h.slaves == []
+
+
+def test_load_file(tmp_path):
+    p = tmp_path / "hosts.conf"
+    p.write_text("master=m\nslaves = a:2, b\n")
+    h = Hosts.load(str(p))
+    assert h.master == "m"
+    assert h.slaves == ["a", "a", "b"]

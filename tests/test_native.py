@@ -140,3 +140,45 @@ def test_mixed_numeric_and_python_partitions(ctx):
     rdd = ctx.parallelize(data, 3).map_partitions_with_index(make)
     result = dict(rdd.reduce_by_key(lambda a, b: a + b, 2).collect())
     assert result == {k: 100 for k in range(10)}
+
+
+def test_native_group_path_parity(ctx):
+    """group_by_key through the native raw-row path matches the pickle path
+    and keeps order-insensitive content."""
+    data = [(i % 23, float(i)) for i in range(4_000)]
+    fast = dict(ctx.parallelize(data, 4).group_by_key(4).collect())
+    expected = {}
+    for k, x in data:
+        expected.setdefault(k, []).append(x)
+    assert set(fast) == set(expected)
+    for k in expected:
+        assert sorted(fast[k]) == sorted(expected[k])
+    # non-numeric values use the pickle path transparently
+    mixed = dict(
+        ctx.parallelize([(1, "a"), (1, "b"), (2, "c")], 2).group_by_key(2).collect()
+    )
+    assert sorted(mixed[1]) == ["a", "b"]
+
+
+def test_native_group_path_cogroup(ctx):
+    """Cogroup's shuffled parents also ride the native group path."""
+    a = ctx.parallelize([(i % 5, i) for i in range(100)], 3)
+    b = ctx.parallelize([(i % 5, i * 10) for i in range(50)], 3)
+    grouped = dict(a.cogroup(b).collect())
+    for k in range(5):
+        assert sorted(grouped[k][0]) == [x for x in range(100) if x % 5 == k]
+        assert sorted(grouped[k][1]) == [x * 10 for x in range(50) if x % 5 == k]
+
+
+def test_mixed_value_types_preserve_fidelity(ctx):
+    """A partition mixing int and float values must keep per-value types
+    (falls back to the pickle path rather than coercing ints to float)."""
+    g = dict(ctx.parallelize([(1, 2), (1, 2.5)], 1).group_by_key(1).collect())
+    assert 2 in g[1] and 2.5 in g[1]
+    assert any(isinstance(x, int) for x in g[1])
+    big = 2**60 + 1
+    g2 = dict(ctx.parallelize([(1, big), (1, 0.5)], 1).group_by_key(1).collect())
+    assert big in g2[1]  # no double rounding
+    r = dict(ctx.parallelize([(1, 2), (1, 3), (2, 2.5)], 1)
+             .reduce_by_key(lambda a, b: a + b, 1).collect())
+    assert r[1] == 5 and isinstance(r[1], int)

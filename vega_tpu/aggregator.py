@@ -15,7 +15,8 @@ C = TypeVar("C")
 
 
 class Aggregator(Generic[K, V, C]):
-    __slots__ = ("create_combiner", "merge_value", "merge_combiners", "op_name")
+    __slots__ = ("create_combiner", "merge_value", "merge_combiners",
+                 "op_name", "is_group")
 
     def __init__(
         self,
@@ -23,6 +24,7 @@ class Aggregator(Generic[K, V, C]):
         merge_value: Callable[[C, V], C],
         merge_combiners: Callable[[C, C], C],
         op_name: str | None = None,
+        is_group: bool = False,
     ):
         self.create_combiner = create_combiner
         self.merge_value = merge_value
@@ -31,6 +33,9 @@ class Aggregator(Generic[K, V, C]):
         # C++ bucket-combine (vega_tpu/native.py) and the device tier's
         # segment fast path. None means "opaque closure".
         self.op_name = op_name
+        # List-collecting aggregator (group_by/cogroup): unlocks the native
+        # bucket-without-combine path.
+        self.is_group = is_group
 
     @staticmethod
     def default() -> "Aggregator":
@@ -39,6 +44,7 @@ class Aggregator(Generic[K, V, C]):
             create_combiner=lambda v: [v],
             merge_value=_append,
             merge_combiners=_extend,
+            is_group=True,
         )
 
 

@@ -21,9 +21,12 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("vega_tpu")
 
-# Frame tag for natively-encoded shuffle buckets (packed 16-byte rows +
-# value-int flag); anything else in the store is a pickled list of pairs.
+# Frame tags for natively-encoded shuffle buckets (packed 16-byte rows +
+# value-int flag). VN01 = pre-combined (k, combiner) rows; VG01 = raw
+# (k, v) rows awaiting list collection (group path). Anything else in the
+# store is a pickled list of pairs.
 NATIVE_MAGIC = b"VN01"
+NATIVE_GROUP_MAGIC = b"VG01"
 
 _SENTINEL = object()
 
@@ -135,7 +138,8 @@ class ShuffleDependency(Dependency):
         from vega_tpu.partitioner import HashPartitioner
 
         source = None
-        if agg.op_name is not None and type(self.partitioner) is HashPartitioner:
+        use_native = (agg.op_name is not None or agg.is_group)
+        if use_native and type(self.partitioner) is HashPartitioner:
             from vega_tpu import native
 
             nat = native.get()
@@ -152,17 +156,22 @@ class ShuffleDependency(Dependency):
                 if first is _SENTINEL:
                     source = iter(())
                 elif _is_numeric_pair(first):
-                    result = nat.bucket_reduce_pairs(
-                        _it.chain([first], it), n_out,
-                        native.OP_BY_NAME[agg.op_name],
-                    )
+                    stream = _it.chain([first], it)
+                    if agg.is_group:
+                        result = nat.bucket_pairs(stream, n_out)
+                        magic = NATIVE_GROUP_MAGIC
+                    else:
+                        result = nat.bucket_reduce_pairs(
+                            stream, n_out, native.OP_BY_NAME[agg.op_name]
+                        )
+                        magic = NATIVE_MAGIC
                     if result is not None:
                         blobs, all_int = result
                         flag = b"\x01" if all_int else b"\x00"
                         for reduce_id, blob in enumerate(blobs):
                             env.shuffle_store.put(
                                 self.shuffle_id, split.index, reduce_id,
-                                NATIVE_MAGIC + flag + blob,
+                                magic + flag + blob,
                             )
                         return (env.shuffle_server.uri
                                 if env.shuffle_server else "local")

@@ -62,6 +62,13 @@ class DistributedBackend(TaskBackend):
         self._rr = itertools.count(0)
         self._lock = threading.Lock()
         self._stopped = False
+        if hosts is None:
+            # Cluster membership from the hosts file when present
+            # (reference: hosts.rs / ~/hosts.conf), else local executors.
+            from vega_tpu.hosts import Hosts
+
+            parsed = Hosts.load(getattr(conf, "hosts_file", None))
+            hosts = parsed.slaves or None
         n = num_executors or getattr(conf, "num_executors", None) or 2
         local_hosts = hosts or ["127.0.0.1"] * n
         self._spawn_workers(local_hosts)

@@ -65,6 +65,9 @@ class Configuration:
     # [n_shards x slot] peak buffer) or "ring" (n-1 ppermute steps, one-slot
     # peak buffer — for big blocks on big meshes). See tpu/ring.py.
     dense_exchange: str = "all_to_all"
+    # Cluster membership file for distributed mode (reference: ~/hosts.conf,
+    # src/hosts.rs); None -> VEGA_TPU_HOSTS_FILE -> ~/hosts.conf -> local.
+    hosts_file: Optional[str] = None
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -73,7 +76,8 @@ class Configuration:
         pref = "VEGA_TPU_"
         if env.get(pref + "DEPLOYMENT_MODE"):
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
-        for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE"):
+        for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
+                     "HOSTS_FILE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

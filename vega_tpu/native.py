@@ -37,6 +37,15 @@ def decode_pairs_py(blob: bytes, is_int: bool):
     return [(k, v) for k, v in struct.iter_unpack(fmt, blob)]
 
 
+def decode(blob: bytes, is_int: bool):
+    """Decode a native row frame with the compiled module when present,
+    else the pure-Python fallback (single source of the selection logic)."""
+    nat = get()
+    if nat is not None:
+        return nat.decode_pairs(blob, is_int)
+    return decode_pairs_py(blob, is_int)
+
+
 def merge_encoded_py(flagged_blobs, op_name: str):
     """Pure-Python equivalent of _vega_native.merge_encoded."""
     op = _PY_OPS[op_name]

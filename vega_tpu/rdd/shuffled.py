@@ -38,12 +38,27 @@ class ShuffledRDD(RDD):
         return [Split(i) for i in range(self.num_partitions)]
 
     def compute(self, split: Split, task_context=None) -> Iterator:
-        from vega_tpu.dependency import NATIVE_MAGIC
+        from vega_tpu.dependency import NATIVE_GROUP_MAGIC, NATIVE_MAGIC
 
         merge_combiners = self.aggregator.merge_combiners
         blobs = ShuffleFetcher.fetch_blobs(self.shuffle_id, split.index)
         native_blobs = [b for b in blobs if b[:4] == NATIVE_MAGIC]
+        group_blobs = [b for b in blobs if b[:4] == NATIVE_GROUP_MAGIC]
         combiners: dict = {}
+
+        if group_blobs:
+            # Raw (k, v) rows from the native group path: collect into lists
+            # (C decode + one dict pass; reference: shuffled_rdd.rs:149-170
+            # with the Vec-collecting aggregator).
+            from vega_tpu import native
+
+            for b in group_blobs:
+                for k, val in native.decode(b[5:], b[4] == 1):
+                    bucket = combiners.get(k)
+                    if bucket is None:
+                        combiners[k] = [val]
+                    else:
+                        bucket.append(val)
 
         if native_blobs:
             # Native merge (C++ hash-map; reference hot loop 2 equivalent,
@@ -62,7 +77,7 @@ class ShuffledRDD(RDD):
                 ))
 
         for blob in blobs:
-            if blob[:4] == NATIVE_MAGIC:
+            if blob[:4] in (NATIVE_MAGIC, NATIVE_GROUP_MAGIC):
                 continue
             for k, c in serialization.loads(blob):
                 if k in combiners:

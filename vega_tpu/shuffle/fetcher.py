@@ -73,18 +73,21 @@ class ShuffleFetcher:
     @staticmethod
     def fetch(shuffle_id: int, reduce_id: int) -> Iterator[Tuple]:
         """Yield all (K, C) pairs destined for `reduce_id`."""
-        from vega_tpu.dependency import NATIVE_MAGIC
+        from vega_tpu.dependency import NATIVE_GROUP_MAGIC, NATIVE_MAGIC
 
         for blob in ShuffleFetcher.fetch_blobs(shuffle_id, reduce_id):
-            if blob[:4] == NATIVE_MAGIC:
+            magic = blob[:4]
+            if magic in (NATIVE_MAGIC, NATIVE_GROUP_MAGIC):
                 from vega_tpu import native
 
-                nat = native.get()
-                value_is_int = blob[4] == 1
-                if nat is not None:
-                    yield from nat.decode_pairs(blob[5:], value_is_int)
+                rows = native.decode(blob[5:], blob[4] == 1)
+                if magic == NATIVE_GROUP_MAGIC:
+                    # Raw rows: present as singleton-list combiners (the
+                    # default aggregator contract, aggregator.rs:33-53).
+                    for k, v in rows:
+                        yield (k, [v])
                 else:
-                    yield from native.decode_pairs_py(blob[5:], value_is_int)
+                    yield from rows
             else:
                 yield from serialization.loads(blob)
 
