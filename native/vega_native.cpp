@@ -248,8 +248,7 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
   std::vector<std::vector<int64_t>> keys(static_cast<size_t>(n_buckets));
   PyObject* iter = PyObject_GetIter(iterable);
   if (iter == nullptr) return nullptr;
-  bool all_int = true;
-  int kind = 0;
+  int kind = 0;  // homogeneity: all_int == (kind != 2) after the loop
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
@@ -264,7 +263,6 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
       Py_RETURN_NONE;  // non-numeric or mixed int/float -> Python path
     }
     Py_DECREF(item);
-    all_int = all_int && value_is_int;
     uint64_t h = splitmix64(static_cast<uint64_t>(key) & kMask);
     size_t b = h % static_cast<uint64_t>(n_buckets);
     keys[b].push_back(key);
@@ -272,6 +270,7 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
   }
   Py_DECREF(iter);
   if (PyErr_Occurred()) return nullptr;
+  const bool all_int = (kind != 2);
 
   PyObject* result = PyList_New(n_buckets);
   if (result == nullptr) return nullptr;
@@ -380,8 +379,7 @@ static PyObject* encode_pairs(PyObject*, PyObject* args) {
   if (iter == nullptr) return nullptr;
   std::vector<int64_t> ks;
   std::vector<Acc> vs;
-  bool all_int = true;
-  int kind = 0;
+  int kind = 0;  // homogeneity: all_int == (kind != 2) after the loop
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
@@ -396,12 +394,12 @@ static PyObject* encode_pairs(PyObject*, PyObject* args) {
       Py_RETURN_NONE;  // non-numeric or mixed int/float -> Python path
     }
     Py_DECREF(item);
-    all_int = all_int && value_is_int;
     ks.push_back(key);
     vs.push_back({dv, iv});
   }
   Py_DECREF(iter);
   if (PyErr_Occurred()) return nullptr;
+  const bool all_int = (kind != 2);
   std::vector<Row> rows;
   rows.reserve(ks.size());
   for (size_t r = 0; r < ks.size(); ++r) {

@@ -320,3 +320,38 @@ def test_dense_cogroup_parity_with_host(dctx):
         .collect()
     }
     assert dev == host
+
+
+def test_dense_multi_column(dctx):
+    """Named multi-column blocks: one reduce_by_key aggregates every value
+    column per key in a single program."""
+    rng = np.random.RandomState(2)
+    n = 1_000
+    ip = rng.randint(0, 20, n).astype(np.int32)
+    rdd = dctx.dense_from_columns(
+        key="ip", ip=ip,
+        bytes=np.ones(n, dtype=np.int32) * 10,
+        packets=np.ones(n, dtype=np.int32),
+    )
+    assert set(rdd.columns) == {"k", "bytes", "packets"}
+    per_key = rdd.reduce_by_key(op="add")
+    arrays = per_key.collect_arrays()
+    assert len(arrays["k"]) == 20
+    by_key = dict(zip(arrays["k"].tolist(), arrays["bytes"].tolist()))
+    counts = dict(zip(arrays["k"].tolist(), arrays["packets"].tolist()))
+    for k in range(20):
+        expected_n = int((ip == k).sum())
+        assert counts[k] == expected_n
+        assert by_key[k] == expected_n * 10
+    # select projects columns (narrow)
+    proj = per_key.select("k", "bytes")
+    assert set(proj.columns) == {"k", "bytes"}
+    with pytest.raises(v.VegaError):
+        per_key.select("nope")
+
+
+def test_dense_profiler_hook(dctx, tmp_path):
+    with dctx.profiler(str(tmp_path / "trace")):
+        dctx.dense_range(1_000).sum()
+    import os
+    assert os.path.exists(tmp_path / "trace")

@@ -27,6 +27,23 @@ from vega_tpu.scheduler.local_backend import LocalBackend
 
 log = logging.getLogger("vega_tpu")
 
+
+def _profile_trace(log_dir: str):
+    import contextlib
+
+    import jax
+
+    @contextlib.contextmanager
+    def _trace():
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+
+    return _trace()
+
+
 _active_context_lock = threading.Lock()
 _active_context: Optional["Context"] = None
 
@@ -146,6 +163,23 @@ class Context:
         return dense_from_numpy(
             self, columns, num_partitions or self.default_parallelism
         )
+
+    def dense_from_columns(self, columns: Optional[dict] = None,
+                           key: Optional[str] = None, **kwcolumns):
+        """Named multi-column dense source (see tpu.dense_rdd.dense_from_columns)."""
+        from vega_tpu.tpu.dense_rdd import dense_from_columns
+
+        return dense_from_columns(self, columns, key=key, **kwcolumns)
+
+    def profiler(self, log_dir: str):
+        """JAX profiler trace over a block of work (the tracing subsystem
+        the reference never built — SURVEY.md §5 'Tracing: none'). View with
+        TensorBoard or xprof.
+
+            with ctx.profiler("/tmp/trace"):
+                rdd.reduce_by_key(op="add").collect()
+        """
+        return _profile_trace(log_dir)
 
     def broadcast(self, value: Any):
         """Driver-side broadcast variable (absent from the reference; Spark

@@ -63,12 +63,17 @@ class DistributedBackend(TaskBackend):
         self._lock = threading.Lock()
         self._stopped = False
         if hosts is None:
-            # Cluster membership from the hosts file when present
-            # (reference: hosts.rs / ~/hosts.conf), else local executors.
-            from vega_tpu.hosts import Hosts
+            # Cluster membership from a hosts file ONLY when explicitly
+            # configured (conf.hosts_file / VEGA_TPU_HOSTS_FILE) — a stray
+            # ~/hosts.conf must not silently override num_executors.
+            import os as _os
 
-            parsed = Hosts.load(getattr(conf, "hosts_file", None))
-            hosts = parsed.slaves or None
+            explicit = getattr(conf, "hosts_file", None) or \
+                _os.environ.get("VEGA_TPU_HOSTS_FILE")
+            if explicit:
+                from vega_tpu.hosts import Hosts
+
+                hosts = Hosts.load(explicit).slaves or None
         n = num_executors or getattr(conf, "num_executors", None) or 2
         local_hosts = hosts or ["127.0.0.1"] * n
         self._spawn_workers(local_hosts)

@@ -144,6 +144,18 @@ class DenseRDD(RDD):
             for i in range(len(cols[0])):
                 yield tuple(c[i] for c in cols)
 
+    @property
+    def columns(self) -> List[str]:
+        return [n for n, _ in self._schema()]
+
+    def select(self, *names: str) -> "DenseRDD":
+        """Project a subset of columns (narrow, fused)."""
+        schema = dict(self._schema())
+        for n in names:
+            if n not in schema:
+                raise VegaError(f"no such column: {n!r}")
+        return _SelectRDD(self, names)
+
     def to_rdd(self) -> RDD:
         """Explicit hand-off to the host tier (identity view)."""
         from vega_tpu.rdd.narrow import MapPartitionsRDD
@@ -726,6 +738,17 @@ class _FilterRDD(_NarrowRDD):
         return kernels.compact(cols, keep, cap)
 
 
+class _SelectRDD(_NarrowRDD):
+    def __init__(self, parent: DenseRDD, names):
+        pschema = dict(parent._schema())
+        super().__init__(parent, tuple((n, pschema[n]) for n in names))
+        self._names = tuple(names)
+        self._user_fn = self._names
+
+    def _shard_fn(self, cols, count):
+        return {n: cols[n] for n in self._names}, count
+
+
 class _ProjectRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, col: str):
         pschema = dict(parent._schema())
@@ -769,6 +792,40 @@ def dense_from_numpy(ctx, columns, num_partitions=None) -> DenseRDD:
     else:
         named = {f"c{i}": np.asarray(c) for i, c in enumerate(columns)}
         blk = block_lib.from_numpy(named, mesh)
+    return _SourceRDD(ctx, blk)
+
+
+def dense_from_columns(ctx, columns: Optional[dict] = None,
+                       key: Optional[str] = None, **kwcolumns) -> DenseRDD:
+    """Named-column dense source (the columnar-analytics face of the tier):
+    any number of value columns; `key=` names the column used as the shuffle
+    key. reduce_by_key with a named op reduces EVERY value column per key in
+    one program (kernels.segment_reduce_named is generic over columns) —
+    e.g. a parquet table flows in with zero pivoting:
+
+        blk = pq.read_table(p).to_pydict()
+        rdd = ctx.dense_from_columns(blk, key="ip")
+        per_ip = rdd.reduce_by_key(op="add")     # sums every other column
+
+    Columns may come as a dict (works for any column names, including
+    "key") and/or keywords.
+    """
+    named = {}
+    for source in (columns or {}), kwcolumns:
+        for name, col in source.items():
+            if name in named:
+                raise VegaError(f"duplicate column {name!r}")
+            named[name] = np.asarray(col)
+    if key is not None:
+        if key not in named:
+            raise VegaError(f"key column {key!r} not in columns")
+        if KEY in named and key != KEY:
+            raise VegaError(
+                f"column {KEY!r} already exists; key={key!r} would "
+                f"overwrite it — rename one of them"
+            )
+        named[KEY] = named.pop(key)
+    blk = block_lib.from_numpy(named, mesh_lib.default_mesh())
     return _SourceRDD(ctx, blk)
 
 
