@@ -184,8 +184,8 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
   PyObject* iter = PyObject_GetIter(iterable);
   if (iter == nullptr) return nullptr;
 
-  bool all_int = true;
-  int kind = 0;
+  int kind = 0;       // value-kind homogeneity (track_kind)
+  bool int_ok = true;  // no int64 overflow during combines
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
@@ -200,7 +200,6 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
       Py_RETURN_NONE;  // non-numeric or mixed int/float -> Python path
     }
     Py_DECREF(item);
-    all_int = all_int && value_is_int;
     uint64_t h = splitmix64(static_cast<uint64_t>(key) & kMask);
     auto& bucket = buckets[h % static_cast<uint64_t>(n_buckets)];
     auto it = bucket.find(key);
@@ -208,13 +207,14 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
       bucket.emplace(key, Acc{dv, iv});
     } else {
       it->second.d = apply_op_d(op, it->second.d, dv);
-      if (all_int && !apply_op_i(op, it->second.i, iv, &it->second.i)) {
-        all_int = false;  // int64 overflow -> double semantics
+      if (int_ok && !apply_op_i(op, it->second.i, iv, &it->second.i)) {
+        int_ok = false;  // int64 overflow -> double semantics
       }
     }
   }
   Py_DECREF(iter);
   if (PyErr_Occurred()) return nullptr;
+  const bool all_int = (kind != 2) && int_ok;
 
   PyObject* result = PyList_New(n_buckets);
   if (result == nullptr) return nullptr;

@@ -355,3 +355,44 @@ def test_dense_profiler_hook(dctx, tmp_path):
         dctx.dense_range(1_000).sum()
     import os
     assert os.path.exists(tmp_path / "trace")
+
+
+def test_dense_map_expand(dctx):
+    import jax.numpy as jnp
+
+    r = dctx.dense_range(100).map_expand(
+        lambda x: jnp.stack([x, x + 1000]), 2
+    )
+    got = sorted(r.collect())
+    expected = sorted(list(range(100)) + [x + 1000 for x in range(100)])
+    assert got == expected
+    # pair output
+    kv = dctx.dense_range(50).map_expand(
+        lambda x: (jnp.stack([x % 3, x % 3]), jnp.stack([x, x * 2])), 2
+    )
+    agg = dict(kv.reduce_by_key(op="add").collect())
+    expected2 = {}
+    for x in range(50):
+        expected2[x % 3] = expected2.get(x % 3, 0) + x + x * 2
+    assert agg == expected2
+
+
+def test_dense_zip_and_index(dctx):
+    a = dctx.dense_range(100)
+    b = dctx.dense_range(100).map(lambda x: x * 2)
+    z = a.zip(b)
+    assert sorted(z.collect()) == [(x, 2 * x) for x in range(100)]
+    wi = dctx.dense_range(64).zip_with_index()
+    pairs = wi.collect()
+    assert sorted(pairs) == sorted((v, i) for i, v in enumerate(
+        [x for s in range(8) for x in range(s * 8, s * 8 + 8)]
+    ))
+    # indices are a permutation of 0..63 and value==index for range input
+    assert sorted(i for _v, i in pairs) == list(range(64))
+
+
+def test_dense_zip_mismatch_raises(dctx):
+    a = dctx.dense_range(100)
+    b = dctx.dense_range(37)
+    with pytest.raises(v.VegaError):
+        a.zip(b).collect()

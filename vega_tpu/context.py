@@ -28,20 +28,18 @@ from vega_tpu.scheduler.local_backend import LocalBackend
 log = logging.getLogger("vega_tpu")
 
 
-def _profile_trace(log_dir: str):
-    import contextlib
+import contextlib
 
+
+@contextlib.contextmanager
+def _profile_trace(log_dir: str):
     import jax
 
-    @contextlib.contextmanager
-    def _trace():
-        jax.profiler.start_trace(log_dir)
-        try:
-            yield
-        finally:
-            jax.profiler.stop_trace()
-
-    return _trace()
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
 
 
 _active_context_lock = threading.Lock()

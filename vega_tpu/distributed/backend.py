@@ -73,6 +73,10 @@ class DistributedBackend(TaskBackend):
             if explicit:
                 from vega_tpu.hosts import Hosts
 
+                if not _os.path.exists(explicit):
+                    raise NetworkError(
+                        f"configured hosts file does not exist: {explicit}"
+                    )
                 hosts = Hosts.load(explicit).slaves or None
         n = num_executors or getattr(conf, "num_executors", None) or 2
         local_hosts = hosts or ["127.0.0.1"] * n

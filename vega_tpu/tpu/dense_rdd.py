@@ -182,6 +182,45 @@ class DenseRDD(RDD):
     def key_by(self, f: Callable):
         return self.map(lambda x: (f(x), x))
 
+    def map_expand(self, f: Callable, factor: int):
+        """Static-arity flat_map: f maps one row to `factor` output rows
+        (returned as length-`factor` arrays / tuples of arrays). The fixed
+        expansion keeps shapes static — the XLA-compatible subset of
+        flat_map (dynamic-arity flat_map falls back to the host tier
+        automatically via the normal RDD method)."""
+        try:
+            return _MapExpandRDD(self, f, factor)
+        except _NotTraceable as e:
+            log.info("dense map_expand fell back to host tier: %s", e)
+
+            def expand(x):
+                out = f(x)
+                if isinstance(out, tuple):
+                    cols = [np.asarray(o).tolist() for o in out]
+                    return list(zip(*cols))
+                return np.asarray(out).tolist()
+
+            return super().flat_map(expand)
+
+    def zip(self, other):
+        """Dense-dense zip of single-value-column RDDs: per-shard column
+        concatenation when shard counts line up (host semantics:
+        rdd.rs:818-829); pair / multi-column operands use the host path so
+        elements keep their full structure."""
+        if (isinstance(other, DenseRDD) and other.mesh == self.mesh
+                and [n for n, _ in self._schema()] == [VALUE]
+                and [n for n, _ in other._schema()] == [VALUE]):
+            return _DenseZipRDD(self, other)
+        return RDD.zip(self, other)
+
+    def zip_with_index(self):
+        """(value, global index) — the index offsets come from a tiny
+        counts transfer at materialization; no second data pass (the host
+        tier needs a full counting job, base.py zip_with_index)."""
+        if self.is_pair:
+            raise VegaError("zip_with_index on pair DenseRDD — use values()")
+        return _ZipWithIndexRDD(self)
+
     def map_values(self, f: Callable):
         if not self.is_pair:
             raise VegaError("map_values on non-pair DenseRDD")
@@ -738,6 +777,158 @@ class _FilterRDD(_NarrowRDD):
         return kernels.compact(cols, keep, cap)
 
 
+class _MapExpandRDD(_NarrowRDD):
+    """Fixed-factor row expansion: vmapped f gives [n, factor] outputs which
+    interleave into factor*capacity rows, compacted to valid prefix."""
+
+    def __init__(self, parent: DenseRDD, f, factor: int):
+        if factor <= 0:
+            raise VegaError("map_expand factor must be positive")
+        in_struct = _row_struct(parent._schema())
+        try:
+            out = jax.eval_shape(f, in_struct)
+        except Exception as e:  # noqa: BLE001
+            raise _NotTraceable(str(e)) from e
+        if isinstance(out, tuple) and len(out) == 2:
+            if any(s.shape != (factor,) for s in out):
+                raise _NotTraceable(
+                    f"map_expand fn must return shape ({factor},) arrays"
+                )
+            schema = ((KEY, out[0].dtype), (VALUE, out[1].dtype))
+        elif hasattr(out, "shape"):
+            if out.shape != (factor,):
+                raise _NotTraceable(
+                    f"map_expand fn must return a ({factor},) array"
+                )
+            schema = ((VALUE, out.dtype),)
+        else:
+            raise _NotTraceable(f"unsupported map_expand output: {out}")
+        super().__init__(parent, schema)
+        self._f = f
+        self._factor = factor
+        self._user_fn = (f, factor)
+
+    def _materialize(self) -> Block:
+        # Expansion changes capacity; run as its own program (not chained).
+        parent_blk = self.parent.block()
+        names_in = list(parent_blk.cols)
+        out_names = [n for n, _ in self._out_schema]
+        factor = self._factor
+        cap_in = parent_blk.capacity
+        cap_out = block_lib._round_capacity(cap_in * factor)
+        f = self._f
+        in_schema = self.parent._schema()
+
+        def prog_fn(counts, *col_arrays):
+            cols = dict(zip(names_in, col_arrays))
+            count = counts[0]
+            args = _cols_to_row(cols, in_schema)
+            out = jax.vmap(f)(args)  # leaves [cap_in, factor]
+            if not isinstance(out, tuple):
+                out = (out,)
+            flat = {
+                name: jnp.pad(o.reshape(-1), (0, cap_out - cap_in * factor))
+                for name, o in zip(out_names, out)
+            }
+            idx = lax.iota(jnp.int32, cap_out)
+            keep = idx < count * factor
+            res, new_count = kernels.compact(flat, keep, cap_out)
+            return (new_count.reshape(1),) + tuple(res[n] for n in out_names)
+
+        key = ("map_expand", self.mesh, _fp(self._user_fn), cap_in, factor)
+        prog = _cached_program(
+            key,
+            lambda: _shard_program(
+                self.mesh, prog_fn, 1 + len(names_in),
+                (_SPEC,) * (1 + len(out_names)),
+            ),
+        )
+        outs = prog(parent_blk.counts,
+                    *[parent_blk.cols[n] for n in names_in])
+        return Block(cols=dict(zip(out_names, outs[1:])), counts=outs[0],
+                     capacity=cap_out, mesh=self.mesh)
+
+    def _shard_fn(self, cols, count):  # not chained; materialize overrides
+        raise NotImplementedError
+
+
+class _ZipWithIndexRDD(DenseRDD):
+    def __init__(self, parent: DenseRDD):
+        super().__init__(parent.context, parent.mesh, [parent])
+        self.parent = parent
+
+    def _schema(self):
+        pschema = dict(self.parent._schema())
+        return ((KEY, pschema[VALUE]), (VALUE, jnp.int32))
+
+    def _materialize(self) -> Block:
+        blk = self.parent.block()
+        counts_host = np.asarray(jax.device_get(blk.counts))
+        offsets = np.concatenate(
+            [[0], np.cumsum(counts_host)[:-1]]
+        ).astype(np.int32)
+        offsets_dev = jnp.asarray(offsets)
+
+        def prog_fn(offsets, counts, vals):
+            shard_off = offsets[0]
+            positions = shard_off + lax.iota(jnp.int32, vals.shape[0])
+            return counts.reshape(1), vals, positions
+
+        prog = _cached_program(
+            ("zip_index", self.mesh, blk.capacity),
+            lambda: _shard_program(self.mesh, prog_fn, 3, (_SPEC,) * 3),
+        )
+        counts, vals, pos = prog(offsets_dev, blk.counts, blk.cols[VALUE])
+        return Block(cols={KEY: vals, VALUE: pos}, counts=counts,
+                     capacity=blk.capacity, mesh=self.mesh)
+
+    def collect(self) -> list:
+        cols = self.block().to_numpy()
+        return list(zip(cols[KEY].tolist(), cols[VALUE].tolist()))
+
+
+class _DenseZipRDD(DenseRDD):
+    """Pairwise zip of co-indexed shards: (left value, right value). Shard
+    counts must match (host semantics raise otherwise,
+    reference: zip_rdd.rs:119-150)."""
+
+    def __init__(self, left: DenseRDD, right: DenseRDD):
+        super().__init__(left.context, left.mesh, [left, right])
+        self.left = left
+        self.right = right
+
+    def _schema(self):
+        l = dict(self.left._schema())
+        r = dict(self.right._schema())
+        return ((KEY, l[VALUE]), (VALUE, r[VALUE]))
+
+    def _materialize(self) -> Block:
+        lb = self.left.block()
+        rb = self.right.block()
+        lc = np.asarray(jax.device_get(lb.counts))
+        rc = np.asarray(jax.device_get(rb.counts))
+        if not np.array_equal(lc, rc):
+            raise VegaError(
+                "dense zip requires equal per-shard counts; repartition or "
+                "use .to_rdd().zip(...)"
+            )
+        cap = max(lb.capacity, rb.capacity)
+
+        def prog_fn(counts, lv, rv):
+            pad_l = cap - lv.shape[0]
+            pad_r = cap - rv.shape[0]
+            return (counts.reshape(1),
+                    jnp.pad(lv, (0, pad_l)), jnp.pad(rv, (0, pad_r)))
+
+        prog = _cached_program(
+            ("dense_zip", self.mesh, lb.capacity, rb.capacity),
+            lambda: _shard_program(self.mesh, prog_fn, 3, (_SPEC,) * 3),
+        )
+        counts, lv, rv = prog(lb.counts, lb.cols[VALUE], rb.cols[VALUE])
+        return Block(cols={KEY: lv, VALUE: rv}, counts=counts, capacity=cap,
+                     mesh=self.mesh)
+
+
 class _SelectRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, names):
         pschema = dict(parent._schema())
@@ -816,6 +1007,9 @@ def dense_from_columns(ctx, columns: Optional[dict] = None,
             if name in named:
                 raise VegaError(f"duplicate column {name!r}")
             named[name] = np.asarray(col)
+    lengths = {name: len(col) for name, col in named.items()}
+    if len(set(lengths.values())) > 1:
+        raise VegaError(f"columns have unequal lengths: {lengths}")
     if key is not None:
         if key not in named:
             raise VegaError(f"key column {key!r} not in columns")
