@@ -169,6 +169,13 @@ class Context:
 
         return dense_from_columns(self, columns, key=key, **kwcolumns)
 
+    def dense_load_npz(self, path: str):
+        """Reload a DenseRDD persisted with save_npz (re-sharded onto the
+        current mesh)."""
+        from vega_tpu.tpu.dense_rdd import dense_load_npz
+
+        return dense_load_npz(self, path)
+
     def profiler(self, log_dir: str):
         """JAX profiler trace over a block of work (the tracing subsystem
         the reference never built — SURVEY.md §5 'Tracing: none'). View with

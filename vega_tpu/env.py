@@ -68,6 +68,13 @@ class Configuration:
     # Cluster membership file for distributed mode (reference: ~/hosts.conf,
     # src/hosts.rs); None -> VEGA_TPU_HOSTS_FILE -> ~/hosts.conf -> local.
     hosts_file: Optional[str] = None
+    # Speculative execution (straggler mitigation; the reference has none):
+    # when a stage has completions and a pending task has run longer than
+    # max(speculation_min_s, speculation_multiplier * median), launch a
+    # duplicate; first completion wins (tasks are idempotent).
+    speculation: bool = False
+    speculation_multiplier: float = 3.0
+    speculation_min_s: float = 1.0
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -84,9 +91,14 @@ class Configuration:
                      "CACHE_CAPACITY_BYTES", "MAX_FAILURES"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
-        for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY"):
+        for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
+                     "SPECULATION"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
+        for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",
+                     "SPECULATION_MULTIPLIER", "SPECULATION_MIN_S"):
+            if env.get(pref + name):
+                setattr(cfg, name.lower(), float(env[pref + name]))
         return cfg
 
 

@@ -76,6 +76,10 @@ class _Job:
         self.pending_tasks: Dict[int, Set[int]] = {}  # stage_id -> partitions
         self.task_attempts: Dict[tuple, int] = {}  # (stage_id, partition) -> tries
         self.last_fetch_failure: float = 0.0
+        # speculation bookkeeping
+        self.inflight: Dict[tuple, tuple] = {}  # (stage,part) -> (task, t0)
+        self.durations: Dict[int, List[float]] = {}  # stage_id -> task secs
+        self.speculated: Set[tuple] = set()
 
 
 class DAGScheduler:
@@ -281,6 +285,9 @@ class DAGScheduler:
             for task in tasks:
                 pending.add(task.partition)
             for task in tasks:
+                job.inflight[(task.stage_id, task.partition)] = (
+                    task, time.time()
+                )
                 self._submit_task(task, event_queue)
 
         def stage_of(task: Task) -> Optional[Stage]:
@@ -306,8 +313,14 @@ class DAGScheduler:
             else:  # ShuffleMapTask
                 if stage is None:
                     return
-                stage.add_output_loc(task.partition, event.result)
                 pending = job.pending_tasks.get(stage.id)
+                if pending is not None and task.partition not in pending:
+                    # Duplicate completion (speculative copy or late
+                    # straggler): the first one already drained this
+                    # partition — ignore to keep output_locs, tracker
+                    # registration, and StageCompleted single-shot.
+                    return
+                stage.add_output_loc(task.partition, event.result)
                 if pending is not None:
                     pending.discard(task.partition)
                 if pending is not None and not pending:
@@ -319,6 +332,17 @@ class DAGScheduler:
             reference lacks."""
             task = event.task
             err = event.error
+            # A failure for a partition that already succeeded (its
+            # speculative twin or the straggler itself losing the race) is
+            # not a failure of the job — ignore it.
+            if isinstance(task, ResultTask) and job.finished[task.output_id]:
+                return
+            if isinstance(task, ShuffleMapTask):
+                stage = stage_of(task)
+                pending = job.pending_tasks.get(task.stage_id)
+                if (stage is not None and pending is not None
+                        and task.partition not in pending):
+                    return
             if isinstance(err, FetchFailedError):
                 log.info("fetch failure: %s", err)
                 map_stage = self._shuffle_to_map_stage.get(err.shuffle_id)
@@ -349,6 +373,10 @@ class DAGScheduler:
                 log.warning("task %s failed (attempt %d/%d): %s",
                             task, tries, conf_max, err)
                 task.attempt = tries
+                # Retries rejoin the inflight map so speculation can still
+                # cover a straggling retry.
+                job.inflight[key] = (task, time.time())
+                job.speculated.discard(key)
                 self._submit_task(task, event_queue)
             else:
                 raise TaskError(
@@ -363,17 +391,24 @@ class DAGScheduler:
                     event = event_queue.get(timeout=conf.poll_timeout_s)
                 except queue.Empty:
                     self._maybe_resubmit_failed(job, submit_stage, conf)
+                    self._maybe_speculate(job, conf, event_queue)
                     continue
                 self.bus.post(ev.TaskEnd(
                     task_id=event.task.task_id, stage_id=event.task.stage_id,
                     partition=event.task.partition, success=event.success,
                     duration_s=event.duration_s,
                 ))
+                key = (event.task.stage_id, event.task.partition)
+                job.inflight.pop(key, None)
                 if event.success:
+                    job.durations.setdefault(
+                        event.task.stage_id, []
+                    ).append(event.duration_s)
                     on_success(event)
                 else:
                     on_failure(event)
                 self._maybe_resubmit_failed(job, submit_stage, conf)
+                self._maybe_speculate(job, conf, event_queue)
             self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=True,
                                     duration_s=time.time() - t_start))
             return job.results
@@ -432,6 +467,28 @@ class DAGScheduler:
         log.info("resubmitting failed stages: %s", to_retry)
         for stage in to_retry:
             submit_stage(stage)
+
+    def _maybe_speculate(self, job: _Job, conf, event_queue) -> None:
+        """Straggler mitigation (opt-in; absent from the reference): when a
+        pending task has run far beyond the stage's median task duration,
+        launch one duplicate — completions are idempotent, first wins."""
+        if not getattr(conf, "speculation", False):
+            return
+        now = time.time()
+        for key, (task, t0) in list(job.inflight.items()):
+            if key in job.speculated:
+                continue
+            durs = job.durations.get(key[0])
+            if not durs:
+                continue
+            median = sorted(durs)[len(durs) // 2]
+            threshold = max(conf.speculation_min_s,
+                            conf.speculation_multiplier * median)
+            if now - t0 > threshold:
+                job.speculated.add(key)
+                log.info("speculating duplicate of %s (%.2fs > %.2fs)",
+                         task, now - t0, threshold)
+                self.backend.submit(task, event_queue.put)
 
     def _submit_task(self, task: Task,
                      event_queue: "queue.Queue[TaskEndEvent]") -> None:

@@ -583,6 +583,28 @@ class DenseRDD(RDD):
         ))
         return edges, parts.sum(axis=0).tolist()
 
+    def save_npz(self, path: str) -> str:
+        """Persist the materialized block to an .npz (columns + counts +
+        capacity) — the dense analogue of checkpoint(): reloading with
+        ctx.dense_load_npz() re-sources the data with no lineage. One file;
+        shard layout is reconstructed on load for the current mesh."""
+        import os as _os
+
+        if type(self).collect is not DenseRDD.collect:
+            raise VegaError(
+                "save_npz persists raw columns; this RDD's elements are "
+                "derived from them (grouped/joined) — save an upstream RDD "
+                "or materialize via collect()/to_rdd() instead"
+            )
+        blk = self.block()
+        cols = blk.to_numpy()  # valid rows only, shard order
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:  # file object: savez keeps the exact name
+            np.savez(f, **cols)
+        _os.replace(tmp, path)
+        return path
+
     def take(self, n: int) -> list:
         # Pull shard by shard until satisfied; avoids full collect.
         out = []
@@ -882,10 +904,6 @@ class _ZipWithIndexRDD(DenseRDD):
         return Block(cols={KEY: vals, VALUE: pos}, counts=counts,
                      capacity=blk.capacity, mesh=self.mesh)
 
-    def collect(self) -> list:
-        cols = self.block().to_numpy()
-        return list(zip(cols[KEY].tolist(), cols[VALUE].tolist()))
-
 
 class _DenseZipRDD(DenseRDD):
     """Pairwise zip of co-indexed shards: (left value, right value). Shard
@@ -1024,6 +1042,17 @@ def dense_from_columns(ctx, columns: Optional[dict] = None,
 
 
 def dense_from_block(ctx, blk: Block) -> DenseRDD:
+    return _SourceRDD(ctx, blk)
+
+
+def dense_load_npz(ctx, path: str) -> DenseRDD:
+    """Load a block persisted with DenseRDD.save_npz; data is re-sharded
+    over the current default mesh (so a block saved on one topology loads
+    onto another — the persistence story the reference lacks entirely,
+    SURVEY.md §5 'Checkpoint/resume: none')."""
+    with np.load(path, allow_pickle=False) as data:
+        cols = {n: data[n] for n in data.files}
+    blk = block_lib.from_numpy(cols, mesh_lib.default_mesh())
     return _SourceRDD(ctx, blk)
 
 
