@@ -337,3 +337,10 @@ def test_save_as_text_file(ctx, tmp_path):
 def test_to_local_iterator(ctx):
     rdd = ctx.make_rdd(list(range(10)), 3)
     assert list(rdd.to_local_iterator()) == list(range(10))
+
+
+def test_count_approx_distinct(ctx):
+    rdd = ctx.make_rdd([i % 5_000 for i in range(20_000)], 4)
+    est = rdd.count_approx_distinct(0.05)
+    assert abs(est - 5_000) / 5_000 < 0.05
+    assert ctx.parallelize([], 2).count_approx_distinct() == 0

@@ -145,3 +145,27 @@ def test_poisson_sampler_statistics():
     items = list(range(10000))
     sampled = list(PoissonSampler(2.0, seed=3).sample(iter(items), 1))
     assert 19000 <= len(sampled) <= 21000
+
+
+def test_hyperloglog_accuracy():
+    from vega_tpu.utils.hll import HyperLogLog
+
+    hll = HyperLogLog(14)
+    n = 50_000
+    for i in range(n):
+        hll.add(i)
+    est = hll.estimate()
+    assert abs(est - n) / n < 0.03
+    # merging partial sketches equals one big sketch
+    a, b = HyperLogLog(14), HyperLogLog(14)
+    for i in range(0, n, 2):
+        a.add(i)
+    for i in range(1, n, 2):
+        b.add(i)
+    a.merge_registers(b.registers)
+    assert abs(a.estimate() - est) / n < 0.01
+    # small-range linear counting is near-exact
+    small = HyperLogLog(14)
+    for i in range(100):
+        small.add(f"item-{i}")
+    assert abs(small.estimate() - 100) <= 2

@@ -71,7 +71,10 @@ class Configuration:
     # Speculative execution (straggler mitigation; the reference has none):
     # when a stage has completions and a pending task has run longer than
     # max(speculation_min_s, speculation_multiplier * median), launch a
-    # duplicate; first completion wins (tasks are idempotent).
+    # duplicate; first completion wins. NOTE: like task retries, this gives
+    # at-least-once semantics for user side effects (for_each etc.) —
+    # framework-owned writes (save_as_text_file, shuffle buckets) are
+    # duplicate-safe.
     speculation: bool = False
     speculation_multiplier: float = 3.0
     speculation_min_s: float = 1.0

@@ -298,31 +298,33 @@ class RDD(PairOpsMixin):
         return CartesianRDD(self.context, self, other)
 
     def distinct(self, num_partitions: Optional[int] = None):
-        """Reference: rdd.rs:525-532 (map to (x,None) -> reduce_by_key)."""
+        """Reference: rdd.rs:525-532 (map to (x, sentinel) -> reduce_by_key).
+        The sentinel is 0 (not None) so integer items ride the native C++
+        combine path."""
         n = num_partitions or self.num_partitions
         return (
-            self.map(lambda x: (x, None))
-            .reduce_by_key(lambda a, _b: a, n)
+            self.map(lambda x: (x, 0))
+            .reduce_by_key(min, n)
             .keys()
         )
 
     def intersection(self, other: "RDD", num_partitions: Optional[int] = None):
         """Reference: rdd.rs:831-841."""
         n = num_partitions or max(self.num_partitions, other.num_partitions)
-        left = self.map(lambda x: (x, None))
-        right = other.map(lambda x: (x, None))
+        left = self.map(lambda x: (x, 0))
+        right = other.map(lambda x: (x, 0))
 
         def emit(groups):
             l, r = groups
-            return [None] if l and r else []
+            return [0] if l and r else []
 
         return left.cogroup(right, partitioner_or_num=n).flat_map_values(emit).keys()
 
     def subtract(self, other: "RDD", num_partitions: Optional[int] = None):
         """Reference: rdd.rs:843-865."""
         n = num_partitions or max(self.num_partitions, other.num_partitions)
-        left = self.map(lambda x: (x, None))
-        right = other.map(lambda x: (x, None))
+        left = self.map(lambda x: (x, 0))
+        right = other.map(lambda x: (x, 0))
         return left.subtract_by_key(right, partitioner_or_num=n).keys()
 
     def sort_by(self, key_func: Callable, ascending: bool = True,
@@ -494,10 +496,15 @@ class RDD(PairOpsMixin):
         os.makedirs(path, exist_ok=True)
 
         def write(tc, it):
+            # Write-then-rename: task retries and speculative duplicates can
+            # run concurrently; each writes its own temp file and the rename
+            # is atomic, so the part file is always one complete attempt.
             out = os.path.join(path, f"part-{tc.split_index:05d}")
-            with open(out, "w") as f:
+            tmp = f"{out}.attempt-{tc.attempt_id}-{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
                 for x in it:
                     f.write(f"{x}\n")
+            os.replace(tmp, out)
 
         self.context.run_job(self, write)
 
@@ -661,6 +668,25 @@ class RDD(PairOpsMixin):
         return self.context.run_approximate_job(
             self, sum_partition, evaluator, timeout_s
         )
+
+    def count_approx_distinct(self, relative_sd: float = 0.05) -> int:
+        """HyperLogLog distinct count (Spark parity; absent from the
+        reference). One pass; per-partition register arrays merged on the
+        driver (utils/hll.py)."""
+        from vega_tpu.utils.hll import HyperLogLog
+
+        p = HyperLogLog.precision_for(relative_sd)
+
+        def sketch_partition(_tc, it):
+            hll = HyperLogLog(p)
+            for x in it:
+                hll.add(x)
+            return hll.registers
+
+        merged = HyperLogLog(p)
+        for registers in self.context.run_job(self, sketch_partition):
+            merged.merge_registers(registers)
+        return merged.estimate()
 
     # ------------------------------------------------------------------- misc
     def id(self) -> int:

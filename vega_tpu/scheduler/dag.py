@@ -78,8 +78,10 @@ class _Job:
         self.last_fetch_failure: float = 0.0
         # speculation bookkeeping
         self.inflight: Dict[tuple, tuple] = {}  # (stage,part) -> (task, t0)
+        self.outstanding: Dict[tuple, int] = {}  # (stage,part) -> live copies
         self.durations: Dict[int, List[float]] = {}  # stage_id -> task secs
         self.speculated: Set[tuple] = set()
+        self.last_speculation_sweep: float = 0.0
 
 
 class DAGScheduler:
@@ -285,9 +287,9 @@ class DAGScheduler:
             for task in tasks:
                 pending.add(task.partition)
             for task in tasks:
-                job.inflight[(task.stage_id, task.partition)] = (
-                    task, time.time()
-                )
+                tkey = (task.stage_id, task.partition)
+                job.inflight[tkey] = (task, time.time())
+                job.outstanding[tkey] = job.outstanding.get(tkey, 0) + 1
                 self._submit_task(task, event_queue)
 
         def stage_of(task: Task) -> Optional[Stage]:
@@ -366,6 +368,11 @@ class DAGScheduler:
                 job.last_fetch_failure = time.time()
                 return
             key = (task.stage_id, task.partition)
+            if job.outstanding.get(key, 0) > 0:
+                # Another copy of this task (speculative twin or an earlier
+                # retry) is still running — let it decide the partition's
+                # fate instead of stacking more attempts.
+                return
             tries = job.task_attempts.get(key, 0) + 1
             job.task_attempts[key] = tries
             conf_max = Env.get().conf.max_failures
@@ -376,6 +383,7 @@ class DAGScheduler:
                 # Retries rejoin the inflight map so speculation can still
                 # cover a straggling retry.
                 job.inflight[key] = (task, time.time())
+                job.outstanding[key] = job.outstanding.get(key, 0) + 1
                 job.speculated.discard(key)
                 self._submit_task(task, event_queue)
             else:
@@ -399,7 +407,9 @@ class DAGScheduler:
                     duration_s=event.duration_s,
                 ))
                 key = (event.task.stage_id, event.task.partition)
-                job.inflight.pop(key, None)
+                job.outstanding[key] = max(0, job.outstanding.get(key, 1) - 1)
+                if job.outstanding[key] == 0:
+                    job.inflight.pop(key, None)
                 if event.success:
                     job.durations.setdefault(
                         event.task.stage_id, []
@@ -475,17 +485,24 @@ class DAGScheduler:
         if not getattr(conf, "speculation", False):
             return
         now = time.time()
+        # Sweep at most ~10x/sec and compute each stage's median once —
+        # per-key sorting would be O(inflight x completions log completions)
+        # on the single driver thread.
+        if now - job.last_speculation_sweep < 0.1:
+            return
+        job.last_speculation_sweep = now
+        medians: Dict[int, float] = {}
+        for stage_id, durs in job.durations.items():
+            if durs:
+                medians[stage_id] = sorted(durs)[len(durs) // 2]
         for key, (task, t0) in list(job.inflight.items()):
-            if key in job.speculated:
+            if key in job.speculated or key[0] not in medians:
                 continue
-            durs = job.durations.get(key[0])
-            if not durs:
-                continue
-            median = sorted(durs)[len(durs) // 2]
             threshold = max(conf.speculation_min_s,
-                            conf.speculation_multiplier * median)
+                            conf.speculation_multiplier * medians[key[0]])
             if now - t0 > threshold:
                 job.speculated.add(key)
+                job.outstanding[key] = job.outstanding.get(key, 0) + 1
                 log.info("speculating duplicate of %s (%.2fs > %.2fs)",
                          task, now - t0, threshold)
                 self.backend.submit(task, event_queue.put)

@@ -584,8 +584,8 @@ class DenseRDD(RDD):
         return edges, parts.sum(axis=0).tolist()
 
     def save_npz(self, path: str) -> str:
-        """Persist the materialized block to an .npz (columns + counts +
-        capacity) — the dense analogue of checkpoint(): reloading with
+        """Persist the materialized block's valid rows as one .npz of
+        column arrays — the dense analogue of checkpoint(): reloading with
         ctx.dense_load_npz() re-sources the data with no lineage. One file;
         shard layout is reconstructed on load for the current mesh."""
         import os as _os
