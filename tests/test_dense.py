@@ -409,3 +409,28 @@ def test_dense_save_load_npz(dctx, tmp_path):
     doubled = dict(reloaded.map_values(lambda x: x * 2)
                    .reduce_by_key(op="add").collect())
     assert doubled == {k: 2 * val for k, val in agg.collect()}
+
+
+def test_dense_left_outer_join(dctx):
+    left = dctx.dense_from_numpy(np.array([1, 2, 3, 4], dtype=np.int32),
+                                 np.array([10, 20, 30, 40], dtype=np.int32))
+    right = dctx.dense_from_numpy(np.array([2, 4], dtype=np.int32),
+                                  np.array([200, 400], dtype=np.int32))
+    j = sorted(left.left_outer_join(right, fill_value=-1).collect())
+    assert j == [(1, (10, -1)), (2, (20, 200)), (3, (30, -1)), (4, (40, 400))]
+    # dup right -> cogroup fallback keeps outer semantics
+    dup = dctx.dense_from_numpy(np.array([2, 2], dtype=np.int32),
+                                np.array([5, 6], dtype=np.int32))
+    j2 = sorted(left.left_outer_join(dup, fill_value=0).collect())
+    assert j2 == [(1, (10, 0)), (2, (20, 5)), (2, (20, 6)),
+                  (3, (30, 0)), (4, (40, 0))]
+
+
+def test_dense_int64_out_of_range_rejected(dctx):
+    with pytest.raises(v.VegaError):
+        dctx.dense_from_numpy(np.array([2**40, 1], dtype=np.int64),
+                              np.array([1, 2], dtype=np.int64))
+    # in-range int64 narrows safely
+    r = dctx.dense_from_numpy(np.array([5, 6], dtype=np.int64),
+                              np.array([50, 60], dtype=np.int64))
+    assert sorted(r.collect()) == [(5, 50), (6, 60)]

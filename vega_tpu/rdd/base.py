@@ -497,10 +497,14 @@ class RDD(PairOpsMixin):
 
         def write(tc, it):
             # Write-then-rename: task retries and speculative duplicates can
-            # run concurrently; each writes its own temp file and the rename
-            # is atomic, so the part file is always one complete attempt.
+            # run concurrently (same attempt id, possibly same pid when the
+            # backend is thread-based) — a uuid makes each writer's temp
+            # file unique and the rename atomic, so the part file is always
+            # one complete attempt.
+            import uuid
+
             out = os.path.join(path, f"part-{tc.split_index:05d}")
-            tmp = f"{out}.attempt-{tc.attempt_id}-{os.getpid()}.tmp"
+            tmp = f"{out}.{uuid.uuid4().hex[:12]}.tmp"
             with open(tmp, "w") as f:
                 for x in it:
                     f.write(f"{x}\n")

@@ -84,6 +84,34 @@ def _round_capacity(c: int) -> int:
     return -(-c // step) * step
 
 
+def _check_dtype(name: str, src: np.ndarray) -> np.ndarray:
+    """Without jax x64, 64-bit inputs silently narrow to 32-bit on
+    device_put. Narrowing int keys/values beyond int32 range would silently
+    corrupt (key collisions, wrong sums) — refuse loudly; floats narrow with
+    precision loss, which is the documented dtype contract."""
+    import jax as _jax
+
+    if _jax.config.read("jax_enable_x64"):
+        return src
+    if src.dtype in (np.int64, np.uint64):
+        narrow = np.uint32 if src.dtype == np.uint64 else np.int32
+        info = np.iinfo(narrow)
+        if len(src) and (src.min() < info.min or src.max() > info.max):
+            from vega_tpu.errors import VegaError
+
+            raise VegaError(
+                f"column {name!r} has {src.dtype} values outside "
+                f"{np.dtype(narrow)} range and jax x64 is disabled — values "
+                "would silently collide. Enable x64 "
+                "(jax.config.update('jax_enable_x64', True)) or use the "
+                "host tier for this data."
+            )
+        return src.astype(narrow)
+    if src.dtype == np.float64:
+        return src.astype(np.float32)
+    return src
+
+
 def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
                capacity: Optional[int] = None) -> Block:
     """Build a row-sharded Block from host columns (equal lengths)."""
@@ -96,7 +124,7 @@ def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
     counts = np.zeros(n_shards, dtype=np.int32)
     cols = {}
     for name in names:
-        src = np.asarray(columns[name])
+        src = _check_dtype(name, np.asarray(columns[name]))
         dst = np.zeros((n_shards * cap,) + src.shape[1:], dtype=src.dtype)
         for s in range(n_shards):
             lo, hi = s * per, min((s + 1) * per, n)

@@ -279,9 +279,35 @@ class DenseRDD(RDD):
         """Device sort-merge join (right side unique keys). Falls back to the
         host cogroup-based join when `other` is not dense or right keys are
         not unique (checked on device, cheap)."""
-        if isinstance(other, DenseRDD) and self.is_pair and other.is_pair:
+        if self._dense_joinable(other, partitioner_or_num):
             return _with_exchange(_JoinRDD(self, other), exchange)
         return super().join(other, partitioner_or_num)
+
+    def _dense_joinable(self, other, partitioner_or_num) -> bool:
+        """Same preconditions as the dense cogroup: both dense pairs, no
+        explicit partitioner request, one mesh (mismatched meshes would pair
+        unrelated shards)."""
+        return (isinstance(other, DenseRDD) and self.is_pair and other.is_pair
+                and partitioner_or_num is None and other.mesh == self.mesh)
+
+    def left_outer_join(self, other, partitioner_or_num=None,
+                        fill_value=0, exchange: Optional[str] = None):
+        """Device left-outer join (right side unique keys): unmatched left
+        rows keep fill_value in the right column (None is not representable
+        in a dense column — host semantics with None come via
+        .to_rdd().left_outer_join(...)). The host fallback also honors
+        fill_value so results don't depend on which path ran."""
+        if self._dense_joinable(other, partitioner_or_num):
+            return _with_exchange(
+                _JoinRDD(self, other, outer=True, fill_value=fill_value),
+                exchange,
+            )
+        joined = super().left_outer_join(other, partitioner_or_num)
+        if fill_value is None:
+            return joined
+        return joined.map_values(
+            lambda pair: (pair[0], fill_value if pair[1] is None else pair[1])
+        )
 
     def cogroup(self, *others, partitioner_or_num=None):
         """Dense-dense cogroup: both sides exchange + sort on device (hash
@@ -1297,10 +1323,13 @@ class _DupRightKeys(Exception):
 
 
 class _JoinRDD(_ExchangeRDD):
-    def __init__(self, left: DenseRDD, right: DenseRDD):
+    def __init__(self, left: DenseRDD, right: DenseRDD,
+                 outer: bool = False, fill_value=0):
         super().__init__(left.context, left.mesh, [left, right])
         self.left = left
         self.right = right
+        self.outer = outer
+        self.fill_value = fill_value
         self._host_fallback = None
 
     def _schema(self):
@@ -1333,7 +1362,8 @@ class _JoinRDD(_ExchangeRDD):
                     rcols, rcount, rb, n, slot_pair, out_cap
                 )
                 joined, jcount, dup = kernels.merge_join_unique_right(
-                    lcols, lcount, rcols, rcount, KEY, out_cap
+                    lcols, lcount, rcols, rcount, KEY, out_cap,
+                    outer=self.outer, fill_value=self.fill_value,
                 )
                 return (
                     jcount.reshape(1), joined[KEY], joined[VALUE],
@@ -1343,7 +1373,7 @@ class _JoinRDD(_ExchangeRDD):
 
             prog = _cached_program(
                 ("join", self.mesh, n, slot_pair, out_cap,
-                 self.exchange_mode),
+                 self.exchange_mode, self.outer, self.fill_value),
                 lambda: _shard_program(self.mesh, prog_fn, 6, (_SPEC,) * 6),
             )
             return prog, (
@@ -1367,9 +1397,13 @@ class _JoinRDD(_ExchangeRDD):
         # (reference: pair_rdd.rs:104-121).
         if self._host_fallback is None:
             cg = _DenseCoGroupRDD(self.left, self.right)
+            outer = self.outer
+            fill = self.fill_value
 
             def emit(groups):
                 lvs, rvs = groups
+                if outer and not rvs:
+                    return [(lv, fill) for lv in lvs]
                 return [(lv, rv) for lv in lvs for rv in rvs]
 
             self._host_fallback = cg.flat_map_values(emit)

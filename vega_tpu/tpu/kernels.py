@@ -281,10 +281,14 @@ def merge_join_unique_right(
     right: Cols, right_count: jax.Array,
     key_name: str,
     out_capacity: int,
+    outer: bool = False,
+    fill_value: float = 0,
 ) -> Tuple[Cols, jax.Array]:
-    """Inner join, right side must have unique keys (probe via binary search).
-    Output = every matching left row + the matched right value columns;
-    static shapes end-to-end (output <= left capacity).
+    """Join with unique right keys (probe via binary search). Inner
+    (outer=False): every matching left row + matched right columns. Left
+    outer (outer=True): every valid left row; unmatched rows get fill_value
+    in the right columns. Static shapes end-to-end (output <= left
+    capacity).
 
     The general dup x dup case routes through group-exchange + host (or the
     device cogroup), matching the reference's CoGroupedRDD semantics."""
@@ -309,8 +313,14 @@ def merge_join_unique_right(
     for name, col in right.items():
         if name == key_name:
             continue
-        out[f"r_{name}"] = jnp.take(col, pos, axis=0)
-    cols, count = compact(out, matched, out_capacity)
+        taken = jnp.take(col, pos, axis=0)
+        if outer:
+            fill = jnp.asarray(fill_value, dtype=col.dtype)
+            m = matched.reshape(matched.shape + (1,) * (taken.ndim - 1))
+            taken = jnp.where(m, taken, fill)
+        out[f"r_{name}"] = taken
+    keep = lmask if outer else matched
+    cols, count = compact(out, keep, out_capacity)
     return cols, count, dup_right
 
 
