@@ -1,0 +1,23 @@
+"""Examples stay runnable (the reference ships examples/ as its de-facto
+acceptance suite; these run the fast ones end-to-end as subprocesses)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAST_EXAMPLES = ["make_rdd.py", "subtract.py", "file_read.py"]
+
+
+@pytest.mark.parametrize("example", FAST_EXAMPLES)
+def test_example_runs(example):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", example)],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert result.stdout.strip()

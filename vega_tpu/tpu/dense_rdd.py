@@ -297,30 +297,39 @@ class DenseRDD(RDD):
         in a dense column — host semantics with None come via
         .to_rdd().left_outer_join(...)). The host fallback also honors
         fill_value so results don't depend on which path ran."""
-        if self._dense_joinable(other, partitioner_or_num):
+        if fill_value is not None and \
+                self._dense_joinable(other, partitioner_or_num):
             return _with_exchange(
                 _JoinRDD(self, other, outer=True, fill_value=fill_value),
                 exchange,
             )
-        joined = super().left_outer_join(other, partitioner_or_num)
         if fill_value is None:
-            return joined
-        return joined.map_values(
-            lambda pair: (pair[0], fill_value if pair[1] is None else pair[1])
-        )
+            # Host None semantics (a dense column can't hold None).
+            return super().left_outer_join(other, partitioner_or_num)
+        # Host fallback with fill: emit per GROUP so a legitimate None right
+        # value is never conflated with "unmatched" (same contract as the
+        # dup-right fallback in _JoinRDD._host_join).
+
+        def emit(groups):
+            lvs, rvs = groups
+            if not rvs:
+                return [(lv, fill_value) for lv in lvs]
+            return [(lv, rv) for lv in lvs for rv in rvs]
+
+        return self.cogroup(
+            other, partitioner_or_num=partitioner_or_num
+        ).flat_map_values(emit)
 
     def cogroup(self, *others, partitioner_or_num=None):
         """Dense-dense cogroup: both sides exchange + sort on device (hash
         placement is shared, so co-keyed rows land on the same shard); only
         the ragged (k, ([lvs], [rvs])) assembly happens on the host.
         Reference semantics: pair_rdd.rs:123-155 / co_grouped_rdd.rs."""
-        if (len(others) == 1 and isinstance(others[0], DenseRDD)
-                and self.is_pair and others[0].is_pair
-                and partitioner_or_num is None
-                and others[0].mesh == self.mesh):
+        if len(others) == 1 and self._dense_joinable(others[0],
+                                                     partitioner_or_num):
             # An explicit partitioner request or a mesh mismatch must honor
             # host-path semantics (and mismatched meshes would pair
-            # unrelated shards) — fall through to the host cogroup.
+            # unrelated shards) — those fall through to the host cogroup.
             return _DenseCoGroupRDD(self, others[0])
         return super().cogroup(*others, partitioner_or_num=partitioner_or_num)
 
