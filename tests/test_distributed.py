@@ -91,3 +91,26 @@ def test_hosts_file_drives_membership(tmp_path):
         assert total == 30
     finally:
         context.stop()
+
+
+def test_executor_session_logs(tmp_path):
+    """Executors write per-session log files at the driver's configured
+    level (propagated via --log-level)."""
+    import glob
+    import os
+
+    os.environ["VEGA_TPU_LOCAL_DIR"] = str(tmp_path)
+    try:
+        context = v.Context("distributed", num_workers=2,
+                            local_dir=str(tmp_path), log_level="INFO",
+                            log_cleanup=False)
+        try:
+            context.parallelize(list(range(10)), 4).count()
+        finally:
+            context.stop()
+    finally:
+        del os.environ["VEGA_TPU_LOCAL_DIR"]
+    exec_logs = glob.glob(str(tmp_path / "session-*" / "executor-*.log"))
+    assert len(exec_logs) >= 2
+    driver_logs = glob.glob(str(tmp_path / "session-*" / "driver.log"))
+    assert driver_logs

@@ -245,3 +245,29 @@ def test_speculation_duplicate_completion_on_shuffle_stage():
         assert dict(pairs.reduce_by_key(lambda a, b: a + b, 4).collect()) == result
     finally:
         context.stop()
+
+
+def test_session_log_file(tmp_path):
+    """Per-session driver log file (reference: ns-driver.log), removed on
+    stop when log_cleanup is set."""
+    import glob
+    import logging
+
+    context = v.Context("local", num_workers=2, local_dir=str(tmp_path),
+                        log_level="INFO", log_cleanup=False)
+    try:
+        logging.getLogger("vega_tpu").info("hello from the test")
+        context.parallelize([1, 2, 3], 2).count()
+    finally:
+        context.stop()
+    logs = glob.glob(str(tmp_path / "session-*" / "driver.log"))
+    assert logs, "driver.log not created"
+    content = open(logs[0]).read()
+    assert "hello from the test" in content
+
+    # log_cleanup=True removes the file on stop
+    ctx2 = v.Context("local", num_workers=2, local_dir=str(tmp_path),
+                     log_level="INFO", log_cleanup=True)
+    ctx2.stop()
+    remaining = glob.glob(str(tmp_path / "session-*" / "driver.log"))
+    assert len(remaining) == 1  # only the first (uncleaned) session's log

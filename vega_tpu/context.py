@@ -62,6 +62,7 @@ class Context:
         env = Env.reset(conf, is_driver=True)
         env.map_output_tracker = MapOutputTracker()
         env.cache_tracker = CacheTracker()
+        self._log_handler = None
 
         self._next_rdd_id = itertools.count(0)
         self._next_shuffle_id = itertools.count(0)
@@ -79,6 +80,12 @@ class Context:
 
             self._backend = DistributedBackend(conf)
         self.scheduler = DAGScheduler(self._backend, self.bus)
+        # Attach last: a failed backend init must not leak a file handler on
+        # the process-global logger.
+        from vega_tpu.env import attach_session_logger
+
+        self._prev_logger_level = log.level
+        self._log_handler = attach_session_logger(env, "driver")
         with _active_context_lock:
             _active_context = self
 
@@ -263,6 +270,11 @@ class Context:
         env = Env.get()
         env.shuffle_store.clear()
         env.cache.clear()
+        from vega_tpu.env import detach_session_logger
+
+        detach_session_logger(self._log_handler, self.conf.log_cleanup)
+        self._log_handler = None
+        log.setLevel(self._prev_logger_level)
         with _active_context_lock:
             if _active_context is self:
                 _active_context = None

@@ -92,11 +92,17 @@ class DistributedBackend(TaskBackend):
                     sys.executable, "-m", "vega_tpu.distributed.worker",
                     "--driver", self.service.uri,
                     "--executor-id", executor_id,
+                    "--log-level", str(self.conf.log_level),
                 ]
                 # Workers are host-tier compute: keep them off the TPU.
+                # Propagate the driver's logging/workdir config so session
+                # logs land (and are cleaned) consistently across the fleet.
                 worker_env = dict(
                     os.environ, JAX_PLATFORMS="cpu",
                     VEGA_TPU_DEPLOYMENT_MODE="distributed",
+                    VEGA_TPU_LOG_LEVEL=str(self.conf.log_level),
+                    VEGA_TPU_LOG_CLEANUP="true" if self.conf.log_cleanup else "false",
+                    VEGA_TPU_LOCAL_DIR=self.conf.local_dir,
                 )
                 worker_env.pop("PALLAS_AXON_POOL_IPS", None)
                 proc = subprocess.Popen(
@@ -112,6 +118,7 @@ class DistributedBackend(TaskBackend):
                     "--driver", self.service.uri,
                     "--executor-id", executor_id,
                     "--host", host,
+                    "--log-level", str(self.conf.log_level),
                 ]
                 proc = subprocess.Popen(
                     cmd, stdout=subprocess.PIPE,

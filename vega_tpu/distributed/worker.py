@@ -114,6 +114,11 @@ class Worker:
         self.port = self._server.server_address[1]
         self._shutdown = threading.Event()
 
+        from vega_tpu.env import attach_session_logger
+
+        self._log_handler = attach_session_logger(
+            env, f"executor-{self.executor_id}"
+        )
         tracker.register_worker({
             "executor_id": self.executor_id,
             "host": host,
@@ -148,6 +153,10 @@ class Worker:
         env = Env.get()
         if env.shuffle_server is not None:
             env.shuffle_server.stop()
+        from vega_tpu.env import detach_session_logger
+
+        detach_session_logger(self._log_handler, env.conf.log_cleanup)
+        self._log_handler = None
 
 
 def main(argv=None) -> int:
@@ -159,10 +168,15 @@ def main(argv=None) -> int:
     parser.add_argument("--log-level", default="WARNING")
     args = parser.parse_args(argv)
 
+    from vega_tpu.env import normalize_log_level
+
+    level = normalize_log_level(args.log_level)
     logging.basicConfig(
-        level=args.log_level,
+        level=level,
         format=f"%(asctime)s {args.executor_id or 'worker'} %(levelname)s %(message)s",
     )
+    # The session-file handler reads the level from Configuration.
+    os.environ.setdefault("VEGA_TPU_LOG_LEVEL", logging.getLevelName(level))
     worker = Worker(args.driver, args.host, args.port, args.executor_id)
     # Announce the bound port for spawners reading our stdout.
     print(f"VEGA_WORKER_READY {worker.executor_id} {worker.task_uri}", flush=True)

@@ -105,6 +105,50 @@ class Configuration:
         return cfg
 
 
+def normalize_log_level(level) -> int:
+    """'info'/'INFO'/20 -> 20; invalid values fall back to WARNING instead
+    of crashing startup."""
+    if isinstance(level, int):
+        return level
+    resolved = logging.getLevelName(str(level).upper())
+    return resolved if isinstance(resolved, int) else logging.WARNING
+
+
+def attach_session_logger(env: "Env", role: str):
+    """Per-session log file (reference: simplelog combined file+terminal
+    logger — ns-driver.log / ns-executor.log, context.rs:542-564). Returns
+    the handler (caller owns detach/cleanup) or None when the directory is
+    unwritable. Never *raises* the logger threshold: an application that
+    configured more verbose logging keeps it."""
+    try:
+        path = os.path.join(env.work_dir(), f"{role}.log")
+        handler = logging.FileHandler(path)
+    except OSError:
+        return None
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s %(message)s"
+    ))
+    level = normalize_log_level(env.conf.log_level)
+    handler.setLevel(level)
+    log.addHandler(handler)
+    if level < log.getEffectiveLevel():
+        log.setLevel(level)
+    return handler
+
+
+def detach_session_logger(handler, cleanup: bool) -> None:
+    if handler is None:
+        return
+    log.removeHandler(handler)
+    path = handler.baseFilename
+    handler.close()
+    if cleanup:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 class Env:
     """Lazy process singleton (reference: src/env.rs:38-96).
 
