@@ -28,6 +28,23 @@ from vega_tpu.rdd.base import RDD
 
 __version__ = "0.1.0"
 
+
+_LAZY = ("DenseRDD",)
+
+
+def __getattr__(name):
+    # DenseRDD lazily (importing it pulls in jax; host-only users skip that).
+    if name == "DenseRDD":
+        from vega_tpu.tpu.dense_rdd import DenseRDD
+
+        globals()[name] = DenseRDD  # cache for subsequent lookups
+        return DenseRDD
+    raise AttributeError(f"module 'vega_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
 __all__ = [
     "Aggregator",
     "BoundedDouble",
