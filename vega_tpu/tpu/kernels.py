@@ -62,12 +62,16 @@ def valid_mask(capacity: int, count: jax.Array) -> jax.Array:
 
 def compact(cols: Cols, keep: jax.Array, out_capacity: int) -> Tuple[Cols, jax.Array]:
     """Move rows where keep=True to the front; returns (cols, new_count).
-    Stable (preserves row order), static-shape."""
-    order = jnp.argsort(~keep, stable=True)
-    idx = order[:out_capacity] if out_capacity <= keep.shape[0] else jnp.pad(
-        order, (0, out_capacity - keep.shape[0])
-    )
-    out = {n: jnp.take(c, idx, axis=0) for n, c in cols.items()}
+    Stable (kept rows' positions are their exclusive prefix count, which is
+    increasing), static-shape. Implemented as cumsum + scatter — O(n) work
+    instead of the O(n log n) argsort this hot helper used to pay (it runs
+    inside every exchange, filter, and segment reduction)."""
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    idx = jnp.where(keep, pos, out_capacity)  # dropped rows land out of range
+    out = {}
+    for n, c in cols.items():
+        dst = jnp.zeros((out_capacity,) + c.shape[1:], c.dtype)
+        out[n] = dst.at[idx].set(c, mode="drop")
     return out, jnp.sum(keep).astype(jnp.int32)
 
 
