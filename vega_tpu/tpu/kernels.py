@@ -93,6 +93,38 @@ def passthrough_exchange(cols: Cols, count: jax.Array, capacity: int,
     return out, new_count, new_count > out_capacity
 
 
+def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
+                     prefer_low_memory: bool = False):
+    """Stable-group rows by target bucket; returns (grouped cols,
+    per-bucket counts, per-bucket start offsets).
+
+    Bucket ids live in the tiny range [0, n_shards] — for small meshes a
+    counting sort (one-hot prefix counts + one scatter per column, O(n*k))
+    beats the O(n log n) argsort. The one-hot/cumsum intermediates are
+    O(capacity * n_shards), so callers with a memory bound to honor
+    (ring_exchange) set prefer_low_memory and larger meshes always take the
+    argsort path."""
+    counts_all = jnp.bincount(bucket, length=n_shards + 1)
+    counts_to = counts_all[:n_shards]
+    starts_all = jnp.cumsum(counts_all) - counts_all  # exclusive prefix
+    starts = starts_all[:n_shards]
+    if n_shards <= 64 and not prefer_low_memory:
+        capacity = bucket.shape[0]
+        one_hot = (bucket[:, None] ==
+                   jnp.arange(n_shards + 1)[None, :]).astype(jnp.int32)
+        rank = jnp.take_along_axis(
+            jnp.cumsum(one_hot, axis=0), bucket[:, None], axis=1
+        )[:, 0] - 1
+        pos = jnp.take(starts_all, bucket) + rank
+        grouped = {}
+        for name, col in cols.items():
+            dst = jnp.zeros((capacity,) + col.shape[1:], col.dtype)
+            grouped[name] = dst.at[pos].set(col, mode="drop")
+        return grouped, counts_to, starts
+    order = jnp.argsort(bucket, stable=True)
+    return gather_rows(cols, order), counts_to, starts
+
+
 def bucket_exchange(
     cols: Cols,
     count: jax.Array,  # int32[] per-shard valid count
@@ -113,13 +145,7 @@ def bucket_exchange(
     mask = valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)  # invalid rows -> ghost bucket
 
-    order = jnp.argsort(bucket, stable=True)
-    sorted_bucket = jnp.take(bucket, order)
-    sorted_cols = gather_rows(cols, order)
-
-    # rows per target + start offset of each target's run
-    counts_to = jnp.bincount(sorted_bucket, length=n_shards + 1)[:n_shards]
-    starts = jnp.searchsorted(sorted_bucket, jnp.arange(n_shards))
+    sorted_cols, counts_to, starts = _group_by_bucket(cols, bucket, n_shards)
     overflow_send = jnp.any(counts_to > slot_capacity)
 
     # Build [n_shards, slot_capacity] send buffers per column.

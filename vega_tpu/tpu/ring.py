@@ -51,12 +51,12 @@ def ring_exchange(
     mask = kernels.valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)
 
-    order = jnp.argsort(bucket, stable=True)
-    sorted_bucket = jnp.take(bucket, order)
-    sorted_cols = kernels.gather_rows(cols, order)
-
-    counts_to = jnp.bincount(sorted_bucket, length=n_shards + 1)[:n_shards]
-    starts = jnp.searchsorted(sorted_bucket, jnp.arange(n_shards))
+    # prefer_low_memory: the counting sort's O(capacity * n_shards)
+    # intermediates would defeat exactly the peak-memory bound this exchange
+    # exists to provide.
+    sorted_cols, counts_to, starts = kernels._group_by_bucket(
+        cols, bucket, n_shards, prefer_low_memory=True
+    )
     overflow = jnp.any(counts_to > slot_capacity)
 
     my_id = lax.axis_index(SHARD_AXIS)
