@@ -344,3 +344,14 @@ def test_count_approx_distinct(ctx):
     est = rdd.count_approx_distinct(0.05)
     assert abs(est - 5_000) / 5_000 < 0.05
     assert ctx.parallelize([], 2).count_approx_distinct() == 0
+
+
+def test_to_debug_string(ctx):
+    rdd = (ctx.parallelize([(1, 2)], 2)
+           .reduce_by_key(lambda a, b: a + b, 2)
+           .map_values(lambda x: x))
+    s = rdd.to_debug_string()
+    assert "MapPartitionsRDD" in s
+    assert "ShuffledRDD" in s
+    assert "+-" in s  # shuffle boundary marked
+    assert "ParallelCollectionRDD" in s

@@ -692,6 +692,34 @@ class RDD(PairOpsMixin):
             merged.merge_registers(registers)
         return merged.estimate()
 
+    def to_debug_string(self) -> str:
+        """Render the lineage DAG (Spark's toDebugString): one line per RDD,
+        indented by depth, '+-' marking shuffle boundaries (stage cuts)."""
+        from vega_tpu.dependency import ShuffleDependency
+
+        lines: List[str] = []
+        seen = set()
+
+        def walk(rdd, depth, via_shuffle):
+            marker = "+-" if via_shuffle else "| " if depth else ""
+            part = rdd.partitioner
+            extra = f" partitioner={part}" if part is not None else ""
+            tag = ""
+            if rdd.rdd_id in seen:
+                tag = " (shared)"
+            lines.append(
+                f"{'  ' * depth}{marker}({rdd.num_partitions}) "
+                f"{type(rdd).__name__}[{rdd.rdd_id}]{extra}{tag}"
+            )
+            if rdd.rdd_id in seen:
+                return
+            seen.add(rdd.rdd_id)
+            for dep in rdd.get_dependencies():
+                walk(dep.rdd, depth + 1, isinstance(dep, ShuffleDependency))
+
+        walk(self, 0, False)
+        return "\n".join(lines)
+
     # ------------------------------------------------------------------- misc
     def id(self) -> int:
         return self.rdd_id
