@@ -1,0 +1,78 @@
+"""Randomized dense-vs-host parity: the CPU/TPU 'identical results' oracle
+(BASELINE.md) exercised over randomized key distributions, sizes, and ops —
+catches capacity-estimation and masking edge cases deterministic tests miss.
+Seeds are fixed for reproducibility."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import vega_tpu as v
+
+@pytest.mark.parametrize("seed,op", list(itertools.product(
+    [0, 1, 2], ["add", "min", "max"]
+)))
+def test_random_reduce_by_key_parity(ctx, seed, op):
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(1, 30_000))
+    n_keys = int(rng.randint(1, max(2, n)))
+    keys = rng.randint(0, n_keys, size=n).astype(np.int32)
+    vals = rng.randint(-1000, 1000, size=n).astype(np.int32)
+
+    collected = ctx.dense_from_numpy(keys, vals).reduce_by_key(op=op).collect()
+    py_op = {"add": lambda a, b: a + b, "min": min, "max": max}[op]
+    host = {}
+    for k, x in zip(keys.tolist(), vals.tolist()):
+        host[k] = py_op(host[k], x) if k in host else x
+    # No duplicate keys may survive the reduce (dict() would mask them).
+    assert len(collected) == len(host)
+    assert dict(collected) == host
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_random_join_parity(ctx, seed):
+    rng = np.random.RandomState(seed)
+    n_left = int(rng.randint(1, 10_000))
+    n_right = int(rng.randint(1, 500))
+    rkeys = rng.permutation(100_000)[:n_right].astype(np.int32)  # unique
+    lkeys = rkeys[rng.randint(0, n_right, size=n_left)]
+    # mix in some unmatched left keys
+    miss = rng.randint(200_000, 300_000, size=max(1, n_left // 10)).astype(np.int32)
+    lkeys = np.concatenate([lkeys, miss])
+    lvals = rng.randint(0, 10**6, size=len(lkeys)).astype(np.int32)
+    rvals = rng.randint(0, 10**6, size=n_right).astype(np.int32)
+
+    dev = sorted(
+        ctx.dense_from_numpy(lkeys, lvals)
+        .join(ctx.dense_from_numpy(rkeys, rvals)).collect()
+    )
+    rmap = dict(zip(rkeys.tolist(), rvals.tolist()))
+    host = sorted(
+        (int(k), (int(x), rmap[int(k)]))
+        for k, x in zip(lkeys, lvals) if int(k) in rmap
+    )
+    assert dev == host
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_random_sort_parity(ctx, seed):
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(2, 20_000))
+    keys = rng.randint(-10**6, 10**6, size=n).astype(np.int32)
+    vals = np.arange(n, dtype=np.int32)
+    result = ctx.dense_from_numpy(keys, vals).sort_by_key().collect()
+    assert [k for k, _ in result] == sorted(keys.tolist())
+
+
+def test_random_skewed_distribution(ctx):
+    """Zipf-ish skew: capacity estimation must survive heavy imbalance."""
+    rng = np.random.RandomState(9)
+    keys = (rng.zipf(1.5, size=20_000) % 1000).astype(np.int32)
+    vals = np.ones(20_000, dtype=np.int32)
+    collected = ctx.dense_from_numpy(keys, vals).reduce_by_key(op="add").collect()
+    host = {}
+    for k in keys.tolist():
+        host[k] = host.get(k, 0) + 1
+    assert len(collected) == len(host)
+    assert dict(collected) == host
