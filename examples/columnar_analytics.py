@@ -1,0 +1,70 @@
+"""Columnar analytics on the device tier: parquet -> multi-column dense
+blocks -> single-pass multi-aggregate -> enrichment join -> persistence.
+
+Shows the newer dense APIs: dense_from_columns, select, left_outer_join,
+stats/histogram, sample, save_npz, count_approx_distinct, to_debug_string.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import vega_tpu as v
+
+
+def write_fixture(path, rows=200_000):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.RandomState(7)
+    pq.write_table(pa.table({
+        "user": rng.zipf(1.3, size=rows).astype(np.int64) % 5_000,
+        "bytes": rng.randint(40, 1_500, size=rows).astype(np.int64),
+        "requests": np.ones(rows, dtype=np.int64),
+    }), path)
+
+
+def main():
+    with tempfile.TemporaryDirectory() as root, v.Context("local") as ctx:
+        path = os.path.join(root, "traffic.parquet")
+        write_fixture(path)
+
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path).to_pydict()
+        events = ctx.dense_from_columns(
+            {k: np.asarray(vals) for k, vals in table.items()}, key="user"
+        )
+
+        # one program aggregates every value column per user
+        per_user = events.reduce_by_key(op="add")
+        print("users:", per_user.count())
+
+        # enrichment against a partial dimension table (left outer)
+        tiers = ctx.dense_from_numpy(
+            np.arange(0, 5_000, 7, dtype=np.int32),
+            (np.arange(0, 5_000, 7, dtype=np.int32) % 3) + 1,
+        )
+        traffic = per_user.select("k", "bytes").map(lambda kv: (kv[0], kv[1]))
+        enriched = traffic.left_outer_join(tiers, fill_value=0)
+        untiered = sum(1 for _k, (_b, t) in enriched.collect() if t == 0)
+        print("users without a tier:", untiered)
+
+        # distributions + estimates
+        volumes = traffic.values_dense()
+        print("volume stats:", {k: round(val, 1)
+                                for k, val in volumes.stats().items()})
+        print("approx distinct users:",
+              events.keys_dense().count_approx_distinct(0.05))
+
+        # persist the aggregate; reload feeds further work
+        agg_path = os.path.join(root, "per_user.npz")
+        traffic.save_npz(agg_path)
+        reloaded = ctx.dense_load_npz(agg_path)
+        print("reloaded rows:", reloaded.count())
+        print(traffic.to_debug_string())
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,8 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FAST_EXAMPLES = ["make_rdd.py", "subtract.py", "file_read.py"]
+FAST_EXAMPLES = ["make_rdd.py", "subtract.py", "file_read.py",
+                 "columnar_analytics.py"]
 
 
 @pytest.mark.parametrize("example", FAST_EXAMPLES)

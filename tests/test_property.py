@@ -8,8 +8,6 @@ import itertools
 import numpy as np
 import pytest
 
-import vega_tpu as v
-
 @pytest.mark.parametrize("seed,op", list(itertools.product(
     [0, 1, 2], ["add", "min", "max"]
 )))

@@ -996,6 +996,11 @@ class _SelectRDD(_NarrowRDD):
 class _ProjectRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, col: str):
         pschema = dict(parent._schema())
+        if col not in pschema:
+            raise VegaError(
+                f"no {col!r} column on this DenseRDD (columns: "
+                f"{list(pschema)})"
+            )
         super().__init__(parent, ((VALUE, pschema[col]),))
         self._col = col
         self._user_fn = col
