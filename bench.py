@@ -63,8 +63,70 @@ def _arm_watchdog(seconds: float):
     return timer
 
 
+def _device_backend_healthy(probe_timeout_s: float = 180.0) -> bool:
+    """Probe device-backend init in a subprocess: a wedged accelerator
+    tunnel hangs jax initialization indefinitely, which would otherwise eat
+    the whole bench budget before the watchdog fires."""
+    import subprocess
+    import sys
+
+    try:
+        result = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout_s, capture_output=True,
+        )
+        return result.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import os
+
+    budget = float(os.environ.get("VEGA_BENCH_TIMEOUT_S", "900"))
+    # Probe only when the wedge-prone accelerator tunnel is in play; plain
+    # CPU/TPU environments skip the duplicate runtime init entirely.
+    needs_probe = (os.environ.get("VEGA_BENCH_CPU_FALLBACK") != "1"
+                   and bool(os.environ.get("PALLAS_AXON_POOL_IPS")))
+    if needs_probe:
+        probe_budget = min(180.0, budget / 5)
+        probe_start = time.time()
+        healthy = _device_backend_healthy(probe_budget)
+        if not healthy:
+            # Device backend is wedged: re-run on the CPU backend so the
+            # harness still gets a real (clearly-labeled) measurement. The
+            # parent owns the one-JSON-line contract: it re-emits the
+            # child's line, or an error line if the child produced none.
+            import subprocess
+            import sys
+
+            env = dict(os.environ, VEGA_BENCH_CPU_FALLBACK="1",
+                       JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.setdefault("VEGA_BENCH_SCALE", "0.25")  # CPU-sized workload
+            remaining = max(60.0, budget - (time.time() - probe_start) - 30)
+            env["VEGA_BENCH_TIMEOUT_S"] = str(remaining)
+            script = globals().get("__file__") or sys.argv[0]
+            try:
+                child = subprocess.run(
+                    [sys.executable, script], env=env,
+                    capture_output=True, text=True, timeout=remaining + 60,
+                )
+                lines = [l for l in child.stdout.splitlines() if l.strip()]
+            except subprocess.TimeoutExpired:
+                child, lines = None, []
+            if lines:
+                print(lines[-1], flush=True)
+                return 0 if child.returncode == 0 else child.returncode
+            print(json.dumps({
+                "metric": "group_by+join rows/sec/chip",
+                "value": 0,
+                "unit": "rows/sec",
+                "vs_baseline": 0.0,
+                "error": "device backend wedged and CPU fallback produced "
+                         "no result",
+            }), flush=True)
+            return 3
 
     import vega_tpu as v
 
@@ -100,6 +162,9 @@ def main():
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "
                       "1M-key inner join)",
+            **({"note": "device backend unavailable; measured on CPU "
+                        "fallback at reduced scale"}
+               if os.environ.get("VEGA_BENCH_CPU_FALLBACK") == "1" else {}),
             "value": round(dev_rows_per_s),
             "unit": "rows/sec",
             "vs_baseline": round(dev_rows_per_s / host_rows_per_s, 2),
