@@ -1,0 +1,59 @@
+"""Runtime-reentrancy tests (reference: tests/test_async.rs — the same job
+under a pre-existing tokio runtime and under async-std, validating
+Env::run_in_async_rt). The Python analogues: jobs driven from inside an
+asyncio event loop and from multiple concurrent driver threads (the
+scheduler's job lock serializes them without deadlock)."""
+
+import asyncio
+import threading
+
+import vega_tpu as v
+
+
+def test_jobs_from_asyncio_event_loop(ctx):
+    async def run():
+        rdd = ctx.make_rdd(list(range(100)), 4).map(lambda x: x * 2)
+        return await asyncio.to_thread(rdd.collect)
+
+    result = asyncio.run(run())
+    assert sorted(result) == [x * 2 for x in range(100)]
+
+
+def test_concurrent_driver_threads(ctx):
+    """Multiple threads submitting jobs against one Context: the job lock
+    serializes them (reference: the scheduler_lock,
+    distributed_scheduler.rs:183-187) and every job completes correctly."""
+    results = {}
+    errors = []
+
+    def work(tid):
+        try:
+            pairs = ctx.parallelize([(i % 5, tid) for i in range(50)], 4)
+            results[tid] = dict(
+                pairs.reduce_by_key(lambda a, b: a + b, 2).collect()
+            )
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(t,), daemon=True)
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # A deadlocked scheduler must FAIL the test, not hang pytest at exit.
+    assert not any(t.is_alive() for t in threads)
+    assert not errors
+    for tid in range(4):
+        assert results[tid] == {k: 10 * tid for k in range(5)}
+
+
+def test_nested_job_from_action(ctx):
+    """An action whose graph construction runs sub-jobs (sort_by_key samples
+    and counts) nests cleanly under the reentrant job lock."""
+    import random
+
+    data = [(i, i) for i in range(200)]
+    random.Random(0).shuffle(data)
+    assert ctx.parallelize(data, 4).sort_by_key(num_partitions=3).collect() \
+        == sorted(data)
