@@ -133,8 +133,7 @@ def test_count_by_value_approx(ctx):
 
 def test_event_bus_metrics(ctx):
     ctx.make_rdd(list(range(10)), 2).count()
-    time.sleep(0.2)  # listener bus drains asynchronously
-    summary = ctx.metrics_summary()
+    summary = ctx.metrics_summary()  # flushes the bus internally
     assert summary["jobs"] >= 1
     assert summary["tasks"] >= 2
 

@@ -258,6 +258,8 @@ class Context:
         return max(2, self._backend.parallelism)
 
     def metrics_summary(self) -> dict:
+        if not self.bus.flush():
+            log.warning("event bus flush timed out; metrics may lag")
         return self.metrics.summary()
 
     def stop(self) -> None:

@@ -90,8 +90,24 @@ class LiveListenerBus:
             self._thread.start()
 
     def post(self, event: Event) -> None:
-        if self._started:
+        with self._lock:
+            if not self._started:
+                return  # post/stop race: drop instead of stranding a task
             self._queue.put(event)
+
+    def flush(self, timeout: float = 2.0) -> bool:
+        """Block until every posted event has been dispatched (readers like
+        metrics_summary call this so results reflect completed jobs).
+        Waits on the queue's own all_tasks_done condition (the documented
+        join() protocol) with a monotonic deadline — no polling."""
+        deadline = time.monotonic() + timeout
+        with self._queue.all_tasks_done:
+            while self._queue.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._queue.all_tasks_done.wait(remaining)
+        return True
 
     def stop(self) -> None:
         with self._lock:
@@ -105,15 +121,18 @@ class LiveListenerBus:
     def _dispatch_loop(self) -> None:
         while True:
             event = self._queue.get()
-            if event is None:
-                return
-            with self._lock:
-                listeners = list(self._listeners)
-            for listener in listeners:
-                try:
-                    listener.on_event(event)
-                except Exception:
-                    log.exception("listener raised")
+            try:
+                if event is None:
+                    return
+                with self._lock:
+                    listeners = list(self._listeners)
+                for listener in listeners:
+                    try:
+                        listener.on_event(event)
+                    except Exception:
+                        log.exception("listener raised")
+            finally:
+                self._queue.task_done()
 
 
 class MetricsListener(Listener):
