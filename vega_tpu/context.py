@@ -14,7 +14,7 @@ import itertools
 import logging
 import threading
 import time
-from typing import Any, Callable, Iterable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 from vega_tpu.cache_tracker import CacheTracker
 from vega_tpu.env import Configuration, DeploymentMode, Env

@@ -15,7 +15,7 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from vega_tpu.cache_tracker import CacheTracker
 from vega_tpu.distributed import protocol

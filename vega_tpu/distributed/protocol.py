@@ -13,7 +13,7 @@ connection round.
 from __future__ import annotations
 
 import socket
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 from vega_tpu import serialization
 from vega_tpu.errors import NetworkError

@@ -15,7 +15,7 @@ equivalents and lowers shuffles to device exchanges.
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Iterable, Iterator, List, Optional, TYPE_CHECKING
+from typing import Any, Callable, Iterator, List, Optional, TYPE_CHECKING
 
 from vega_tpu.dependency import Dependency
 from vega_tpu.errors import VegaError

@@ -8,7 +8,7 @@ zip_rdd.rs.
 from __future__ import annotations
 
 import itertools
-from typing import Callable, Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List, Sequence
 
 from vega_tpu.dependency import OneToOneDependency
 from vega_tpu.rdd.base import RDD

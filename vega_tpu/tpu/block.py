@@ -13,7 +13,7 @@ Layout: each column is [n_shards * capacity, ...] sharded on axis 0; rows
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp

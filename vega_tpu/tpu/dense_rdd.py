@@ -28,7 +28,6 @@ pluggable shuffles — here the exchange implementation is a per-op plugin
 
 from __future__ import annotations
 
-import functools
 import logging
 import math
 from typing import Callable, List, Optional, Sequence, Tuple
