@@ -9,27 +9,14 @@ dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
 
 # Must precede backend initialization (first jax.devices()/jit call).
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-# Persistent XLA compilation cache: dense-tier programs compile once per
-# machine, not once per pytest run.
-jax.config.update("jax_compilation_cache_dir", "/tmp/vega_tpu_xla_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-assert jax.default_backend() == "cpu", (
-    "tests must run on the CPU backend; TPU init happened before conftest"
-)
-assert jax.device_count() >= 8, "expected 8 virtual CPU devices"
+force_cpu_mesh(8)
 
 import pytest  # noqa: E402
 
