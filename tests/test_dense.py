@@ -434,3 +434,30 @@ def test_dense_int64_out_of_range_rejected(dctx):
     r = dctx.dense_from_numpy(np.array([5, 6], dtype=np.int64),
                               np.array([50, 60], dtype=np.int64))
     assert sorted(r.collect()) == [(5, 50), (6, 60)]
+
+
+def test_histogram_sizing_no_retries_under_skew(ctx):
+    """Exchange capacities come from a one-pass destination histogram, so
+    even a fully-skewed key distribution (every row to one reducer) runs in
+    ONE attempt — no overflow -> grow -> recompile loop (the round-1 jit
+    thrash hazard)."""
+    skewed = ctx.dense_range(8192).map(lambda x: (x * 0, x))
+    node = skewed.reduce_by_key(op="add")
+    assert dict(node.collect()) == {0: sum(range(8192))}
+    assert node._last_attempts == 1
+
+    # 90/10 mixed skew through a join as well.
+    keys = np.where(np.arange(4096) % 10 == 0, np.arange(4096) % 7, 0)
+    left = ctx.dense_from_numpy(keys.astype(np.int32),
+                                np.ones(4096, dtype=np.int32))
+    right = ctx.dense_from_numpy(np.arange(7, dtype=np.int32),
+                                 np.arange(7, dtype=np.int32) * 2)
+    j = left.reduce_by_key(op="add").join(right)
+    assert j.count() == len(set(keys.tolist()))
+    assert j._last_attempts == 1
+
+    srt = ctx.dense_from_numpy(keys.astype(np.int32),
+                               keys.astype(np.int32)).sort_by_key()
+    sk = [k for k, _ in srt.collect()]
+    assert sk == sorted(keys.tolist())
+    assert srt._last_attempts == 1

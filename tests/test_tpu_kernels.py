@@ -113,3 +113,47 @@ def test_group_by_bucket_branch_parity():
         assert jnp.array_equal(fast[0][name][:n_valid], slow[0][name][:n_valid])
     assert jnp.array_equal(fast[1], slow[1])  # counts
     assert jnp.array_equal(fast[2], slow[2])  # starts
+
+
+def test_bucket_key_sort_groups_and_sorts():
+    """bucket_key_sort: one multi-key sort -> bucket-grouped rows with
+    key-sorted runs, ghost (invalid) rows sunk to the end, row multiset
+    preserved. This is the map side of the 2-sort exchange."""
+    rng = np.random.RandomState(11)
+    capacity, count, n_shards = 64, 41, 4
+    keys = jnp.asarray(rng.randint(0, 30, capacity, dtype=np.int32))
+    vals = jnp.asarray(rng.rand(capacity).astype(np.float32))
+    iota = jnp.arange(capacity)
+    bucket = jnp.where(iota < count, keys % n_shards, n_shards)
+    cols = {"k": keys, "v": vals}
+
+    out, sb = kernels.bucket_key_sort(cols, jnp.int32(count), bucket, "k")
+
+    sb = np.asarray(sb)
+    ok = np.asarray(out["k"])
+    assert np.all(sb[1:] >= sb[:-1]), "buckets must be grouped"
+    assert np.all(sb[count:] == n_shards), "ghost rows must sink to the end"
+    same = sb[1:] == sb[:-1]
+    assert np.all(ok[1:][same] >= ok[:-1][same]), "key-sorted within bucket"
+    got = sorted(zip(np.asarray(out["k"])[:count].tolist(),
+                     np.asarray(out["v"])[:count].tolist()))
+    exp = sorted(zip(np.asarray(keys)[:count].tolist(),
+                     np.asarray(vals)[:count].tolist()))
+    assert got == exp, "row multiset must be preserved"
+
+
+def test_pregrouped_counts_match_group_by_bucket():
+    """The pregrouped exchange's bincount shortcut must agree with
+    _group_by_bucket's (counts, starts) on grouped input."""
+    from vega_tpu.tpu.kernels import _group_by_bucket
+
+    rng = np.random.RandomState(12)
+    n_shards = 8
+    bucket = jnp.sort(jnp.asarray(
+        rng.randint(0, n_shards + 1, size=256, dtype=np.int32)))
+    cols = {"k": jnp.arange(256, dtype=jnp.int32)}
+    _, counts, starts = _group_by_bucket(cols, bucket, n_shards)
+    counts_all = jnp.bincount(bucket, length=n_shards + 1)
+    assert jnp.array_equal(counts_all[:n_shards], counts)
+    assert jnp.array_equal(
+        (jnp.cumsum(counts_all) - counts_all)[:n_shards], starts)

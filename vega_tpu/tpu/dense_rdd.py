@@ -1125,6 +1125,23 @@ def _exchange_capacities(counts: np.ndarray, n_shards: int,
     return slot, out
 
 
+def _histogram_capacities(hists: List[np.ndarray],
+                          attempt: int) -> Tuple[int, int]:
+    """Exact slot/out capacities from per-shard destination histograms.
+
+    Each hist is [n_shards, n_shards]: hist[s, t] = rows shard s sends to
+    target t. slot must hold the largest single (sender, target) cell; out
+    must hold the largest per-target column sum. Sized from the real key
+    distribution, overflow retries (which recompile a bigger program,
+    multi-second jit stalls on TPU) become an anomaly instead of the
+    expected path under skew. Growth on retry is kept as a safety net for
+    exchanges whose histogram is an estimate (none today)."""
+    grow = 2 ** attempt
+    slot = max(int(h.max()) for h in hists)
+    out = max(int(h.sum(axis=0).max()) for h in hists)
+    return _cap_round(max(slot, 1) * grow), _cap_round(max(out, 1) * grow)
+
+
 def _with_exchange(node, exchange: Optional[str]):
     if exchange is not None:
         node.exchange_mode = exchange
@@ -1158,12 +1175,57 @@ class _ExchangeRDD(DenseRDD):
     def exchange_mode(self, mode: str) -> None:
         self._exchange_mode = mode
 
-    def _run_exchange(self, build_program, counts: np.ndarray):
+    def _hash_histogram(self, blk: Block) -> Optional[np.ndarray]:
+        """One cheap counting pass over the keys: hist[s, t] = rows shard s
+        will send to target t under hash bucketing. Costs a hash + bincount
+        per shard (no sort, no value movement) and one tiny [n, n]
+        transfer; buys exactly-sized exchange capacities."""
+        n = self.mesh.size
+        if n == 1:
+            return None
+
+        def prog_fn(counts, keys):
+            cap = keys.shape[0]
+            bucket = pallas_kernels.hash_bucket(keys, n)
+            bucket = jnp.where(kernels.valid_mask(cap, counts[0]), bucket, n)
+            return jnp.bincount(bucket, length=n + 1)[:n].astype(jnp.int32)
+
+        prog = _cached_program(
+            ("hash_hist", self.mesh, n),
+            lambda: _shard_program(self.mesh, prog_fn, 2, _SPEC),
+        )
+        out = prog(blk.counts, blk.cols[KEY])
+        return np.asarray(jax.device_get(out)).reshape(n, n)
+
+    def _range_histogram(self, blk: Block, bounds_dev,
+                         ascending: bool) -> Optional[np.ndarray]:
+        """Destination histogram under range partitioning (sort_by_key)."""
+        n = self.mesh.size
+        if n == 1:
+            return None
+
+        def prog_fn(bnds, counts, keys):
+            cap = keys.shape[0]
+            bucket = kernels.range_bucket(bnds, keys, ascending)
+            bucket = jnp.where(kernels.valid_mask(cap, counts[0]), bucket, n)
+            return jnp.bincount(bucket, length=n + 1)[:n].astype(jnp.int32)
+
+        prog = _cached_program(
+            ("range_hist", self.mesh, n, ascending),
+            lambda: _shard_program(self.mesh, prog_fn,
+                                   (_REPL, _SPEC, _SPEC), _SPEC),
+        )
+        out = prog(bounds_dev, blk.counts, blk.cols[KEY])
+        return np.asarray(jax.device_get(out)).reshape(n, n)
+
+    def _run_exchange(self, build_program, counts: np.ndarray,
+                      hists: Optional[List[np.ndarray]] = None):
         import time as _time
 
         from vega_tpu.scheduler import events as ev
 
         n = self.mesh.size
+        hists = [h for h in (hists or []) if h is not None]
         bus = getattr(self.context, "bus", None)
         t_start = _time.time()
         if bus is not None:
@@ -1175,9 +1237,13 @@ class _ExchangeRDD(DenseRDD):
             ))
         try:
             for attempt in range(5):
-                slot, out_cap = _exchange_capacities(counts, n, attempt)
+                if hists:
+                    slot, out_cap = _histogram_capacities(hists, attempt)
+                else:
+                    slot, out_cap = _exchange_capacities(counts, n, attempt)
                 prog, args = build_program(slot, out_cap)
                 *outs, overflow = prog(*args)
+                self._last_attempts = attempt + 1
                 if not bool(np.any(np.asarray(jax.device_get(overflow)))):
                     return outs, out_cap
                 log.info("exchange overflow (slot=%d out=%d), retrying",
@@ -1241,14 +1307,29 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
                 if n > 1:
-                    # map-side combine (reference: dependency.rs:176-223);
-                    # pointless on one shard — the reduce side sorts anyway.
+                    # 2-sort exchange: ONE multi-key sort (bucket major,
+                    # key minor) feeds both the presorted map-side combine
+                    # (reference: dependency.rs:176-223) and a pregrouped
+                    # exchange — vs the 3 sorts of sort-for-combine +
+                    # group-by-bucket + reduce-side sort.
+                    capacity = cols[KEY].shape[0]
+                    mask = kernels.valid_mask(capacity, count)
+                    bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                    bucket = jnp.where(mask, bucket, n)
+                    cols, bucket = kernels.bucket_key_sort(
+                        cols, count, bucket, KEY
+                    )
                     cols, count = self._segment_reduce(cols, count,
-                                                       presorted=False)
-                bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
-                          if n > 1 else jnp.zeros_like(cols[KEY]))
+                                                       presorted=True)
+                    # compact kept (bucket, key) order; re-derive the
+                    # combiner rows' buckets from their keys (hash is cheap
+                    # and deterministic).
+                    bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                else:
+                    bucket = jnp.zeros_like(cols[KEY])
                 cols, count, overflow = exchange(
-                    cols, count, bucket, n, slot, out_cap
+                    cols, count, bucket, n, slot, out_cap,
+                    pregrouped=(n > 1),
                 )
                 # reduce-side merge (reference: shuffled_rdd.rs:149-170)
                 cols, count = self._segment_reduce(cols, count, presorted=False)
@@ -1267,7 +1348,9 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in names])
 
-        outs, out_cap = self._run_exchange(build, counts_host)
+        outs, out_cap = self._run_exchange(
+            build, counts_host, hists=[self._hash_histogram(blk)]
+        )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
                      capacity=out_cap, mesh=self.mesh)
@@ -1315,7 +1398,9 @@ class _GroupByKeyRDD(_ExchangeRDD):
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in names])
 
-        outs, out_cap = self._run_exchange(build, counts_host)
+        outs, out_cap = self._run_exchange(
+            build, counts_host, hists=[self._hash_histogram(blk)]
+        )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
                      capacity=out_cap, mesh=self.mesh)
@@ -1395,7 +1480,10 @@ class _JoinRDD(_ExchangeRDD):
             )
 
         counts = np.concatenate([l_counts, r_counts])
-        outs, out_cap = self._run_exchange(build, counts)
+        outs, out_cap = self._run_exchange(
+            build, counts,
+            hists=[self._hash_histogram(lblk), self._hash_histogram(rblk)],
+        )
         jcounts, jk, jlv, jrv, dup = outs
         if bool(np.any(np.asarray(jax.device_get(dup)))):
             raise _DupRightKeys()
@@ -1507,10 +1595,8 @@ class _SortByKeyRDD(_ExchangeRDD):
                 keys = cols[KEY]
                 if n == 1:
                     bucket = jnp.zeros_like(keys, shape=keys.shape).astype(jnp.int32)
-                elif ascending:
-                    bucket = jnp.searchsorted(bnds, keys).astype(jnp.int32)
                 else:
-                    bucket = jnp.searchsorted(-bnds, -keys).astype(jnp.int32)
+                    bucket = kernels.range_bucket(bnds, keys, ascending)
                 cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
@@ -1534,7 +1620,10 @@ class _SortByKeyRDD(_ExchangeRDD):
             return prog, (bounds_dev, blk.counts,
                           *[blk.cols[nm] for nm in names])
 
-        outs, out_cap = self._run_exchange(build, counts_host)
+        outs, out_cap = self._run_exchange(
+            build, counts_host,
+            hists=[self._range_histogram(blk, bounds_dev, ascending)],
+        )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
                      capacity=out_cap, mesh=self.mesh)

@@ -125,6 +125,46 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
     return gather_rows(cols, order), counts_to, starts
 
 
+def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
+                    key_name: str) -> Tuple[Cols, jax.Array]:
+    """One stable multi-key sort by (bucket major, key minor).
+
+    Rows become bucket-grouped with a key-sorted run per bucket, so a single
+    lax.sort feeds BOTH the presorted map-side combine and a pregrouped
+    exchange — replacing the separate pre-combine key sort and the
+    exchange's bucket grouping (the 3-sorts-to-2 restructuring of the
+    reference's map-side combine, dependency.rs:176-223). Caller must have
+    ghosted invalid rows (bucket = n_shards) so they sink to the end.
+    Returns (cols, bucket), both permuted."""
+    capacity = bucket.shape[0]
+    perm_src = lax.iota(jnp.int32, capacity)
+    sorted_bucket, sorted_key, perm = lax.sort(
+        (bucket, cols[key_name], perm_src), num_keys=2, is_stable=True
+    )
+    out = gather_rows({n: c for n, c in cols.items() if n != key_name}, perm)
+    out[key_name] = sorted_key  # already produced by the sort; skip a gather
+    return out, sorted_bucket
+
+
+def range_bucket(bounds: jax.Array, keys: jax.Array,
+                 ascending: bool) -> jax.Array:
+    """Range-partition bucket ids from sorted split bounds (sort_by_key's
+    partitioner). Shared by the exchange program and its sizing histogram —
+    exact capacity sizing depends on the two staying bit-identical."""
+    if ascending:
+        return jnp.searchsorted(bounds, keys).astype(jnp.int32)
+    return jnp.searchsorted(-bounds, -keys).astype(jnp.int32)
+
+
+def pregrouped_group(bucket: jax.Array, n_shards: int):
+    """(counts_to, starts) for rows already contiguous per bucket — the
+    bincount shortcut both exchanges use instead of _group_by_bucket."""
+    counts_all = jnp.bincount(bucket, length=n_shards + 1)
+    counts_to = counts_all[:n_shards]
+    starts = (jnp.cumsum(counts_all) - counts_all)[:n_shards]
+    return counts_to, starts
+
+
 def bucket_exchange(
     cols: Cols,
     count: jax.Array,  # int32[] per-shard valid count
@@ -132,20 +172,30 @@ def bucket_exchange(
     n_shards: int,
     slot_capacity: int,  # C: max rows this shard sends to any one target
     out_capacity: int,  # per-shard capacity of the received block
+    pregrouped: bool = False,  # rows already bucket-grouped (bucket_key_sort)
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """All-to-all by bucket id. Returns (cols, new_count, overflow_flag).
 
     Map side: stable-sort rows by target bucket, slice into n_shards slots of
     slot_capacity rows each. Wire: one lax.all_to_all per column over ICI.
     Reduce side: mask + compact received rows. This is the entire reference
-    shuffle data plane (SURVEY.md §2.5) as one fused XLA program."""
+    shuffle data plane (SURVEY.md §2.5) as one fused XLA program.
+
+    With pregrouped=True the caller guarantees valid rows are already
+    contiguous per target bucket (e.g. via bucket_key_sort) and the grouping
+    pass collapses to a bincount."""
     capacity = bucket.shape[0]
     if n_shards == 1:
         return passthrough_exchange(cols, count, capacity, out_capacity)
     mask = valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)  # invalid rows -> ghost bucket
 
-    sorted_cols, counts_to, starts = _group_by_bucket(cols, bucket, n_shards)
+    if pregrouped:
+        counts_to, starts = pregrouped_group(bucket, n_shards)
+        sorted_cols = cols
+    else:
+        sorted_cols, counts_to, starts = _group_by_bucket(cols, bucket,
+                                                          n_shards)
     overflow_send = jnp.any(counts_to > slot_capacity)
 
     # Build [n_shards, slot_capacity] send buffers per column.

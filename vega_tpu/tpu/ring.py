@@ -41,9 +41,11 @@ def ring_exchange(
     n_shards: int,
     slot_capacity: int,
     out_capacity: int,
+    pregrouped: bool = False,
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """Drop-in replacement for kernels.bucket_exchange (same contract:
-    returns (cols, new_count, overflow_flag))."""
+    returns (cols, new_count, overflow_flag); pregrouped means rows are
+    already contiguous per bucket, so grouping collapses to a bincount)."""
     capacity = bucket.shape[0]
     if n_shards == 1:
         return kernels.passthrough_exchange(cols, count, capacity,
@@ -51,12 +53,16 @@ def ring_exchange(
     mask = kernels.valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)
 
-    # prefer_low_memory: the counting sort's O(capacity * n_shards)
-    # intermediates would defeat exactly the peak-memory bound this exchange
-    # exists to provide.
-    sorted_cols, counts_to, starts = kernels._group_by_bucket(
-        cols, bucket, n_shards, prefer_low_memory=True
-    )
+    if pregrouped:
+        counts_to, starts = kernels.pregrouped_group(bucket, n_shards)
+        sorted_cols = cols
+    else:
+        # prefer_low_memory: the counting sort's O(capacity * n_shards)
+        # intermediates would defeat exactly the peak-memory bound this
+        # exchange exists to provide.
+        sorted_cols, counts_to, starts = kernels._group_by_bucket(
+            cols, bucket, n_shards, prefer_low_memory=True
+        )
     overflow = jnp.any(counts_to > slot_capacity)
 
     my_id = lax.axis_index(SHARD_AXIS)
