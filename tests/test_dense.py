@@ -185,14 +185,49 @@ def test_dense_skew_overflow_retry(dctx):
     assert dev == {0: sum(range(n))}
 
 
-def test_dense_join_duplicate_right_falls_back(dctx):
-    """Dup right keys are detected on device; join silently degrades to the
-    host cogroup join with full dup x dup semantics."""
+def test_dense_join_duplicate_keys_on_device(dctx):
+    """Dup keys on either side run the full dup x dup product ON DEVICE
+    (merge_join_expand) — no host fallback (reference pair_rdd.rs:104-121
+    semantics)."""
     left = dctx.dense_from_numpy(np.array([1, 2]), np.array([5, 6]))
     right = dctx.dense_from_numpy(np.array([1, 1, 2]), np.array([10, 20, 30]))
     j = left.join(right)
     assert sorted(j.collect()) == [(1, (5, 10)), (1, (5, 20)), (2, (6, 30))]
     assert j.count() == 3
+
+
+def test_dense_join_dup_parity_randomized(dctx):
+    """Randomized dup x dup join parity: dense result must equal the host
+    tier's join on the same data (inner and left-outer)."""
+    rng = np.random.RandomState(42)
+    lk = rng.randint(0, 40, 3000).astype(np.int32)
+    lv = rng.randint(0, 1000, 3000).astype(np.int32)
+    rk = rng.randint(20, 60, 500).astype(np.int32)  # partial key overlap
+    rv = rng.randint(0, 1000, 500).astype(np.int32)
+
+    dense = dctx.dense_from_numpy(lk, lv).join(
+        dctx.dense_from_numpy(rk, rv))
+    host = dctx.parallelize(list(zip(lk.tolist(), lv.tolist())), 4).join(
+        dctx.parallelize(list(zip(rk.tolist(), rv.tolist())), 4))
+    assert sorted(dense.collect()) == sorted(host.collect())
+
+    douter = dctx.dense_from_numpy(lk, lv).left_outer_join(
+        dctx.dense_from_numpy(rk, rv), fill_value=-1)
+    houter = dctx.parallelize(list(zip(lk.tolist(), lv.tolist())), 4) \
+        .cogroup(dctx.parallelize(list(zip(rk.tolist(), rv.tolist())), 4)) \
+        .flat_map_values(lambda g: [(a, b) for a in g[0] for b in g[1]]
+                         if g[1] else [(a, -1) for a in g[0]])
+    assert sorted(douter.collect()) == sorted(houter.collect())
+
+
+def test_dense_join_expansion_overflow_retries(dctx):
+    """A join whose dup x dup product far exceeds the input row counts must
+    trigger the expansion-overflow retry and still return exact results."""
+    lk = np.zeros(300, dtype=np.int32)  # all same key
+    rk = np.zeros(300, dtype=np.int32)  # 300 x 300 = 90k output rows
+    j = dctx.dense_from_numpy(lk, np.arange(300, dtype=np.int32)).join(
+        dctx.dense_from_numpy(rk, np.arange(300, dtype=np.int32)))
+    assert j.count() == 90_000
 
 
 def test_dense_take(dctx):
@@ -461,3 +496,28 @@ def test_histogram_sizing_no_retries_under_skew(ctx):
     sk = [k for k, _ in srt.collect()]
     assert sk == sorted(keys.tolist())
     assert srt._last_attempts == 1
+
+
+def test_collect_grouped_columnar_parity(ctx):
+    """collect_grouped returns (keys, offsets, values) arrays whose groups
+    match the host tier's group_by_key exactly."""
+    n, k = 20_000, 113
+    grouped = ctx.dense_range(n).map(lambda x: (x % k, x)).group_by_key()
+    keys, offsets, values = grouped.collect_grouped()
+    assert len(keys) == k
+    assert offsets[0] == 0 and offsets[-1] == n
+    host = dict(
+        ctx.range(n, num_slices=8).map(lambda x: (x % k, x))
+        .group_by_key(8).collect()
+    )
+    for i, key in enumerate(keys.tolist()):
+        got = sorted(values[offsets[i]:offsets[i + 1]].tolist())
+        assert got == sorted(host[key]), f"group {key} mismatch"
+
+    # cogroup over the same machinery (columnar merge path)
+    other = ctx.dense_range(500).map(lambda x: (x % 7, x * 10))
+    cg = dict(ctx.dense_range(300).map(lambda x: (x % 5, x))
+              .cogroup(other).collect())
+    for key, (lvs, rvs) in cg.items():
+        assert sorted(lvs) == [x for x in range(300) if x % 5 == key]
+        assert sorted(rvs) == [x * 10 for x in range(500) if x % 7 == key]

@@ -275,9 +275,10 @@ class DenseRDD(RDD):
 
     def join(self, other, partitioner_or_num=None,
              exchange: Optional[str] = None):
-        """Device sort-merge join (right side unique keys). Falls back to the
-        host cogroup-based join when `other` is not dense or right keys are
-        not unique (checked on device, cheap)."""
+        """Device sort-merge join with full duplicate-key semantics (dup x
+        dup product per key, reference pair_rdd.rs:104-121). Falls back to
+        the host cogroup-based join only when `other` is not dense or an
+        explicit partitioner is requested."""
         if self._dense_joinable(other, partitioner_or_num):
             return _with_exchange(_JoinRDD(self, other), exchange)
         return super().join(other, partitioner_or_num)
@@ -291,8 +292,9 @@ class DenseRDD(RDD):
 
     def left_outer_join(self, other, partitioner_or_num=None,
                         fill_value=0, exchange: Optional[str] = None):
-        """Device left-outer join (right side unique keys): unmatched left
-        rows keep fill_value in the right column (None is not representable
+        """Device left-outer join (duplicate keys allowed on both sides):
+        unmatched left rows keep fill_value in the right column (None is
+        not representable
         in a dense column — host semantics with None come via
         .to_rdd().left_outer_join(...)). The host fallback also honors
         fill_value so results don't depend on which path ran."""
@@ -306,8 +308,7 @@ class DenseRDD(RDD):
             # Host None semantics (a dense column can't hold None).
             return super().left_outer_join(other, partitioner_or_num)
         # Host fallback with fill: emit per GROUP so a legitimate None right
-        # value is never conflated with "unmatched" (same contract as the
-        # dup-right fallback in _JoinRDD._host_join).
+        # value is never conflated with "unmatched".
 
         def emit(groups):
             lvs, rvs = groups
@@ -1405,6 +1406,16 @@ class _GroupByKeyRDD(_ExchangeRDD):
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
                      capacity=out_cap, mesh=self.mesh)
 
+    def collect_grouped(self):
+        """Columnar grouped collect: (keys, offsets, values) numpy arrays,
+        where group i's values are values[offsets[i]:offsets[i+1]] — the
+        ragged result WITHOUT per-key Python lists (group_by_key's scale
+        face; reference aggregator.rs:33-53 builds Vecs instead). Shards are
+        key-sorted and hash-disjoint, so boundaries fall out of one
+        vectorized pass over the concatenated rows."""
+        cols = self.block().to_numpy()
+        return _grouped_columnar(cols[KEY], cols[VALUE])
+
     def collect(self) -> list:
         # keys are sorted within each shard; shards don't overlap (hash
         # partitioned), so grouping is a single pass per shard run.
@@ -1416,11 +1427,12 @@ class _GroupByKeyRDD(_ExchangeRDD):
         yield from _sorted_runs(rows[KEY], rows[VALUE])
 
 
-class _DupRightKeys(Exception):
-    pass
-
-
 class _JoinRDD(_ExchangeRDD):
+    """Device sort-merge join with full duplicate-key semantics (dup x dup
+    product, reference pair_rdd.rs:104-121) — no host fallback on the dense
+    path. Output expansion beyond the exchange capacity is caught by the
+    kernel's overflow flag and retried with grown capacities."""
+
     def __init__(self, left: DenseRDD, right: DenseRDD,
                  outer: bool = False, fill_value=0):
         super().__init__(left.context, left.mesh, [left, right])
@@ -1428,7 +1440,6 @@ class _JoinRDD(_ExchangeRDD):
         self.right = right
         self.outer = outer
         self.fill_value = fill_value
-        self._host_fallback = None
 
     def _schema(self):
         ls = dict(self.left._schema())
@@ -1442,8 +1453,13 @@ class _JoinRDD(_ExchangeRDD):
         l_counts = np.asarray(jax.device_get(lblk.counts))
         r_counts = np.asarray(jax.device_get(rblk.counts))
         exchange = _get_exchange(self.exchange_mode)
+        join_cap_override: List[Optional[int]] = [None]
+        join_cap_used: List[int] = [0]
 
         def build(slot_pair, out_cap):
+            join_cap = join_cap_override[0] or out_cap
+            join_cap_used[0] = join_cap
+
             def prog_fn(lc, lk, lv, rc, rk, rv):
                 lcols, lcount = {KEY: lk, VALUE: lv}, lc[0]
                 rcols, rcount = {KEY: rk, VALUE: rv}, rc[0]
@@ -1459,18 +1475,18 @@ class _JoinRDD(_ExchangeRDD):
                 rcols, rcount, rof = exchange(
                     rcols, rcount, rb, n, slot_pair, out_cap
                 )
-                joined, jcount, dup = kernels.merge_join_unique_right(
-                    lcols, lcount, rcols, rcount, KEY, out_cap,
+                joined, jcount, jtotal = kernels.merge_join_expand(
+                    lcols, lcount, rcols, rcount, KEY, join_cap,
                     outer=self.outer, fill_value=self.fill_value,
                 )
                 return (
-                    jcount.reshape(1), joined[KEY], joined[VALUE],
-                    joined[f"r_{VALUE}"], dup.reshape(1),
+                    jcount.reshape(1), jtotal.reshape(1), joined[KEY],
+                    joined[VALUE], joined[f"r_{VALUE}"],
                     (lof | rof).reshape(1),
                 )
 
             prog = _cached_program(
-                ("join", self.mesh, n, slot_pair, out_cap,
+                ("join", self.mesh, n, slot_pair, out_cap, join_cap,
                  self.exchange_mode, self.outer, self.fill_value),
                 lambda: _shard_program(self.mesh, prog_fn, 6, (_SPEC,) * 6),
             )
@@ -1480,51 +1496,29 @@ class _JoinRDD(_ExchangeRDD):
             )
 
         counts = np.concatenate([l_counts, r_counts])
-        outs, out_cap = self._run_exchange(
-            build, counts,
-            hists=[self._hash_histogram(lblk), self._hash_histogram(rblk)],
-        )
-        jcounts, jk, jlv, jrv, dup = outs
-        if bool(np.any(np.asarray(jax.device_get(dup)))):
-            raise _DupRightKeys()
+        hists = [self._hash_histogram(lblk), self._hash_histogram(rblk)]
+        outs, _ = self._run_exchange(build, counts, hists=hists)
+        jcounts, jtotals = outs[0], np.asarray(jax.device_get(outs[1]))
+        if int(jtotals.max(initial=0)) >= 2**31 - 1:
+            raise VegaError(
+                "dense join product exceeds 2^31 rows on one shard — "
+                "cannot materialize; filter or pre-aggregate the heavy keys"
+            )
+        if int(jtotals.max(initial=0)) > join_cap_used[0]:
+            # dup x dup expansion exceeded the exchange-sized output; the
+            # kernel reported the exact product size, so ONE resized rerun
+            # is guaranteed to fit (no geometric-growth walk).
+            join_cap_override[0] = _cap_round(int(jtotals.max()))
+            outs, _ = self._run_exchange(build, counts, hists=hists)
+            jcounts = outs[0]
+        _, _, jk, jlv, jrv = outs
         return Block(
             cols={KEY: jk, "lv": jlv, "rv": jrv},
-            counts=jcounts, capacity=out_cap, mesh=self.mesh,
+            counts=jcounts, capacity=join_cap_used[0], mesh=self.mesh,
         )
 
-    def _host_join(self):
-        # Fallback for duplicate right-side keys: dense cogroup (exchange
-        # still on device) + host-side dup x dup expansion
-        # (reference: pair_rdd.rs:104-121).
-        if self._host_fallback is None:
-            cg = _DenseCoGroupRDD(self.left, self.right)
-            outer = self.outer
-            fill = self.fill_value
-
-            def emit(groups):
-                lvs, rvs = groups
-                if outer and not rvs:
-                    return [(lv, fill) for lv in lvs]
-                return [(lv, rv) for lv in lvs for rv in rvs]
-
-            self._host_fallback = cg.flat_map_values(emit)
-        return self._host_fallback
-
-    def block(self) -> Block:
-        try:
-            return super().block()
-        except _DupRightKeys:
-            raise VegaError(
-                "dense join requires unique keys on the right side; "
-                "use .to_rdd().join(...) for duplicate-key joins"
-            ) from None
-
     def collect(self) -> list:
-        try:
-            cols = self.block().to_numpy()
-        except VegaError:
-            log.info("dense join: duplicate right keys -> host fallback")
-            return self._host_join().collect()
+        cols = self.block().to_numpy()
         return [
             (k, (lv, rv))
             for k, lv, rv in zip(
@@ -1533,17 +1527,10 @@ class _JoinRDD(_ExchangeRDD):
         ]
 
     def count(self) -> int:
-        try:
-            return self.block().num_rows
-        except VegaError:
-            return self._host_join().count()
+        return self.block().num_rows
 
     def compute(self, split: Split, task_context=None):
-        try:
-            rows = self.block().shard_rows(split.index)
-        except VegaError:
-            yield from self._host_join().iterator(Split(split.index))
-            return
+        rows = self.block().shard_rows(split.index)
         for k, lv, rv in zip(rows[KEY].tolist(), rows["lv"].tolist(),
                              rows["rv"].tolist()):
             yield (k, (lv, rv))
@@ -1649,16 +1636,28 @@ class _SampleRDD(_NarrowRDD):
         return kernels.compact(cols, keep, cap)
 
 
+def _grouped_columnar(keys: np.ndarray, vals: np.ndarray):
+    """(group_keys, offsets, values) from key-sorted runs: group i's values
+    are values[offsets[i]:offsets[i+1]]. Pure vectorized numpy — no per-row
+    or per-key Python. Rows from different shards never share a key (hash
+    partitioning), so a key change marks every group boundary including
+    shard boundaries."""
+    if len(keys) == 0:
+        return keys, np.zeros(1, dtype=np.int64), vals
+    starts = np.concatenate(
+        [[0], np.flatnonzero(keys[1:] != keys[:-1]) + 1]
+    ).astype(np.int64)
+    offsets = np.concatenate([starts, [len(keys)]])
+    return keys[starts], offsets, vals
+
+
 def _sorted_runs(keys: np.ndarray, vals: np.ndarray):
     """(key, [values]) pairs from a key-sorted run (shared by group_by_key
-    collect/compute and cogroup)."""
-    if len(keys) == 0:
-        return
-    bounds = np.flatnonzero(keys[1:] != keys[:-1]) + 1
-    groups = np.split(vals, bounds)
-    group_keys = keys[np.concatenate([[0], bounds])]
-    for k, g in zip(group_keys, groups):
-        yield k.item(), g.tolist()
+    collect/compute and cogroup) — the host-facing view of
+    _grouped_columnar; per-GROUP (not per-row) Python cost."""
+    group_keys, offsets, values = _grouped_columnar(keys, vals)
+    for i, k in enumerate(group_keys.tolist()):
+        yield k, values[offsets[i]:offsets[i + 1]].tolist()
 
 
 class _DenseCoGroupRDD(RDD):
@@ -1685,13 +1684,26 @@ class _DenseCoGroupRDD(RDD):
         return self.mesh.size
 
     def compute(self, split: Split, task_context=None):
+        # Columnar alignment: both sides are key-sorted runs, so the merge
+        # is two vectorized searchsorted passes; Python cost is per GROUP
+        # (the unavoidable host-facing (k, ([lvs], [rvs])) assembly), never
+        # per row.
         lrows = self.left_grouped.block().shard_rows(split.index)
         rrows = self.right_grouped.block().shard_rows(split.index)
+        lk, loff, lv = _grouped_columnar(lrows[KEY], lrows[VALUE])
+        rk, roff, rv = _grouped_columnar(rrows[KEY], rrows[VALUE])
 
-        lmap = dict(_sorted_runs(lrows[KEY], lrows[VALUE]))
-        rmap = dict(_sorted_runs(rrows[KEY], rrows[VALUE]))
-        for k in lmap.keys() | rmap.keys():
-            yield (k, (lmap.get(k, []), rmap.get(k, [])))
+        union = np.union1d(lk, rk)
+        li = np.searchsorted(lk, union)
+        ri = np.searchsorted(rk, union)
+        has_l = np.isin(union, lk, assume_unique=True)
+        has_r = np.isin(union, rk, assume_unique=True)
+        for j, k in enumerate(union.tolist()):
+            lvs = (lv[loff[li[j]]:loff[li[j] + 1]].tolist()
+                   if has_l[j] else [])
+            rvs = (rv[roff[ri[j]]:roff[ri[j] + 1]].tolist()
+                   if has_r[j] else [])
+            yield (k, (lvs, rvs))
 
     def collect(self) -> list:
         out = []

@@ -356,52 +356,87 @@ def segment_reduce_named(
 # ---------------------------------------------------------------------------
 
 
-def merge_join_unique_right(
+def merge_join_expand(
     left: Cols, left_count: jax.Array,
     right: Cols, right_count: jax.Array,
     key_name: str,
     out_capacity: int,
     outer: bool = False,
     fill_value: float = 0,
-) -> Tuple[Cols, jax.Array]:
-    """Join with unique right keys (probe via binary search). Inner
-    (outer=False): every matching left row + matched right columns. Left
-    outer (outer=True): every valid left row; unmatched rows get fill_value
-    in the right columns. Static shapes end-to-end (output <= left
-    capacity).
+) -> Tuple[Cols, jax.Array, jax.Array]:
+    """General sort-merge join with duplicate keys on BOTH sides.
 
-    The general dup x dup case routes through group-exchange + host (or the
-    device cogroup), matching the reference's CoGroupedRDD semantics."""
+    Reference semantics (pair_rdd.rs:104-121 via cogroup): inner join emits
+    the full dup x dup product per key; left outer emits every valid left
+    row, with fill_value in right columns when unmatched. Static shapes:
+    output rows are assigned by ragged expansion — per-left-row match
+    counts -> exclusive prefix sums -> each output slot finds its owning
+    left row by binary search — so the product materializes into a fixed
+    out_capacity with an overflow flag (the exchange capacity-factor
+    pattern; driver retries with a larger capacity). Output rows are
+    key-sorted (left sort order), deterministic across capacities.
+
+    Returns (cols, count, total) where count = min(total, out_capacity) and
+    total is the exact full product size — the driver uses it to size the
+    ONE retry exactly instead of growing geometrically (a dup x dup product
+    can exceed any constant growth factor). Right columns appear as
+    "r_<name>".
+    """
     lcap = left[key_name].shape[0]
     rcap = right[key_name].shape[0]
-    lmask = valid_mask(lcap, left_count)
+    left = sort_by_column(left, left_count, key_name)
     right = sort_by_column(right, right_count, key_name)
+    lkeys = left[key_name]
     rkeys = right[key_name]
     rmask = valid_mask(rcap, right_count)
-    sentinel = _orderable_max(rkeys)
-    rkeys = jnp.where(rmask, rkeys, sentinel)
-    # Detect duplicate right keys: adjacent equal valid keys after the sort.
-    dup_right = jnp.any((rkeys[1:] == rkeys[:-1]) & rmask[1:] & rmask[:-1])
+    rkeys = jnp.where(rmask, rkeys, _orderable_max(rkeys))
+    lmask = valid_mask(lcap, left_count)
 
-    lkeys = left[key_name]
-    pos = jnp.searchsorted(rkeys, lkeys)
-    pos = jnp.clip(pos, 0, rcap - 1)
-    matched = lmask & (jnp.take(rkeys, pos) == lkeys) & (
-        pos < right_count
-    )
-    out = dict(left)
+    # Per-left-row match range in the sorted right block. The min() guards
+    # clip sentinel-padded rows out when a valid key equals the sentinel.
+    lo = jnp.minimum(jnp.searchsorted(rkeys, lkeys, side="left"),
+                     right_count)
+    hi = jnp.minimum(jnp.searchsorted(rkeys, lkeys, side="right"),
+                     right_count)
+    n_match = hi - lo
+    if outer:
+        m = jnp.where(lmask, jnp.maximum(n_match, 1), 0)
+    else:
+        m = jnp.where(lmask, n_match, 0)
+    starts = jnp.cumsum(m) - m
+    total = jnp.sum(m).astype(jnp.int32)
+    # int32 wrap guard: a dup x dup product over 2^31 rows/shard cannot
+    # materialize anyway (25+ GB of rows), but it must fail loudly, not
+    # return a truncated block. Wrapped prefix sums go negative; saturate
+    # total to INT32_MAX as the driver-visible "impossible" sentinel.
+    wrapped = (total < 0) | jnp.any(starts < 0)
+    total = jnp.where(wrapped, jnp.int32(2**31 - 1), total)
+
+    # Output slot j belongs to the last left row whose start <= j (rows
+    # with m == 0 never own a slot: the next row shares their start and
+    # wins the 'right'-side search).
+    j = lax.iota(jnp.int32, out_capacity)
+    li = jnp.clip(jnp.searchsorted(starts, j, side="right") - 1, 0, lcap - 1)
+    ri = jnp.clip(jnp.take(lo, li) + (j - jnp.take(starts, li)), 0, rcap - 1)
+    row_matched = jnp.take(n_match > 0, li)
+
+    out: Cols = {key_name: jnp.take(lkeys, li)}
+    for name, col in left.items():
+        if name != key_name:
+            out[name] = jnp.take(col, li, axis=0)
     for name, col in right.items():
         if name == key_name:
             continue
-        taken = jnp.take(col, pos, axis=0)
+        taken = jnp.take(col, ri, axis=0)
         if outer:
             fill = jnp.asarray(fill_value, dtype=col.dtype)
-            m = matched.reshape(matched.shape + (1,) * (taken.ndim - 1))
-            taken = jnp.where(m, taken, fill)
+            mm = row_matched.reshape(row_matched.shape
+                                     + (1,) * (taken.ndim - 1))
+            taken = jnp.where(mm, taken, fill)
         out[f"r_{name}"] = taken
-    keep = lmask if outer else matched
-    cols, count = compact(out, keep, out_capacity)
-    return cols, count, dup_right
+    # Valid output slots are the prefix [0, total) — already compact.
+    count = jnp.minimum(total, out_capacity)
+    return out, count, total
 
 
 # ---------------------------------------------------------------------------
