@@ -1,0 +1,163 @@
+"""Streamed dense sources: bounded-HBM chunked execution (tpu/stream.py).
+
+The 1B-row single-chip story at test scale: sources over the HBM budget
+flow chunk by chunk; streaming reduce_by_key must match the resident path
+exactly."""
+
+import numpy as np
+import pytest
+
+import vega_tpu as v
+from vega_tpu.tpu.stream import StreamedDenseRDD, planned_chunk_rows
+
+
+def test_planned_chunk_rows_policy():
+    # fits: no streaming
+    assert planned_chunk_rows(1000, 4, 4 << 30) is None
+    # explicit chunk_rows wins
+    assert planned_chunk_rows(1000, 4, 4 << 30, chunk_rows=100) == 100
+    # over budget: chunks are 1M-row multiples, rounded DOWN (footprint
+    # must stay within budget)
+    rows = planned_chunk_rows(1_000_000_000, 8, 4 << 30)
+    assert rows is not None and rows % (1 << 20) == 0
+    assert rows * 8 * 6 <= 4 << 30
+    # wide rows / tiny budgets: pow2 chunks below 1M, still within budget
+    small = planned_chunk_rows(10_000_000, 1024, 1 << 30)
+    assert small is not None and small < (1 << 20)
+    assert small * 1024 * 6 <= 1 << 30
+    assert small & (small - 1) == 0  # power of two
+
+
+def test_streamed_reduce_by_key_parity(ctx):
+    n, k, chunk = 200_000, 777, 30_000
+    streamed = ctx.dense_range(n, chunk_rows=chunk)
+    assert isinstance(streamed, StreamedDenseRDD)
+    assert streamed.n_chunks == -(-n // chunk)
+    got = dict(
+        streamed.map(lambda x: (x % k, x)).reduce_by_key(op="add")
+        .collect()
+    )
+    resident = dict(
+        ctx.dense_range(n).map(lambda x: (x % k, x))
+        .reduce_by_key(op="add").collect()
+    )
+    assert got == resident  # int sums: exact across chunk boundaries
+
+    # Float sums associate differently across chunks (documented float
+    # reduction-order caveat, SURVEY §7 hard part 4): tolerance compare.
+    gotf = dict(
+        ctx.dense_range(n, chunk_rows=chunk)
+        .map(lambda x: (x % k, x * 0.5)).reduce_by_key(op="add").collect()
+    )
+    residentf = dict(
+        ctx.dense_range(n).map(lambda x: (x % k, x * 0.5))
+        .reduce_by_key(op="add").collect()
+    )
+    for kk, val in residentf.items():
+        assert gotf[kk] == pytest.approx(val, rel=1e-6)
+
+
+def test_streamed_groupby_join_pipeline(ctx):
+    """The BASELINE north-star shape end-to-end: streamed source ->
+    reduce_by_key -> join against a resident table."""
+    n, k, chunk = 120_000, 500, 25_000
+    reduced = (ctx.dense_range(n, chunk_rows=chunk)
+               .map(lambda x: (x % k, x)).reduce_by_key(op="add"))
+    table = ctx.dense_from_numpy(np.arange(k, dtype=np.int32),
+                                 np.arange(k, dtype=np.int32) * 2)
+    joined = reduced.join(table)
+    assert joined.count() == k
+    got = {kk: (a, b) for kk, (a, b) in joined.collect()}
+    for kk in (0, 7, k - 1):
+        assert got[kk] == (sum(x for x in range(n) if x % k == kk), kk * 2)
+
+
+def test_streamed_narrow_ops_and_folds(ctx):
+    s = ctx.dense_range(50_000, chunk_rows=8_000)
+    assert s.count() == 50_000
+    assert s.sum() == sum(range(50_000))
+    assert s.map(lambda x: x * 2).max() == 2 * 49_999
+    assert s.filter(lambda x: x % 10 == 0).count() == 5_000
+    assert s.min() == 0
+
+
+def test_streamed_untraceable_map_falls_back(ctx):
+    """The two-tier contract survives streaming: an untraceable closure
+    degrades to the resident build's host fallback, never errors."""
+    s = ctx.dense_range(10_000, chunk_rows=2_000)
+    r = s.map(lambda x: f"row-{int(x)}")
+    assert not isinstance(r, StreamedDenseRDD)
+    assert r.take(2) == ["row-0", "row-1"]
+
+
+def test_streamed_unsupported_op_delegates_to_resident(ctx):
+    """Ops without a streaming path (group_by_key, collect, ...) run on
+    the resident build transparently."""
+    s = ctx.dense_range(10_000, chunk_rows=2_000)
+    grouped = dict(s.map(lambda x: (x % 5, x)).group_by_key().collect())
+    assert sorted(grouped[3]) == list(range(3, 10_000, 5))
+    assert sorted(s.collect()) == list(range(10_000))
+
+
+def test_streamed_untraceable_reduce_falls_back(ctx):
+    s = ctx.dense_range(5_000, chunk_rows=1_000)
+    got = dict(
+        s.map(lambda x: (x % 3, x))
+        .reduce_by_key(lambda a, b: max(int(a), int(b))).collect()
+    )
+    assert got == {k: max(range(k, 5_000, 3)) for k in range(3)}
+
+
+def test_auto_stream_kicks_in_over_budget(ctx):
+    """A tiny configured budget must flip dense_range into streaming."""
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_hbm_budget
+    Env.get().conf.dense_hbm_budget = 1 << 20  # 1 MiB
+    try:
+        s = ctx.dense_range(2_000_000)
+        assert isinstance(s, StreamedDenseRDD)
+        assert s.count() == 2_000_000
+    finally:
+        Env.get().conf.dense_hbm_budget = old
+
+
+def test_streamed_npz_roundtrip(ctx, tmp_path):
+    n = 40_000
+    keys = (np.arange(n) % 101).astype(np.int32)
+    vals = np.arange(n, dtype=np.int32)
+    resident = ctx.dense_from_numpy(keys, vals)
+    path = str(tmp_path / "blk.npz")
+    resident.save_npz(path)
+
+    streamed = ctx.dense_load_npz(path, chunk_rows=7_000)
+    assert isinstance(streamed, StreamedDenseRDD)
+    got = dict(streamed.reduce_by_key(op="add").collect())
+    exp = dict(resident.reduce_by_key(op="add").collect())
+    assert got == exp
+
+
+def test_streamed_map_filter_chain(ctx):
+    """Narrow chains compose per chunk and agree with the resident path."""
+    s = (ctx.dense_range(60_000, chunk_rows=9_000)
+         .map(lambda x: x * 2).filter(lambda x: x % 6 == 0))
+    r = (ctx.dense_range(60_000)
+         .map(lambda x: x * 2).filter(lambda x: x % 6 == 0))
+    assert s.count() == r.count()
+    assert s.max() == r.max()
+
+
+def test_chunk_rows_validation(ctx):
+    with pytest.raises(v.VegaError, match="chunk_rows"):
+        ctx.dense_range(1_000, chunk_rows=0)
+    with pytest.raises(v.VegaError, match="chunk_rows"):
+        ctx.dense_range(1_000, chunk_rows=-5)
+
+
+def test_resident_fallback_memoized(ctx):
+    """Repeated non-streamable ops materialize the resident build once."""
+    s = ctx.dense_range(10_000, chunk_rows=2_000)
+    first = s.resident()
+    assert s.resident() is first
+    s.collect()
+    assert s.resident() is first

@@ -156,11 +156,14 @@ class Context:
     # Device-tier sources (vega_tpu/tpu): numeric RDDs whose partitions are
     # arrays and whose ops lower to XLA.
     def dense_range(self, n: int, num_partitions: Optional[int] = None,
-                    dtype=None):
+                    dtype=None, chunk_rows: Optional[int] = None):
+        """Device iota source; auto-streams in chunks when block bytes
+        times the exchange footprint (~6x) exceed
+        Configuration.dense_hbm_budget (see tpu/stream.py)."""
         from vega_tpu.tpu.dense_rdd import dense_range
 
         return dense_range(self, n, num_partitions or self.default_parallelism,
-                           dtype)
+                           dtype, chunk_rows=chunk_rows)
 
     def dense_from_numpy(self, *columns, num_partitions: Optional[int] = None):
         from vega_tpu.tpu.dense_rdd import dense_from_numpy
@@ -176,12 +179,13 @@ class Context:
 
         return dense_from_columns(self, columns, key=key, **kwcolumns)
 
-    def dense_load_npz(self, path: str):
+    def dense_load_npz(self, path: str, chunk_rows: Optional[int] = None):
         """Reload a DenseRDD persisted with save_npz (re-sharded onto the
-        current mesh)."""
+        current mesh); auto-streams in chunks when block bytes times the
+        exchange footprint (~6x) exceed the HBM budget."""
         from vega_tpu.tpu.dense_rdd import dense_load_npz
 
-        return dense_load_npz(self, path)
+        return dense_load_npz(self, path, chunk_rows=chunk_rows)
 
     def profiler(self, log_dir: str):
         """JAX profiler trace over a block of work (the tracing subsystem

@@ -78,6 +78,14 @@ class Configuration:
     speculation: bool = False
     speculation_multiplier: float = 3.0
     speculation_min_s: float = 1.0
+    # Dense-tier HBM budget in bytes (per chip). Sources stream through
+    # the mesh in chunks (tpu/stream.py) when estimated block bytes times
+    # the exchange footprint factor (~6: operand + sorted copy + send
+    # slots + received block) exceed this — i.e. resident execution is
+    # kept only while block_bytes * 6 <= budget. Default 4 GiB:
+    # conservative for a 16 GiB v5e chip once XLA workspace and a second
+    # live block are accounted for.
+    dense_hbm_budget: int = 4 << 30
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -91,7 +99,8 @@ class Configuration:
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",
-                     "CACHE_CAPACITY_BYTES", "MAX_FAILURES"):
+                     "CACHE_CAPACITY_BYTES", "MAX_FAILURES",
+                     "DENSE_HBM_BUDGET"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",

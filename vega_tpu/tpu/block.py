@@ -44,6 +44,15 @@ class Block:
     def column_names(self) -> List[str]:
         return list(self.cols)
 
+    @property
+    def nbytes(self) -> int:
+        """Device-resident bytes of this block (all columns, full static
+        capacity — padding rows occupy HBM like any others). HBM
+        accounting for materialized blocks; pre-materialization sizing
+        (which only has row counts) lives in stream.planned_chunk_rows."""
+        return sum(int(np.prod(c.shape)) * c.dtype.itemsize
+                   for c in self.cols.values())
+
     def to_numpy(self) -> Dict[str, np.ndarray]:
         """Gather valid rows to host, shard order preserved."""
         counts = np.asarray(jax.device_get(self.counts))
@@ -137,10 +146,11 @@ def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
     return Block(cols=cols, counts=counts_arr, capacity=cap, mesh=mesh)
 
 
-def block_range(n: int, mesh=None, dtype=jnp.int32) -> Block:
-    """Lazy iota block: shard s holds [s*per, s*per+count_s) — the device
-    analogue of ctx.range (reference: context.rs:422-442), built on device
-    with no host materialization."""
+def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
+    """Lazy iota block: shard s holds [start+s*per, start+s*per+count_s) —
+    the device analogue of ctx.range (reference: context.rs:422-442), built
+    on device with no host materialization. `start` offsets the whole range
+    (used by the chunked/streamed source)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = mesh or mesh_lib.default_mesh()
@@ -154,7 +164,7 @@ def block_range(n: int, mesh=None, dtype=jnp.int32) -> Block:
 
     def build(shard_id):
         # shard_id: int32[1] per shard under shard_map
-        base = shard_id[0] * per
+        base = start + shard_id[0] * per
         vals = base + jax.lax.iota(dtype, cap)
         return vals
 

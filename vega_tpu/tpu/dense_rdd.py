@@ -1026,9 +1026,26 @@ class _SourceRDD(DenseRDD):
         return tuple((n, c.dtype) for n, c in self._block.cols.items())
 
 
-def dense_range(ctx, n: int, num_partitions=None, dtype=None) -> DenseRDD:
+def dense_range(ctx, n: int, num_partitions=None, dtype=None,
+                chunk_rows: Optional[int] = None):
+    """Device iota source. When estimated block bytes times the exchange
+    footprint (~6x transient copies) exceed Configuration.dense_hbm_budget,
+    returns a StreamedDenseRDD that flows chunk by chunk through the mesh
+    instead of materializing whole (the 1B-row single-chip path); pass
+    chunk_rows to force streaming."""
+    from vega_tpu.env import Env
+    from vega_tpu.tpu.stream import planned_chunk_rows, streamed_range
+
     mesh = mesh_lib.default_mesh()
-    return _SourceRDD(ctx, block_lib.block_range(n, mesh, dtype or jnp.int32))
+    dtype = dtype or jnp.int32
+    rows = planned_chunk_rows(
+        n, jnp.dtype(dtype).itemsize,
+        getattr(Env.get().conf, "dense_hbm_budget", 4 << 30),
+        chunk_rows,
+    )
+    if rows is not None and rows < n:
+        return streamed_range(ctx, n, rows, mesh, dtype)
+    return _SourceRDD(ctx, block_lib.block_range(n, mesh, dtype))
 
 
 def dense_from_numpy(ctx, columns, num_partitions=None) -> DenseRDD:
@@ -1085,13 +1102,31 @@ def dense_from_block(ctx, blk: Block) -> DenseRDD:
     return _SourceRDD(ctx, blk)
 
 
-def dense_load_npz(ctx, path: str) -> DenseRDD:
+def dense_load_npz(ctx, path: str, chunk_rows: Optional[int] = None):
     """Load a block persisted with DenseRDD.save_npz; data is re-sharded
     over the current default mesh (so a block saved on one topology loads
     onto another — the persistence story the reference lacks entirely,
-    SURVEY.md §5 'Checkpoint/resume: none')."""
+    SURVEY.md §5 'Checkpoint/resume: none'). Files bigger than the HBM
+    budget stream chunk by chunk (host RAM holds the file; HBM holds one
+    chunk); pass chunk_rows to force streaming."""
+    from vega_tpu.env import Env
+    from vega_tpu.tpu.stream import planned_chunk_rows, streamed_npz
+
     with np.load(path, allow_pickle=False) as data:
         cols = {n: data[n] for n in data.files}
+    n = len(next(iter(cols.values()))) if cols else 0
+    bytes_per_row = sum(
+        c.dtype.itemsize * int(np.prod(c.shape[1:], dtype=np.int64))
+        for c in cols.values()
+    ) or 1
+    rows = planned_chunk_rows(
+        n, bytes_per_row,
+        getattr(Env.get().conf, "dense_hbm_budget", 4 << 30),
+        chunk_rows,
+    )
+    if rows is not None and rows < n:
+        # Reuse the already-loaded host columns — no second npz read.
+        return streamed_npz(ctx, cols, rows, mesh_lib.default_mesh())
     blk = block_lib.from_numpy(cols, mesh_lib.default_mesh())
     return _SourceRDD(ctx, blk)
 

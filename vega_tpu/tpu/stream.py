@@ -1,0 +1,291 @@
+"""Streamed dense sources: datasets bigger than the HBM budget.
+
+The dense tier materializes whole Blocks; a 1B-row (key, value) source is
+~8 GB of raw columns and several times that in transient exchange buffers
+— it cannot live resident on one chip (SURVEY.md §7 hard part 6; the
+reference never solved memory either: cache.rs:68-76 eviction is todo!()).
+
+A StreamedDenseRDD holds a *recipe* for the data as a sequence of chunk
+DenseRDDs, each small enough (chunk_bytes * _EXCHANGE_FOOTPRINT fits the
+Configuration.dense_hbm_budget) to run the normal fused device pipelines.
+Narrow ops (map/filter/map_values) compose per chunk. Aggregations stream:
+
+  reduce_by_key: each chunk runs the full device exchange+segment-reduce,
+  producing a small combiner block; partials fold into an accumulator via
+  union + re-reduce (the accumulator is bounded by the number of distinct
+  keys, not rows). The result is a REGULAR DenseRDD — downstream joins,
+  sorts, collects run the resident path. This is the multi-pass schedule
+  for BASELINE config 5's 1B-row group_by+join on a single chip.
+
+  count/sum/min/max: per-chunk named reductions folded on the host.
+
+Anything else — untraceable closures, group_by_key, collect, the whole
+host-RDD surface — transparently falls back to the RESIDENT build (the
+exact behavior auto-streaming replaced), preserving the two-tier contract
+that unsupported operations degrade, never error. At scales where resident
+materialization is impossible the fallback fails the same way it always
+would; the streamed fast paths are how those scales are meant to run.
+
+Chunking policy lives in planned_chunk_rows(): sources auto-stream when
+their estimated block bytes exceed the budget; chunk_rows can be forced
+explicitly.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from vega_tpu.errors import VegaError
+
+log = logging.getLogger("vega_tpu")
+
+# An exchange holds ~this many transient copies of its operand block
+# (operand + multi-key-sorted copy + send slots + received block), so a
+# chunk is sized such that chunk_bytes * footprint <= budget.
+_EXCHANGE_FOOTPRINT = 6
+
+
+def planned_chunk_rows(n_rows: int, bytes_per_row: int,
+                       budget_bytes: int,
+                       chunk_rows: Optional[int] = None) -> Optional[int]:
+    """None when the whole source fits the budget (no streaming needed),
+    else the chunk size, rounded DOWN to a shape-stable bucket (1M-row
+    multiples, or a power of two below 1M) so the chunk footprint stays
+    within budget and block capacities repeat across chunks."""
+    if chunk_rows is not None:
+        if int(chunk_rows) < 1:
+            raise VegaError(f"chunk_rows must be >= 1, got {chunk_rows}")
+        return int(chunk_rows)
+    if n_rows * bytes_per_row * _EXCHANGE_FOOTPRINT <= budget_bytes:
+        return None
+    rows = max(int(budget_bytes // (bytes_per_row * _EXCHANGE_FOOTPRINT)), 1)
+    step = 1 << 20
+    if rows >= step:
+        return (rows // step) * step
+    return max(128, 1 << (rows.bit_length() - 1))
+
+
+class StreamedDenseRDD:
+    """A chunked dense dataset: `chunks()` yields fresh per-chunk DenseRDDs
+    (so HBM for one chunk is released before the next materializes), and
+    `resident()` builds the equivalent un-chunked DenseRDD for operations
+    that cannot stream.
+
+    Not an RDD subclass on purpose: the host tier's per-partition pull
+    model would force the whole dataset resident; the streamed surface is
+    the explicit, bounded-memory subset of the dense API, with everything
+    else delegated to the resident fallback."""
+
+    def __init__(self, ctx, make_chunks: Callable[[], Iterator],
+                 make_resident: Callable[[], object], n_chunks: int,
+                 make_probe: Optional[Callable[[], object]] = None):
+        self.context = ctx
+        self._make_chunks = make_chunks
+        self._make_resident = make_resident
+        self.n_chunks = n_chunks
+        # Tiny (few-row) chunk with the stream's schema, used only to
+        # decide closure traceability — never full-size data.
+        self._make_probe = make_probe or (
+            lambda: next(iter(make_chunks()), None))
+        self._resident_memo = None
+
+    def resident(self):
+        """The un-chunked DenseRDD this stream is a recipe for (or a host
+        RDD, if a composed closure was untraceable). Memoized: repeated
+        fallback ops materialize the dataset once, not per access."""
+        if self._resident_memo is None:
+            self._resident_memo = self._make_resident()
+        return self._resident_memo
+
+    def __getattr__(self, name):
+        # Fallback surface: any op without a streaming implementation runs
+        # against the resident build — the behavior auto-streaming
+        # replaced. (Only called for names not defined on the class.)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        attr = getattr(self.resident(), name)
+        if not callable(attr):
+            return attr
+        log.info(
+            "streamed source: %s() has no streaming path — materializing "
+            "resident (%d chunks coalesce)", name, self.n_chunks,
+        )
+        return attr
+
+    # --- narrow ops: compose per chunk -----------------------------------
+    def _per_chunk(self, op_name: str, apply) -> "StreamedDenseRDD":
+        make = self._make_chunks
+        make_resident = self._make_resident
+        make_probe = self._make_probe
+
+        # Traceability probe on a few-row block BEFORE building the
+        # streamed node: untraceable closures take the resident path
+        # (which itself falls back to the host tier) instead of erroring
+        # mid-stream. Node construction is lazy, so this allocates rows
+        # only for the tiny probe block.
+        probe = make_probe()
+        if probe is not None:
+            from vega_tpu.tpu.dense_rdd import DenseRDD
+
+            if not isinstance(apply(probe), DenseRDD):
+                log.info("streamed %s: closure not traceable — resident "
+                         "fallback", op_name)
+                return apply(self.resident())
+
+        def chunks():
+            for chunk in make():
+                yield apply(chunk)
+
+        return StreamedDenseRDD(self.context, chunks,
+                                lambda: apply(make_resident()),
+                                self.n_chunks,
+                                make_probe=lambda: apply(make_probe()))
+
+    def map(self, f: Callable):
+        return self._per_chunk("map", lambda c: c.map(f))
+
+    def filter(self, predicate: Callable):
+        return self._per_chunk("filter", lambda c: c.filter(predicate))
+
+    def map_values(self, f: Callable):
+        return self._per_chunk("map_values", lambda c: c.map_values(f))
+
+    # --- streaming aggregations ------------------------------------------
+    def reduce_by_key(self, func=None, partitioner_or_num=None, *,
+                      op: Optional[str] = None,
+                      exchange: Optional[str] = None):
+        """Multi-pass reduce_by_key; returns a regular (resident) DenseRDD
+        whose size is bounded by the number of distinct keys."""
+        from vega_tpu.tpu.dense_rdd import (DenseRDD, _DenseUnionRDD,
+                                            dense_from_block)
+
+        acc = None
+        for i, chunk in enumerate(self._make_chunks()):
+            partial = chunk.reduce_by_key(func, partitioner_or_num, op=op,
+                                          exchange=exchange)
+            if not isinstance(partial, DenseRDD):
+                # Untraceable combiner fell back to the host tier inside
+                # the chunk — streaming can't help; run resident (same
+                # degradation the non-streamed path takes).
+                log.info("streamed reduce_by_key: combiner not traceable "
+                         "— resident fallback")
+                return self.resident().reduce_by_key(
+                    func, partitioner_or_num)
+            merged = (partial if acc is None
+                      else _DenseUnionRDD(acc, partial).reduce_by_key(
+                          func, partitioner_or_num, op=op, exchange=exchange))
+            # Materialize now and keep only the block: drops the lineage
+            # references to this chunk's source so its HBM frees before the
+            # next chunk builds.
+            blk = merged.block()
+            acc = dense_from_block(self.context, blk)
+            log.info(
+                "streamed reduce_by_key: chunk %d/%d -> %d keys "
+                "(accumulator %.1f MiB device-resident)",
+                i + 1, self.n_chunks, blk.num_rows, blk.nbytes / 2**20,
+            )
+        if acc is None:
+            raise VegaError("streamed reduce_by_key on empty source")
+        return acc
+
+    def count(self) -> int:
+        return sum(c.count() for c in self._make_chunks())
+
+    def _fold_named(self, op: str):
+        total = None
+        for chunk in self._make_chunks():
+            part = getattr(chunk, {"add": "sum", "min": "min",
+                                   "max": "max"}[op])()
+            if total is None:
+                total = part
+            elif op == "add":
+                total = total + part
+            elif op == "min":
+                total = min(total, part)
+            else:
+                total = max(total, part)
+        if total is None:
+            raise VegaError("reduction over empty streamed source")
+        return total
+
+    def sum(self):
+        return self._fold_named("add")
+
+    def min(self):
+        return self._fold_named("min")
+
+    def max(self):
+        return self._fold_named("max")
+
+
+def streamed_range(ctx, n: int, chunk_rows: int, mesh=None,
+                   dtype=None) -> StreamedDenseRDD:
+    """Chunked ctx.dense_range: chunk i covers [i*chunk_rows, ...)."""
+    import jax.numpy as jnp
+
+    from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu import mesh as mesh_lib
+    from vega_tpu.tpu.dense_rdd import dense_from_block
+
+    mesh = mesh or mesh_lib.default_mesh()
+    dtype = dtype or jnp.int32
+    n_chunks = -(-n // chunk_rows)
+
+    def chunks():
+        for i in range(n_chunks):
+            start = i * chunk_rows
+            size = min(chunk_rows, n - start)
+            yield dense_from_block(
+                ctx, block_lib.block_range(size, mesh, dtype, start=start)
+            )
+
+    def resident():
+        return dense_from_block(ctx, block_lib.block_range(n, mesh, dtype))
+
+    def probe():
+        return dense_from_block(
+            ctx, block_lib.block_range(min(n, 8), mesh, dtype)
+        )
+
+    return StreamedDenseRDD(ctx, chunks, resident, n_chunks,
+                            make_probe=probe)
+
+
+def streamed_npz(ctx, cols: dict, chunk_rows: int, mesh=None
+                 ) -> StreamedDenseRDD:
+    """Chunked dense_load_npz over already-loaded host columns: host RAM
+    holds the file once (the caller's copy is reused, not re-read); HBM
+    only ever holds one chunk."""
+    from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu import mesh as mesh_lib
+    from vega_tpu.tpu.dense_rdd import dense_from_block
+
+    mesh = mesh or mesh_lib.default_mesh()
+    n = len(next(iter(cols.values()))) if cols else 0
+    n_chunks = max(1, -(-n // chunk_rows))
+
+    def chunks():
+        for i in range(n_chunks):
+            lo = i * chunk_rows
+            hi = min(lo + chunk_rows, n)
+            yield dense_from_block(
+                ctx,
+                block_lib.from_numpy(
+                    {name: col[lo:hi] for name, col in cols.items()}, mesh
+                ),
+            )
+
+    def resident():
+        return dense_from_block(ctx, block_lib.from_numpy(cols, mesh))
+
+    def probe():
+        if n == 0:
+            return None
+        tiny = {name: col[:min(n, 8)] for name, col in cols.items()}
+        return dense_from_block(ctx, block_lib.from_numpy(tiny, mesh))
+
+    return StreamedDenseRDD(ctx, chunks, resident, n_chunks,
+                            make_probe=probe)
