@@ -1,0 +1,160 @@
+"""Multi-host plumbing tests.
+
+Round-1 gap: the ssh worker-launch branch (distributed/backend.py) and
+tpu/mesh.init_multihost (jax.distributed) were dead code as far as tests
+knew. These tests exercise both without real remote hosts:
+
+- ssh launch: no sshd exists in this sandbox, so an `ssh` shim on PATH
+  drops the host argument and execs the worker command locally. The shim
+  path still exercises everything the real one does on the driver side —
+  argv construction, the VEGA_WORKER_READY handshake over the ssh
+  process's stdout, task dispatch to the advertised URI, and shutdown.
+  The worker binds 127.0.0.2: a loopback address (Linux routes all of
+  127/8 locally) that is NOT the literal "127.0.0.1"/"localhost" the
+  local-subprocess branch matches, so the ssh branch is the one that runs.
+
+- jax.distributed: two real processes join one coordinator and run a
+  cross-process global-mesh reduction on the CPU backend (the DCN
+  analogue of the reference's multi-host bootstrap, context.rs:209-303).
+  Skipped if this jax build can't do multi-process CPU collectives.
+
+Kept in a separate module from test_distributed.py: each test here builds
+its own Context, and the one-live-Context-per-process invariant means they
+must not overlap that module's module-scoped fixture.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import vega_tpu as v
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_ssh_launch_path_with_shim(tmp_path, monkeypatch):
+    """The ssh executor-launch branch works end to end (driver-side
+    plumbing exercised for real; transport faked by a local-exec shim)."""
+    shim = tmp_path / "ssh"
+    shim.write_text("#!/bin/sh\n# fake ssh: drop the host arg, exec "
+                    "the command locally\nshift\nexec \"$@\"\n")
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}{os.environ['PATH']}")
+
+    hosts = tmp_path / "hosts.conf"
+    hosts.write_text("master = 127.0.0.1\nslaves = 127.0.0.2:2\n")
+
+    ctx = v.Context("distributed", hosts_file=str(hosts), num_workers=2)
+    try:
+        backend = ctx._backend
+        assert len(backend._executors) == 2
+        assert all(ex.host == "127.0.0.2" for ex in
+                   backend._executors.values())
+        assert all(ex.task_uri.startswith("127.0.0.2:") for ex in
+                   backend._executors.values())
+        got = dict(
+            ctx.parallelize([(i % 3, i) for i in range(60)], 4)
+            .reduce_by_key(lambda a, b: a + b, 3).collect()
+        )
+        assert got == {k: sum(range(k, 60, 3)) for k in range(3)}
+    finally:
+        ctx.stop()
+
+
+def test_ssh_launch_missing_binary_fails_loudly(tmp_path, monkeypatch):
+    """Without any `ssh` on PATH, remote hosts must fail with a clear
+    error, not hang the driver."""
+    monkeypatch.setenv("PATH", str(tmp_path))  # no ssh, no anything
+    hosts = tmp_path / "hosts.conf"
+    hosts.write_text("slaves = 10.99.99.99\n")
+    with pytest.raises(Exception):
+        v.Context("distributed", hosts_file=str(hosts))
+    # The failed Context must not leave a live singleton behind.
+    v.Context("local").stop()
+
+
+_MULTIHOST_SCRIPT = textwrap.dedent("""
+    import sys
+
+    sys.path.insert(0, {repo!r})
+    from _cpu_mesh import force_cpu_mesh
+
+    # assert_count=False: the asserts would initialize the XLA backend,
+    # which must not happen before jax.distributed.initialize().
+    force_cpu_mesh(2, assert_count=False)
+
+    import jax
+    import numpy as np
+
+    from vega_tpu.tpu import mesh as mesh_lib
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    mesh_lib.init_multihost(coordinator=coordinator, num_processes=2,
+                            process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    assert n_global == 2 * n_local, (n_global, n_local)
+
+    mesh = mesh_lib.default_mesh()
+    assert mesh.size == n_global
+
+    # A real cross-process reduction over the global mesh.
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(mesh_lib.SHARD_AXIS))
+    local = np.full(n_local, float(pid + 1), dtype=np.float32)
+    arr = jax.make_array_from_process_local_data(sharding, local,
+                                                 (n_global,))
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    assert float(total) == n_local * 1.0 + n_local * 2.0, float(total)
+    print("MULTIHOST_OK", pid, flush=True)
+""")
+
+
+def test_jax_distributed_two_process_smoke(tmp_path):
+    """tpu/mesh.init_multihost glues two processes into one global device
+    set and a cross-process collective produces the right answer."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_MULTIHOST_SCRIPT.format(repo=repo))
+    coordinator = f"127.0.0.1:{_free_port()}"
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("jax.distributed CPU rendezvous timed out — "
+                    "unsupported in this environment")
+    for rc, out, err in outs:
+        if rc != 0 and ("unimplemented" in err.lower()
+                        or "not supported" in err.lower()
+                        or "unavailable" in err.lower()):
+            pytest.skip(f"multi-process CPU collectives unsupported: "
+                        f"{err.splitlines()[-1] if err else rc}")
+        assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
+        assert "MULTIHOST_OK" in out

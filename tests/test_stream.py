@@ -161,3 +161,20 @@ def test_resident_fallback_memoized(ctx):
     assert s.resident() is first
     s.collect()
     assert s.resident() is first
+
+
+def test_streamed_as_resident_operand(ctx):
+    """A streamed source captured as the OPERAND of a resident op
+    (resident.join(streamed)) must behave like its resident build inside
+    host lineage — the degrade-never-error contract is symmetric."""
+    table = ctx.dense_from_numpy(np.arange(5, dtype=np.int32),
+                                 np.arange(5, dtype=np.int32) * 10)
+    kv = ctx.dense_range(10_000, chunk_rows=2_000).map(lambda x: (x % 5, x))
+    joined = table.join(kv)
+    assert joined.count() == 10_000
+    sample = dict(joined.collect())[2]
+    assert sample[0] == 20  # table value rides along
+
+    # union with a streamed operand goes through the same delegation
+    u = ctx.dense_range(100).union(ctx.dense_range(100, chunk_rows=30))
+    assert u.count() == 200

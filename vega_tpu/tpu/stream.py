@@ -92,33 +92,36 @@ class StreamedDenseRDD:
             lambda: next(iter(make_chunks()), None))
         self._resident_memo = None
 
+    _INTERNALS = ("context", "n_chunks", "_make_chunks", "_make_resident",
+                  "_make_probe", "_resident_memo")
+
     def resident(self):
         """The un-chunked DenseRDD this stream is a recipe for (or a host
         RDD, if a composed closure was untraceable). Memoized: repeated
         fallback ops materialize the dataset once, not per access."""
         if self._resident_memo is None:
+            log.info(
+                "streamed source: materializing resident build "
+                "(%d chunks coalesce into one block)", self.n_chunks,
+            )
             self._resident_memo = self._make_resident()
         return self._resident_memo
 
     def __getattr__(self, name):
-        # Fallback surface: any op without a streaming implementation runs
-        # against the resident build — the behavior auto-streaming
-        # replaced. (Only called for names not defined on the class.)
-        if name.startswith("_"):
+        # Fallback surface: anything without a streaming implementation —
+        # RDD internals included, so a streamed source captured as the
+        # operand of a resident op (resident.join(streamed), union, ...)
+        # behaves like its resident build inside host lineage. (Only
+        # called for names not found normally; the _INTERNALS guard stops
+        # recursion when instance attrs are probed before __init__ ran,
+        # e.g. during unpickling.)
+        if name in StreamedDenseRDD._INTERNALS:
             raise AttributeError(name)
-        attr = getattr(self.resident(), name)
-        if not callable(attr):
-            return attr
-        log.info(
-            "streamed source: %s() has no streaming path — materializing "
-            "resident (%d chunks coalesce)", name, self.n_chunks,
-        )
-        return attr
+        return getattr(self.resident(), name)
 
     # --- narrow ops: compose per chunk -----------------------------------
     def _per_chunk(self, op_name: str, apply) -> "StreamedDenseRDD":
         make = self._make_chunks
-        make_resident = self._make_resident
         make_probe = self._make_probe
 
         # Traceability probe on a few-row block BEFORE building the
@@ -139,8 +142,10 @@ class StreamedDenseRDD:
             for chunk in make():
                 yield apply(chunk)
 
+        # The child's resident build reuses the parent's memo, so sibling
+        # fallbacks materialize the shared base once.
         return StreamedDenseRDD(self.context, chunks,
-                                lambda: apply(make_resident()),
+                                lambda: apply(self.resident()),
                                 self.n_chunks,
                                 make_probe=lambda: apply(make_probe()))
 
@@ -162,14 +167,24 @@ class StreamedDenseRDD:
         from vega_tpu.tpu.dense_rdd import (DenseRDD, _DenseUnionRDD,
                                             dense_from_block)
 
+        # Traceability decided on the few-row probe BEFORE any chunk work:
+        # an untraceable combiner degrades to the resident build's host
+        # path without first burning a full chunk-sized host reduce.
+        probe = self._make_probe()
+        if probe is not None and not isinstance(
+                probe.reduce_by_key(func, partitioner_or_num, op=op,
+                                    exchange=exchange), DenseRDD):
+            log.info("streamed reduce_by_key: combiner not traceable "
+                     "— resident fallback")
+            return self.resident().reduce_by_key(func, partitioner_or_num)
+
         acc = None
         for i, chunk in enumerate(self._make_chunks()):
             partial = chunk.reduce_by_key(func, partitioner_or_num, op=op,
                                           exchange=exchange)
             if not isinstance(partial, DenseRDD):
-                # Untraceable combiner fell back to the host tier inside
-                # the chunk — streaming can't help; run resident (same
-                # degradation the non-streamed path takes).
+                # Belt-and-braces: the probe said traceable but a real
+                # chunk disagreed (should not happen).
                 log.info("streamed reduce_by_key: combiner not traceable "
                          "— resident fallback")
                 return self.resident().reduce_by_key(
