@@ -169,3 +169,115 @@ def test_hyperloglog_accuracy():
     for i in range(100):
         small.add(f"item-{i}")
     assert abs(small.estimate() - 100) <= 2
+
+
+def test_coalescer_balances_under_skewed_locality():
+    """Power-of-two-choices + balance slack (reference
+    coalesced_rdd.rs:406-732): one hot host must not absorb every
+    partition that prefers it — balance spills past slack."""
+    from vega_tpu.rdd.coalesced import CoalescedRDD
+
+    class _FakeRDD:
+        num_partitions = 100
+
+        def splits(self):
+            from vega_tpu.split import Split
+
+            return [Split(i) for i in range(100)]
+
+        def preferred_locations(self, split):
+            # 90% of partitions prefer one hot host
+            return ["hostA"] if split.index % 10 else ["hostB"]
+
+    groups = CoalescedRDD._pack(_FakeRDD(), 10)
+    # exact partition of all parents
+    flat = sorted(p for g in groups for p in g)
+    assert flat == list(range(100))
+    sizes = sorted(len(g) for g in groups)
+    # slack = 10: the hot host's groups stay near avg + slack, not 90
+    assert sizes[-1] <= 10 + 10 + 2, sizes
+
+
+def test_coalescer_no_locality_contiguous_chunks():
+    from vega_tpu.rdd.coalesced import CoalescedRDD
+
+    class _Plain:
+        num_partitions = 10
+
+        def splits(self):
+            from vega_tpu.split import Split
+
+            return [Split(i) for i in range(10)]
+
+        def preferred_locations(self, split):
+            return []
+
+    groups = CoalescedRDD._pack(_Plain(), 4)
+    assert [p for g in groups for p in g] == list(range(10))
+    assert all(g == list(range(g[0], g[0] + len(g))) for g in groups if g)
+
+
+def test_coalescer_deterministic():
+    from vega_tpu.rdd.coalesced import CoalescedRDD
+
+    class _FakeRDD:
+        num_partitions = 40
+
+        def splits(self):
+            from vega_tpu.split import Split
+
+            return [Split(i) for i in range(40)]
+
+        def preferred_locations(self, split):
+            return [f"host{split.index % 3}"]
+
+    a = CoalescedRDD._pack(_FakeRDD(), 6)
+    b = CoalescedRDD._pack(_FakeRDD(), 6)
+    assert a == b, "packing must be deterministic for lineage recompute"
+
+
+def test_coalescer_exact_group_count_no_locality():
+    """No-locality coalesce must yield exactly n groups (reference
+    throw_balls, coalesced_rdd.rs:637-648) — ceil-chunking used to
+    produce 5 groups for coalesce(6..9) of 10 parents."""
+    from vega_tpu.rdd.coalesced import CoalescedRDD
+
+    class _Plain:
+        def __init__(self, n):
+            self.num_partitions = n
+
+        def splits(self):
+            from vega_tpu.split import Split
+
+            return [Split(i) for i in range(self.num_partitions)]
+
+        def preferred_locations(self, split):
+            return []
+
+    for n in (4, 6, 7, 8, 9, 10):
+        groups = CoalescedRDD._pack(_Plain(10), n)
+        assert len(groups) == n
+        assert all(groups), f"empty group at n={n}"
+        assert [p for g in groups for p in g] == list(range(10))
+
+
+def test_coalescer_no_empty_groups_mixed_locality():
+    """Groups starved by random probing get seeded one partition
+    (reference coalesced_rdd.rs:650-688)."""
+    from vega_tpu.rdd.coalesced import CoalescedRDD
+
+    class _Mixed:
+        num_partitions = 30
+
+        def splits(self):
+            from vega_tpu.split import Split
+
+            return [Split(i) for i in range(30)]
+
+        def preferred_locations(self, split):
+            return ["hot"] if split.index < 25 else []
+
+    groups = CoalescedRDD._pack(_Mixed(), 8)
+    assert len(groups) == 8
+    assert all(groups), [len(g) for g in groups]
+    assert sorted(p for g in groups for p in g) == list(range(30))

@@ -1,30 +1,42 @@
 """Shrink partition count without a shuffle (reference: src/rdd/coalesced_rdd.rs).
 
-The reference's DefaultPartitionCoalescer does locality-aware bin-packing with
-power-of-two-choices and a balance slack (coalesced_rdd.rs:406-732). vega_tpu
-keeps the same contract — group parent partitions into <= n groups, preferring
-groups whose parents share a preferred location — with a simpler two-pass
-packer: seed groups by distinct location, then assign each parent partition to
-the smallest group that matches its location (falling back to globally
-smallest), which is the reference algorithm minus its randomized probing.
+The reference's DefaultPartitionCoalescer does locality-aware bin-packing
+with power-of-two-choices and a balance slack (coalesced_rdd.rs:406-732);
+this is the same algorithm, deterministic-seeded:
+
+- setup (rs:515-560): anchor up to n groups on distinct preferred hosts,
+  cycling hosts when there are fewer hosts than groups.
+- pickBin (rs:580-620): for each parent partition, the locality candidate
+  is the least-loaded group anchored at one of its preferred hosts; the
+  balance candidate is the least-loaded of TWO randomly probed groups
+  (power of two choices). Locality wins unless the anchored group already
+  exceeds the probe winner by more than slack = balance_slack * n_parent —
+  so one hot host cannot absorb everything, but small imbalances never
+  sacrifice locality.
+- no locality anywhere (rs:700-732 throwBalls): contiguous round-robin
+  chunks, preserving order.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 from collections import Counter
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
 from vega_tpu.dependency import ManyToOneDependency
 from vega_tpu.rdd.base import RDD
 from vega_tpu.split import Split
 
+BALANCE_SLACK = 0.10  # reference default (coalesced_rdd.rs:406)
+
 
 class CoalescedRDD(RDD):
-    def __init__(self, prev: RDD, num_partitions: int):
+    def __init__(self, prev: RDD, num_partitions: int,
+                 balance_slack: float = BALANCE_SLACK):
         if num_partitions <= 0:
             raise ValueError("num_partitions must be positive")
-        groups = self._pack(prev, num_partitions)
+        groups = self._pack(prev, num_partitions, balance_slack)
         super().__init__(
             prev.context, deps=[ManyToOneDependency(prev, groups)]
         )
@@ -32,36 +44,73 @@ class CoalescedRDD(RDD):
         self.groups = groups
 
     @staticmethod
-    def _pack(prev: RDD, n: int) -> List[List[int]]:
+    def _pack(prev: RDD, n: int,
+              balance_slack: float = BALANCE_SLACK) -> List[List[int]]:
         n_parent = prev.num_partitions
         n = min(n, max(n_parent, 1))
         if n_parent == 0:
-            return [[] for _ in range(0)]
+            return []
         parent_splits = prev.splits()
         locs = [prev.preferred_locations(s) for s in parent_splits]
-        groups: List[List[int]] = [[] for _ in range(n)]
-        group_loc: List[str | None] = [None] * n
 
-        # Seed distinct locations across groups (coalesced_rdd.rs:515-560).
-        distinct = []
+        if not any(locs):
+            # No locality anywhere: exactly n contiguous chunks, order
+            # preserved (reference throw_balls, coalesced_rdd.rs:637-648,
+            # always yields the requested group count).
+            base, extra = divmod(n_parent, n)
+            out, lo = [], 0
+            for gi in range(n):
+                size = base + (1 if gi < extra else 0)
+                out.append(list(range(lo, lo + size)))
+                lo += size
+            return out
+
+        groups: List[List[int]] = [[] for _ in range(n)]
+        # Anchor groups round-robin over distinct hosts.
+        distinct: List[str] = []
         seen = set()
         for ls in locs:
             for loc in ls:
                 if loc not in seen:
                     seen.add(loc)
                     distinct.append(loc)
-        for gi, loc in zip(range(n), distinct):
-            group_loc[gi] = loc
+        group_loc: List[Optional[str]] = [
+            distinct[gi % len(distinct)] for gi in range(n)
+        ]
+        by_host: dict = {}
+        for gi, loc in enumerate(group_loc):
+            by_host.setdefault(loc, []).append(gi)
 
-        def best_group(pls: List[str]) -> int:
-            candidates = [
-                gi for gi in range(n) if group_loc[gi] in pls
-            ] if pls else []
-            pool = candidates or range(n)
-            return min(pool, key=lambda gi: len(groups[gi]))
+        # Deterministic probes: coalesce() must produce the same grouping
+        # every run (lineage recomputation depends on it).
+        rng = random.Random(0x5EED ^ n_parent ^ (n << 16))
+        slack = int(balance_slack * n_parent)
 
         for pi in range(n_parent):
-            groups[best_group(locs[pi])].append(pi)
+            # Power-of-two balance candidate over ALL groups.
+            r1, r2 = rng.randrange(n), rng.randrange(n)
+            min2 = r1 if len(groups[r1]) <= len(groups[r2]) else r2
+            # Locality candidate: least-loaded group anchored at one of
+            # this partition's preferred hosts.
+            anchored = [gi for loc in locs[pi] for gi in by_host.get(loc, [])]
+            if not anchored:
+                groups[min2].append(pi)
+                continue
+            pref = min(anchored, key=lambda gi: len(groups[gi]))
+            if len(groups[min2]) + slack <= len(groups[pref]):
+                groups[min2].append(pi)  # balance beats locality
+            else:
+                groups[pref].append(pi)
+
+        # Every group must hold at least one partition (reference
+        # throw_balls seeds empty groups, coalesced_rdd.rs:650-688):
+        # random probing can starve a group, which would silently shrink
+        # downstream parallelism.
+        for gi in range(n):
+            if not groups[gi]:
+                donor = max(range(n), key=lambda g: len(groups[g]))
+                if len(groups[donor]) > 1:
+                    groups[gi].append(groups[donor].pop())
         return groups
 
     @property
