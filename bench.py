@@ -4,9 +4,9 @@ Workload (BASELINE.json configs 1+2): N (int32, float32) pairs with K distinct
 keys -> reduce_by_key(add) -> inner join against a K-row table. The device
 tier runs it as two fused SPMD programs (exchange + segment reduce; exchange +
 merge join). The baseline is this framework's own host (pure-Python local
-mode) tier on a scaled-down copy of the same pipeline — the stand-in for the
-reference's local-mode CPU throughput (the reference publishes no numbers,
-BASELINE.md).
+mode) tier running the SAME pipeline at the SAME scale (identical rows, keys,
+and results) — the stand-in for the reference's local-mode CPU throughput
+(the reference publishes no numbers, BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -131,49 +131,64 @@ def main():
     # budget even after a slow-but-healthy probe.
     watchdog = _arm_watchdog(max(60.0, budget - probe_elapsed - 10))
     scale = float(os.environ.get("VEGA_BENCH_SCALE", "1.0"))
-    n_dev = max(1000, int(20_000_000 * scale))
-    keys_dev = min(n_dev, max(1000, int(1_000_000 * scale)))
-    n_host = max(200, int(400_000 * min(1.0, scale * 4)))
-    keys_host = min(n_host, max(100, int(20_000 * min(1.0, scale * 4))))
+    n_rows = max(1000, int(20_000_000 * scale))
+    n_keys = min(n_rows, max(1000, int(1_000_000 * scale)))
 
     ctx = v.Context("local")
     try:
-        # --- host (CPU local-mode) baseline, scaled down ---
+        # --- host (CPU local-mode) baseline at the SAME scale as the
+        # device run: same rows, same keys, identical results — the
+        # apples-to-apples ratio round 1 lacked (it compared tiers at
+        # different scales) ---
         t0 = time.time()
-        host_count = host_pipeline(ctx, n_host, keys_host)
+        host_count = host_pipeline(ctx, n_rows, n_keys)
         host_s = time.time() - t0
-        host_rows_per_s = n_host / host_s
-        assert host_count == keys_host
+        host_rows_per_s = n_rows / host_s
+        assert host_count == n_keys
 
-        # --- device tier: warmup on IDENTICAL shapes (program + jit caches
-        # make the measured run compile-free), then measure ---
-        warm = device_pipeline(ctx, n_dev, keys_dev)
-        assert warm == keys_dev
+        # --- device tier: warmup on IDENTICAL shapes (program + jit
+        # caches make the measured run compile-free), then measure ---
+        warm = device_pipeline(ctx, n_rows, n_keys)
+        assert warm == n_keys
         t0 = time.time()
-        dev_count = device_pipeline(ctx, n_dev, keys_dev)
+        dev_count = device_pipeline(ctx, n_rows, n_keys)
         dev_s = time.time() - t0
-        assert dev_count == keys_dev
-        dev_rows_per_s = n_dev / dev_s
+        assert dev_count == n_keys
+        dev_rows_per_s = n_rows / dev_s
 
         import jax
 
+        backend = jax.default_backend()
+        # HBM-traffic lower bound for the pipeline: each of the n rows
+        # (8 B as int32 key + f32 value) is touched by ~6 row-wide passes
+        # (hash, multi-key sort r+w, exchange r+w, segment reduce) before
+        # the key-bounded join. Real traffic is higher (sort is O(log n)
+        # passes); this bounds utilization from below.
+        bytes_moved_lb = n_rows * 8 * 6
+        gbps_lb = bytes_moved_lb / dev_s / 1e9
+        detail = {
+            "backend": backend,
+            "rows": n_rows,
+            "keys": n_keys,
+            "device_seconds": round(dev_s, 3),
+            "host_seconds": round(host_s, 3),
+            "host_rows_per_sec": round(host_rows_per_s),
+            "hbm_gbps_lower_bound": round(gbps_lb, 1),
+        }
+        if backend == "tpu":
+            # v5e HBM bandwidth ~819 GB/s.
+            detail["hbm_utilization_lower_bound"] = round(gbps_lb / 819, 3)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "
-                      "1M-key inner join)",
+                      "1M-key inner join; host tier measured at identical "
+                      "scale)",
             **({"note": "device backend unavailable; measured on CPU "
                         "fallback at reduced scale"}
                if os.environ.get("VEGA_BENCH_CPU_FALLBACK") == "1" else {}),
             "value": round(dev_rows_per_s),
             "unit": "rows/sec",
             "vs_baseline": round(dev_rows_per_s / host_rows_per_s, 2),
-            "detail": {
-                "backend": jax.default_backend(),
-                "device_rows": n_dev,
-                "device_seconds": round(dev_s, 3),
-                "host_baseline_rows": n_host,
-                "host_baseline_seconds": round(host_s, 3),
-                "host_rows_per_sec": round(host_rows_per_s),
-            },
+            "detail": detail,
         }
         watchdog.cancel()
         print(json.dumps(result))

@@ -77,40 +77,3 @@ def test_executor_loss_recovery(dist_ctx):
     assert dict(shuffled.collect()) == {0: 10, 1: 10, 2: 10, 3: 10}
     # fresh work still schedules on the survivor
     assert dist_ctx.parallelize(list(range(20)), 4).map(lambda x: x + 1).count() == 20
-
-
-def test_hosts_file_drives_membership(tmp_path):
-    """DistributedBackend reads cluster membership from the hosts file
-    (reference: hosts.rs / ~/hosts.conf)."""
-    hosts = tmp_path / "hosts.conf"
-    hosts.write_text("master = 127.0.0.1\nslaves = 127.0.0.1:3\n")
-    context = v.Context("distributed", hosts_file=str(hosts))
-    try:
-        assert len(context._backend._executors) == 3
-        total = context.parallelize(list(range(30)), 6).map(lambda x: x + 1).count()
-        assert total == 30
-    finally:
-        context.stop()
-
-
-def test_executor_session_logs(tmp_path):
-    """Executors write per-session log files at the driver's configured
-    level (propagated via --log-level)."""
-    import glob
-    import os
-
-    os.environ["VEGA_TPU_LOCAL_DIR"] = str(tmp_path)
-    try:
-        context = v.Context("distributed", num_workers=2,
-                            local_dir=str(tmp_path), log_level="INFO",
-                            log_cleanup=False)
-        try:
-            context.parallelize(list(range(10)), 4).count()
-        finally:
-            context.stop()
-    finally:
-        del os.environ["VEGA_TPU_LOCAL_DIR"]
-    exec_logs = glob.glob(str(tmp_path / "session-*" / "executor-*.log"))
-    assert len(exec_logs) >= 2
-    driver_logs = glob.glob(str(tmp_path / "session-*" / "driver.log"))
-    assert driver_logs

@@ -1,0 +1,88 @@
+"""Distributed-mode config tests that build their OWN Context.
+
+Separate module from test_distributed.py on purpose: that module holds a
+module-scoped Context fixture, and only one Context may be live per
+process (Context now enforces this with a crisp VegaError instead of
+silently clobbering the Env) — so per-test Contexts must run after that
+module's fixture tears down.
+"""
+
+import pytest
+
+import vega_tpu as v
+
+
+def test_hosts_file_drives_membership(tmp_path):
+    """DistributedBackend reads cluster membership from the hosts file
+    (reference: hosts.rs / ~/hosts.conf)."""
+    hosts = tmp_path / "hosts.conf"
+    hosts.write_text("master = 127.0.0.1\nslaves = 127.0.0.1:3\n")
+    context = v.Context("distributed", hosts_file=str(hosts))
+    try:
+        assert len(context._backend._executors) == 3
+        total = context.parallelize(list(range(30)), 6).map(lambda x: x + 1).count()
+        assert total == 30
+    finally:
+        context.stop()
+
+
+def test_executor_session_logs(tmp_path):
+    """Executors write per-session log files at the driver's configured
+    level (propagated via --log-level)."""
+    import glob
+    import os
+
+    os.environ["VEGA_TPU_LOCAL_DIR"] = str(tmp_path)
+    try:
+        context = v.Context("distributed", num_workers=2,
+                            local_dir=str(tmp_path), log_level="INFO",
+                            log_cleanup=False)
+        try:
+            context.parallelize(list(range(10)), 4).count()
+        finally:
+            context.stop()
+    finally:
+        del os.environ["VEGA_TPU_LOCAL_DIR"]
+    exec_logs = glob.glob(str(tmp_path / "session-*" / "executor-*.log"))
+    assert len(exec_logs) >= 2
+    driver_logs = glob.glob(str(tmp_path / "session-*" / "driver.log"))
+    assert driver_logs
+
+
+def test_overlapping_context_rejected_crisply():
+    """The one-live-Context-per-process invariant errors loudly instead of
+    silently resetting the Env under the first context's feet."""
+    from vega_tpu.errors import VegaError
+
+    a = v.Context("local")
+    try:
+        with pytest.raises(VegaError, match="already active"):
+            v.Context("local")
+    finally:
+        a.stop()
+    b = v.Context("local")  # fine after stop()
+    assert b.range(10).count() == 10
+    b.stop()
+
+
+def test_context_active_recovery_handle():
+    """Context.active() recovers a live context whose variable was lost."""
+    v.Context("local")  # reference immediately dropped
+    handle = v.Context.active()
+    assert handle is not None
+    handle.stop()
+    c = v.Context("local")
+    assert v.Context.active() is c
+    c.stop()
+
+
+def test_failed_context_init_releases_slot(tmp_path, monkeypatch):
+    """A Context whose backend init fails must not keep the active slot."""
+    monkeypatch.setenv("PATH", str(tmp_path))  # no ssh binary
+    hosts = tmp_path / "hosts.conf"
+    hosts.write_text("slaves = 10.99.99.99\n")
+    with pytest.raises(Exception):
+        v.Context("distributed", hosts_file=str(hosts))
+    c = v.Context("local")
+    assert c.range(5).count() == 5
+    c.stop()

@@ -18,6 +18,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from vega_tpu.cache_tracker import CacheTracker
 from vega_tpu.env import Configuration, DeploymentMode, Env
+from vega_tpu.errors import VegaError
 from vega_tpu.map_output_tracker import MapOutputTracker
 from vega_tpu.partial.partial_result import PartialResult
 from vega_tpu.rdd.base import RDD
@@ -50,44 +51,70 @@ class Context:
     def __init__(self, mode: str | DeploymentMode = "local",
                  conf: Optional[Configuration] = None, **conf_overrides):
         global _active_context
-        if isinstance(mode, str):
-            mode = DeploymentMode(mode)
-        conf = conf or Configuration.from_environ()
-        conf.deployment_mode = mode
-        for key, value in conf_overrides.items():
-            if not hasattr(conf, key):
-                raise TypeError(f"unknown configuration field: {key}")
-            setattr(conf, key, value)
-        self.conf = conf
-        env = Env.reset(conf, is_driver=True)
-        env.map_output_tracker = MapOutputTracker()
-        env.cache_tracker = CacheTracker()
-        self._log_handler = None
-
-        self._next_rdd_id = itertools.count(0)
-        self._next_shuffle_id = itertools.count(0)
         self._stopped = False
-
-        self.bus = LiveListenerBus()
-        self.metrics = MetricsListener()
-        self.bus.add_listener(self.metrics)
-        self.bus.start()
-
-        if mode is DeploymentMode.LOCAL:
-            self._backend = LocalBackend()
-        else:
-            from vega_tpu.distributed.backend import DistributedBackend
-
-            self._backend = DistributedBackend(conf)
-        self.scheduler = DAGScheduler(self._backend, self.bus)
-        # Attach last: a failed backend init must not leak a file handler on
-        # the process-global logger.
-        from vega_tpu.env import attach_session_logger
-
-        self._prev_logger_level = log.level
-        self._log_handler = attach_session_logger(env, "driver")
+        # Claim the active slot atomically with the liveness check (a
+        # check-then-register race would let two threads both pass and the
+        # second Env.reset clobber the first context's shuffles — the
+        # exact corruption this guard exists to prevent).
         with _active_context_lock:
+            if _active_context is not None and not _active_context._stopped:
+                raise VegaError(
+                    "a Context is already active in this process — the Env "
+                    "(shuffle store, trackers) is a process singleton like "
+                    "the reference's (env.rs:38-40), so a second Context "
+                    "would silently break the first one's shuffles. Call "
+                    ".stop() on it — reachable via Context.active() if the "
+                    "variable was lost — or use `with Context(...)`."
+                )
             _active_context = self
+        try:
+            if isinstance(mode, str):
+                mode = DeploymentMode(mode)
+            conf = conf or Configuration.from_environ()
+            conf.deployment_mode = mode
+            for key, value in conf_overrides.items():
+                if not hasattr(conf, key):
+                    raise TypeError(f"unknown configuration field: {key}")
+                setattr(conf, key, value)
+            self.conf = conf
+            env = Env.reset(conf, is_driver=True)
+            env.map_output_tracker = MapOutputTracker()
+            env.cache_tracker = CacheTracker()
+            self._log_handler = None
+
+            self._next_rdd_id = itertools.count(0)
+            self._next_shuffle_id = itertools.count(0)
+
+            self.bus = LiveListenerBus()
+            self.metrics = MetricsListener()
+            self.bus.add_listener(self.metrics)
+            self.bus.start()
+
+            if mode is DeploymentMode.LOCAL:
+                self._backend = LocalBackend()
+            else:
+                from vega_tpu.distributed.backend import DistributedBackend
+
+                self._backend = DistributedBackend(conf)
+            self.scheduler = DAGScheduler(self._backend, self.bus)
+            # Attach last: a failed backend init must not leak a file
+            # handler on the process-global logger.
+            from vega_tpu.env import attach_session_logger
+
+            self._prev_logger_level = log.level
+            self._log_handler = attach_session_logger(env, "driver")
+        except BaseException:
+            with _active_context_lock:
+                if _active_context is self:
+                    _active_context = None
+            raise
+
+    @staticmethod
+    def active() -> Optional["Context"]:
+        """The live Context of this process, if any — the recovery handle
+        when the creating variable was lost (Context.active().stop())."""
+        with _active_context_lock:
+            return _active_context
 
     # ------------------------------------------------------------------ ids
     def new_rdd_id(self) -> int:
