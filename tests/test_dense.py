@@ -521,3 +521,76 @@ def test_collect_grouped_columnar_parity(ctx):
     for key, (lvs, rvs) in cg.items():
         assert sorted(lvs) == [x for x in range(300) if x % 5 == key]
         assert sorted(rvs) == [x * 10 for x in range(500) if x % 7 == key]
+
+
+def test_flat_map_ragged_device(dctx):
+    """Variable-arity flat_map on device: each row x emits x % 4 copies of
+    itself (bounded by 3) — parity vs the host flat_map."""
+    import jax.numpy as jnp
+
+    def emit(x):
+        n = x % 4  # 0..3 outputs
+        return jnp.full((3,), x), n
+
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    r = dctx.dense_range(2_000).flat_map_ragged(emit, 3)
+    assert isinstance(r, DenseRDD), "must stay on device"
+    got = sorted(r.collect())
+    exp = sorted(x for x in range(2_000) for _ in range(x % 4))
+    assert got == exp
+
+    # pair output feeds the shuffle ops directly
+    def emit_kv(x):
+        ks = jnp.stack([x % 7, x % 7])
+        vs = jnp.stack([x, x * 0 + 1])
+        return (ks, vs), jnp.int32(2)
+
+    kv = dctx.dense_range(1_000).flat_map_ragged(emit_kv, 2)
+    red = dict(kv.reduce_by_key(op="add").collect())
+    exp_red = {}
+    for x in range(1_000):
+        exp_red[x % 7] = exp_red.get(x % 7, 0) + x + 1
+    assert red == exp_red
+
+
+def test_flat_map_ragged_untraceable_falls_back(dctx):
+    """An untraceable ragged closure degrades to the host flat_map with
+    identical results."""
+    def emit(x):
+        n = int(x) % 3  # int() breaks tracing
+        import numpy as _np
+
+        return _np.full(2, int(x)), min(n, 2)
+
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    r = dctx.dense_range(300).flat_map_ragged(emit, 2)
+    assert not isinstance(r, DenseRDD)
+    got = sorted(r.collect())
+    exp = sorted(x for x in range(300) for _ in range(min(x % 3, 2)))
+    assert got == exp
+
+
+def test_expansion_nodes_chain_with_narrow_ops(dctx):
+    """Narrow ops AFTER a capacity-changing expansion node must
+    materialize the expansion via its own program, not fuse through it
+    (chain-break regression: map/filter after flat_map_ragged/map_expand
+    used to hit NotImplementedError)."""
+    import jax.numpy as jnp
+
+    def emit(x):
+        return jnp.full((3,), x), x % 4
+
+    r = (dctx.dense_range(500).flat_map_ragged(emit, 3)
+         .map(lambda x: x + 1).filter(lambda x: x % 2 == 0))
+    exp = sorted(x + 1 for x in range(500) for _ in range(x % 4)
+                 if (x + 1) % 2 == 0)
+    assert sorted(r.collect()) == exp
+
+    m = dctx.dense_range(100).map_expand(
+        lambda x: jnp.stack([x, x + 1000]), 2
+    ).map(lambda x: x * 2)
+    exp_m = sorted(x * 2 for pair in ((y, y + 1000) for y in range(100))
+                   for x in pair)
+    assert sorted(m.collect()) == exp_m

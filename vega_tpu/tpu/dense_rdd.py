@@ -201,6 +201,30 @@ class DenseRDD(RDD):
 
             return super().flat_map(expand)
 
+    def flat_map_ragged(self, f: Callable, max_out_per_row: int):
+        """Variable-arity flat_map that stays on device: f maps one row to
+        (out, n_valid) — out a (max_out_per_row,) array (or a (keys,
+        values) pair of them), n_valid how many lead entries are real.
+        This is the XLA-compatible form of the reference's fully-dynamic
+        flat_map (rdd.rs:207-214): the per-row bound keeps shapes static;
+        genuinely unbounded closures use .flat_map (host tier)."""
+        try:
+            return _FlatMapRaggedRDD(self, f, max_out_per_row)
+        except _NotTraceable as e:
+            log.info("dense flat_map_ragged fell back to host tier: %s", e)
+
+            def expand(x):
+                out, n = f(x)
+                # Same clamp as the device path: host and device results
+                # must be identical, only placement may differ.
+                n = max(0, min(int(n), max_out_per_row))
+                if isinstance(out, tuple):
+                    ks, vs = (np.asarray(o)[:n] for o in out)
+                    return list(zip(ks.tolist(), vs.tolist()))
+                return np.asarray(out)[:n].tolist()
+
+            return super().flat_map(expand)
+
     def zip(self, other):
         """Dense-dense zip of single-value-column RDDs: per-shard column
         concatenation when shard counts line up (host semantics:
@@ -733,6 +757,11 @@ class _NarrowRDD(DenseRDD):
     """A narrow dense op: shard-local (cols, count) -> (cols, count).
     Chains of narrow nodes compose into one jitted program."""
 
+    # Nodes that override _materialize (capacity-changing expansions) are
+    # chain BREAKS: a downstream narrow chain must materialize them via
+    # their own program, never call their _shard_fn.
+    _chainable = True
+
     def __init__(self, parent: DenseRDD, out_schema):
         super().__init__(parent.context, parent.mesh, [parent])
         self.parent = parent
@@ -749,10 +778,13 @@ class _NarrowRDD(DenseRDD):
         return (type(self).__name__, _fp(getattr(self, "_user_fn", None)))
 
     def _materialize(self) -> Block:
-        # Collect the narrow chain down to the nearest materialization root.
+        # Collect the narrow chain down to the nearest materialization
+        # root (a non-narrow node, an already-materialized block, or a
+        # chain-breaking expansion node).
         chain: List[_NarrowRDD] = [self]
         root = self.parent
-        while isinstance(root, _NarrowRDD) and root._block is None:
+        while isinstance(root, _NarrowRDD) and root._block is None \
+                and root._chainable:
             chain.append(root)
             root = root.parent
         chain.reverse()
@@ -834,9 +866,29 @@ class _FilterRDD(_NarrowRDD):
         return kernels.compact(cols, keep, cap)
 
 
+def _fixed_payload_schema(payload, width: int, what: str):
+    """Schema for a (width,)-array payload — one array (values) or a
+    (keys, values) pair. Shared by map_expand and flat_map_ragged."""
+    if isinstance(payload, tuple) and len(payload) == 2:
+        if any(getattr(s, "shape", None) != (width,) for s in payload):
+            raise _NotTraceable(
+                f"{what} fn must return shape ({width},) arrays"
+            )
+        return ((KEY, payload[0].dtype), (VALUE, payload[1].dtype))
+    if hasattr(payload, "shape"):
+        if payload.shape != (width,):
+            raise _NotTraceable(
+                f"{what} fn must return a ({width},) array"
+            )
+        return ((VALUE, payload.dtype),)
+    raise _NotTraceable(f"unsupported {what} output: {payload}")
+
+
 class _MapExpandRDD(_NarrowRDD):
     """Fixed-factor row expansion: vmapped f gives [n, factor] outputs which
     interleave into factor*capacity rows, compacted to valid prefix."""
+
+    _chainable = False  # overrides _materialize (capacity changes)
 
     def __init__(self, parent: DenseRDD, f, factor: int):
         if factor <= 0:
@@ -846,20 +898,7 @@ class _MapExpandRDD(_NarrowRDD):
             out = jax.eval_shape(f, in_struct)
         except Exception as e:  # noqa: BLE001
             raise _NotTraceable(str(e)) from e
-        if isinstance(out, tuple) and len(out) == 2:
-            if any(s.shape != (factor,) for s in out):
-                raise _NotTraceable(
-                    f"map_expand fn must return shape ({factor},) arrays"
-                )
-            schema = ((KEY, out[0].dtype), (VALUE, out[1].dtype))
-        elif hasattr(out, "shape"):
-            if out.shape != (factor,):
-                raise _NotTraceable(
-                    f"map_expand fn must return a ({factor},) array"
-                )
-            schema = ((VALUE, out.dtype),)
-        else:
-            raise _NotTraceable(f"unsupported map_expand output: {out}")
+        schema = _fixed_payload_schema(out, factor, "map_expand")
         super().__init__(parent, schema)
         self._f = f
         self._factor = factor
@@ -893,6 +932,85 @@ class _MapExpandRDD(_NarrowRDD):
             return (new_count.reshape(1),) + tuple(res[n] for n in out_names)
 
         key = ("map_expand", self.mesh, _fp(self._user_fn), cap_in, factor)
+        prog = _cached_program(
+            key,
+            lambda: _shard_program(
+                self.mesh, prog_fn, 1 + len(names_in),
+                (_SPEC,) * (1 + len(out_names)),
+            ),
+        )
+        outs = prog(parent_blk.counts,
+                    *[parent_blk.cols[n] for n in names_in])
+        return Block(cols=dict(zip(out_names, outs[1:])), counts=outs[0],
+                     capacity=cap_out, mesh=self.mesh)
+
+    def _shard_fn(self, cols, count):  # not chained; materialize overrides
+        raise NotImplementedError
+
+
+class _FlatMapRaggedRDD(_NarrowRDD):
+    """Variable-arity flat_map on device: f(row) -> (out, n_valid) where
+    out is one (max_out,) array (values) or a pair of (max_out,) arrays
+    (key, value) and n_valid is how many lead entries are real.
+
+    The XLA-compatible general flat_map (reference rdd.rs:207-214 is fully
+    dynamic): per-row counts -> exclusive prefix sums -> each output slot
+    finds its owning row by binary search (same ragged-expansion pattern as
+    merge_join_expand). Output capacity is the static bound
+    capacity * max_out, so no overflow is possible."""
+
+    _chainable = False  # overrides _materialize (capacity changes)
+
+    def __init__(self, parent: DenseRDD, f, max_out: int):
+        if max_out <= 0:
+            raise VegaError("flat_map_ragged max_out_per_row must be > 0")
+        in_struct = _row_struct(parent._schema())
+        try:
+            out = jax.eval_shape(f, in_struct)
+        except Exception as e:  # noqa: BLE001
+            raise _NotTraceable(str(e)) from e
+        if not (isinstance(out, tuple) and len(out) == 2):
+            raise _NotTraceable(
+                "flat_map_ragged fn must return (out_arrays, n_valid)"
+            )
+        payload, n_struct = out
+        if getattr(n_struct, "shape", None) != ():
+            raise _NotTraceable("n_valid must be a scalar")
+        schema = _fixed_payload_schema(payload, max_out, "flat_map_ragged")
+        super().__init__(parent, schema)
+        self._f = f
+        self._max_out = max_out
+        self._user_fn = (f, max_out)
+
+    def _materialize(self) -> Block:
+        parent_blk = self.parent.block()
+        names_in = list(parent_blk.cols)
+        out_names = [n for n, _ in self._out_schema]
+        max_out = self._max_out
+        cap_in = parent_blk.capacity
+        cap_out = block_lib._round_capacity(cap_in * max_out)
+        f = self._f
+        in_schema = self.parent._schema()
+
+        def prog_fn(counts, *col_arrays):
+            cols = dict(zip(names_in, col_arrays))
+            count = counts[0]
+            args = _cols_to_row(cols, in_schema)
+            payload, n = jax.vmap(f)(args)  # leaves [cap_in, max_out]
+            if not isinstance(payload, tuple):
+                payload = (payload,)
+            mask = kernels.valid_mask(cap_in, count)
+            n = jnp.where(mask, jnp.clip(n.astype(jnp.int32), 0, max_out), 0)
+            li, off, total = kernels.ragged_expand(n, cap_out)
+            off = jnp.clip(off, 0, max_out - 1)
+            res = {
+                name: leaf[li, off]
+                for name, leaf in zip(out_names, payload)
+            }
+            return (total.reshape(1),) + tuple(res[n_] for n_ in out_names)
+
+        key = ("flat_map_ragged", self.mesh, _fp(self._user_fn), cap_in,
+               max_out)
         prog = _cached_program(
             key,
             lambda: _shard_program(

@@ -356,6 +356,28 @@ def segment_reduce_named(
 # ---------------------------------------------------------------------------
 
 
+def ragged_expand(counts_per_row: jax.Array, out_capacity: int):
+    """Slot ownership for ragged expansion: row i emits counts_per_row[i]
+    contiguous output slots. Returns (owner, offset, total) where output
+    slot j belongs to row owner[j] at position offset[j] within that row's
+    run, and total is the exact output size (saturated to INT32_MAX if the
+    int32 prefix sums wrapped — the caller must fail loudly, not truncate).
+    Rows with count 0 never own a slot: the next row shares their start
+    and wins the 'right'-side binary search. Shared by merge_join_expand
+    and the device flat_map."""
+    n_rows = counts_per_row.shape[0]
+    m = counts_per_row
+    starts = jnp.cumsum(m) - m
+    total = jnp.sum(m).astype(jnp.int32)
+    wrapped = (total < 0) | jnp.any(starts < 0)
+    total = jnp.where(wrapped, jnp.int32(2**31 - 1), total)
+    j = lax.iota(jnp.int32, out_capacity)
+    owner = jnp.clip(jnp.searchsorted(starts, j, side="right") - 1,
+                     0, n_rows - 1)
+    offset = j - jnp.take(starts, owner)
+    return owner, offset, total
+
+
 def merge_join_expand(
     left: Cols, left_count: jax.Array,
     right: Cols, right_count: jax.Array,
@@ -403,21 +425,12 @@ def merge_join_expand(
         m = jnp.where(lmask, jnp.maximum(n_match, 1), 0)
     else:
         m = jnp.where(lmask, n_match, 0)
-    starts = jnp.cumsum(m) - m
-    total = jnp.sum(m).astype(jnp.int32)
-    # int32 wrap guard: a dup x dup product over 2^31 rows/shard cannot
-    # materialize anyway (25+ GB of rows), but it must fail loudly, not
-    # return a truncated block. Wrapped prefix sums go negative; saturate
-    # total to INT32_MAX as the driver-visible "impossible" sentinel.
-    wrapped = (total < 0) | jnp.any(starts < 0)
-    total = jnp.where(wrapped, jnp.int32(2**31 - 1), total)
-
-    # Output slot j belongs to the last left row whose start <= j (rows
-    # with m == 0 never own a slot: the next row shares their start and
-    # wins the 'right'-side search).
-    j = lax.iota(jnp.int32, out_capacity)
-    li = jnp.clip(jnp.searchsorted(starts, j, side="right") - 1, 0, lcap - 1)
-    ri = jnp.clip(jnp.take(lo, li) + (j - jnp.take(starts, li)), 0, rcap - 1)
+    # Slot ownership via ragged_expand; total saturates to INT32_MAX when
+    # a dup x dup product over 2^31 rows/shard would wrap (cannot
+    # materialize anyway — 25+ GB of rows — but must fail loudly in the
+    # driver, not return a truncated block).
+    li, off, total = ragged_expand(m, out_capacity)
+    ri = jnp.clip(jnp.take(lo, li) + off, 0, rcap - 1)
     row_matched = jnp.take(n_match > 0, li)
 
     out: Cols = {key_name: jnp.take(lkeys, li)}
