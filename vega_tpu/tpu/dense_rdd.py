@@ -287,6 +287,44 @@ class DenseRDD(RDD):
         ones = self.map_values(lambda _v: jnp.int32(1))
         return ones.reduce_by_key(op="add")
 
+    def combine_by_key(self, create_combiner: Callable,
+                       merge_value: Callable, merge_combiners: Callable,
+                       partitioner_or_num=None, *,
+                       exchange: Optional[str] = None):
+        """Device combine_by_key for scalar traceable combiners
+        (reference: pair_rdd.rs:20-33): lowered to
+        map_values(create_combiner) + segment-reduce(merge_combiners),
+        which equals the host semantics under the standard combiner
+        compatibility contract merge_value(c, v) ==
+        merge_combiners(c, create_combiner(v)). Untraceable or non-scalar
+        combiners fall back to the host tier DIRECTLY (the host mixin's
+        own reduce_by_key lowers through self.combine_by_key, so the
+        fallback must not re-dispatch through this override)."""
+        if not self.is_pair:
+            raise VegaError("combine_by_key on non-pair DenseRDD")
+        try:
+            mapped = _MapValuesRDD(self, create_combiner)
+            op = _infer_named_op(merge_combiners)
+            node = _ReduceByKeyRDD(mapped, op=op,
+                                   func=None if op else merge_combiners)
+            return _with_exchange(node, exchange)
+        except _NotTraceable as e:
+            log.info("dense combine_by_key fell back to host tier: %s", e)
+            from vega_tpu.rdd.pair import PairOpsMixin
+
+            return PairOpsMixin.combine_by_key(
+                self, create_combiner, merge_value, merge_combiners,
+                partitioner_or_num,
+            )
+
+    # fold_by_key / aggregate_by_key deliberately have NO device lowering:
+    # their zero is applied once per key per PARTITION (host tier,
+    # rdd/pair.py:74-93 — our extension; the reference has neither op), and
+    # that partition-coupled semantic is not expressible as an associative
+    # device combine without silently changing results for non-neutral
+    # zeros. For the device path, express the job as
+    # map_values(...) + reduce_by_key(op=...) explicitly.
+
     def group_by_key(self, partitioner_or_num=None,
                      exchange: Optional[str] = None):
         """Device group_by_key: exchange by key hash, sort within shard.
