@@ -1,0 +1,58 @@
+"""Streamed group_by + join: datasets bigger than device memory.
+
+The BASELINE config-5 shape at example scale: a source that exceeds the
+configured HBM budget streams through the mesh chunk by chunk,
+reduce_by_key folds per-chunk combiner blocks into a key-bounded
+accumulator, and the (small) result joins a resident table. At full scale
+(1B rows) the same code runs on one chip; see benchmarks/stream_1b.py.
+
+Also shows flat_map_ragged: variable-arity row expansion that stays on
+device (each value emits one output per decimal digit).
+"""
+
+import numpy as np
+
+import vega_tpu as v
+
+
+def main():
+    with v.Context("local") as ctx:
+        n, keys = 1_000_000, 10_000
+        # chunk_rows forces streaming at example scale; at real scale the
+        # HBM budget (Configuration.dense_hbm_budget) triggers it
+        # automatically.
+        src = ctx.dense_range(n, chunk_rows=256 * 1024)
+        print(f"streaming {n} rows in {src.n_chunks} chunks")
+
+        reduced = src.map(lambda x: (x % keys, x)).reduce_by_key(op="add")
+        table = ctx.dense_from_numpy(
+            np.arange(keys, dtype=np.int32),
+            np.arange(keys, dtype=np.int32) * 2,
+        )
+        joined = reduced.join(table)
+        print("joined rows:", joined.count())
+
+        # Variable-arity flat_map on device: one output per decimal digit.
+        import jax.numpy as jnp
+
+        def digits(x):
+            ds = jnp.stack([(x // 10**i) % 10 for i in range(7)])
+            nd = jnp.where(
+                x == 0, 1,
+                jnp.int32(jnp.floor(
+                    jnp.log10(jnp.maximum(x.astype(jnp.float32), 1.0))
+                ) + 1),
+            )
+            return (ds, jnp.ones((7,), jnp.int32)), nd
+
+        digit_counts = dict(
+            ctx.dense_range(100_000)
+            .flat_map_ragged(digits, 7)
+            .reduce_by_key(op="add")
+            .collect()
+        )
+        print("digit histogram:", {d: digit_counts[d] for d in range(10)})
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,16 @@ static PyObject* rows_to_bytes(const std::vector<Row>& rows) {
   return out;
 }
 
+// Allocate an uninitialized row blob and expose its write cursor: bucket
+// serializers fill rows in place — one copy per bucket instead of a
+// staging vector plus memcpy.
+static inline PyObject* alloc_row_blob(size_t count, Row** dst) {
+  PyObject* blob = PyBytes_FromStringAndSize(
+      nullptr, static_cast<Py_ssize_t>(count * sizeof(Row)));
+  if (blob != nullptr) *dst = reinterpret_cast<Row*>(PyBytes_AS_STRING(blob));
+  return blob;
+}
+
 static PyObject* pair_list_from_accs(
     const std::unordered_map<int64_t, Acc>& combined, bool as_int) {
   PyObject* out = PyList_New(static_cast<Py_ssize_t>(combined.size()));
@@ -218,16 +228,13 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
 
   PyObject* result = PyList_New(n_buckets);
   if (result == nullptr) return nullptr;
-  std::vector<Row> rows;
   for (Py_ssize_t b = 0; b < n_buckets; ++b) {
-    rows.clear();
-    rows.reserve(buckets[b].size());
-    for (const auto& kv : buckets[b]) {
-      rows.push_back({kv.first,
-                      all_int ? kv.second.i : d2bits(kv.second.d)});
-    }
-    PyObject* blob = rows_to_bytes(rows);
+    Row* dst;
+    PyObject* blob = alloc_row_blob(buckets[b].size(), &dst);
     if (blob == nullptr) { Py_DECREF(result); return nullptr; }
+    for (const auto& kv : buckets[b]) {
+      *dst++ = {kv.first, all_int ? kv.second.i : d2bits(kv.second.d)};
+    }
     PyList_SET_ITEM(result, b, blob);
   }
   PyObject* out = Py_BuildValue("(Oi)", result, all_int ? 1 : 0);
@@ -274,16 +281,14 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
 
   PyObject* result = PyList_New(n_buckets);
   if (result == nullptr) return nullptr;
-  std::vector<Row> rows;
   for (Py_ssize_t b = 0; b < n_buckets; ++b) {
-    rows.clear();
-    rows.reserve(keys[b].size());
-    for (size_t r = 0; r < keys[b].size(); ++r) {
-      rows.push_back({keys[b][r],
-                      all_int ? vals[b][r].i : d2bits(vals[b][r].d)});
-    }
-    PyObject* blob = rows_to_bytes(rows);
+    Row* dst;
+    PyObject* blob = alloc_row_blob(keys[b].size(), &dst);
     if (blob == nullptr) { Py_DECREF(result); return nullptr; }
+    for (size_t r = 0; r < keys[b].size(); ++r) {
+      *dst++ = {keys[b][r],
+                all_int ? vals[b][r].i : d2bits(vals[b][r].d)};
+    }
     PyList_SET_ITEM(result, b, blob);
   }
   PyObject* out = Py_BuildValue("(Oi)", result, all_int ? 1 : 0);

@@ -9,7 +9,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FAST_EXAMPLES = ["make_rdd.py", "subtract.py", "file_read.py",
-                 "columnar_analytics.py"]
+                 "columnar_analytics.py", "streamed_billion_rows.py"]
 
 
 @pytest.mark.parametrize("example", FAST_EXAMPLES)
