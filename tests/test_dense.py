@@ -723,3 +723,44 @@ def test_dense_combine_by_key_untraceable_falls_back(dctx):
     assert not isinstance(r, DenseRDD)
     got = {key: sorted(vals) for key, vals in r.collect()}
     assert got[2] == list(range(2, 200, 5))
+
+
+def test_hash_placed_propagation_and_elision(dctx):
+    """hash_placed propagates through key-preserving ops and resets on
+    key-rewriting ones; elided shuffles match un-elided results exactly."""
+    kv = dctx.dense_range(10_000).map(lambda x: (x % 50, x))
+    assert not kv.hash_placed
+    reduced = kv.reduce_by_key(op="add")
+    assert reduced.hash_placed
+    assert reduced.map_values(lambda v: v * 2).hash_placed
+    assert reduced.filter(lambda p: p[1] > 0).hash_placed
+    assert not reduced.map(lambda p: (p[1], p[0])).hash_placed  # key rewrite
+
+    # reduce-of-reduce: second reduce elides its exchange; results must
+    # equal a fresh single reduce — and the elision must actually RUN
+    # (the _elided flag guards against the optimization silently dying)
+    rr_node = reduced.map_values(lambda v: v).reduce_by_key(op="add")
+    again = dict(rr_node.collect())
+    base_node = kv.reduce_by_key(op="add")
+    base = dict(base_node.collect())
+    assert again == base
+    assert rr_node._elided is True
+    assert base_node._elided is False
+
+    # group_by_key over placed data
+    g_node = reduced.group_by_key()
+    g = dict(g_node.collect())
+    assert all(g[key] == [base[key]] for key in base)
+    assert g_node._elided is True
+
+    # join with a placed left side (the north-star shape): one collective
+    table = dctx.dense_from_numpy(np.arange(50, dtype=np.int32),
+                                  np.arange(50, dtype=np.int32) * 7)
+    j_node = reduced.join(table)
+    j = dict(j_node.collect())
+    assert j == {key: (base[key], key * 7) for key in base}
+    assert j_node._elided == (True, False)
+    # join of two placed sides: zero collectives
+    both = reduced.join(kv.map_values(lambda v: v * 0).reduce_by_key(op="add"))
+    assert dict(both.collect()) == {key: (base[key], 0) for key in base}
+    assert both._elided == (True, True)

@@ -119,6 +119,18 @@ class DenseRDD(RDD):
     def is_pair(self) -> bool:
         return KEY in dict(self._schema())
 
+    @property
+    def hash_placed(self) -> bool:
+        """True when every key's rows provably live only on shard
+        hash(key) % n — the output of any hash exchange. Downstream
+        keyed shuffles over hash-placed inputs elide the exchange
+        entirely (one per-shard program, zero collectives): the device
+        analogue of the host tier's partitioner-equality shuffle elision
+        (reference: co_grouped_rdd.rs:102-127, a CLAUDE.md invariant).
+        Key-preserving narrow ops propagate it; anything that can rewrite
+        keys resets it."""
+        return False
+
     def _schema(self) -> Tuple[Tuple[str, jnp.dtype], ...]:
         """(name, dtype) of columns without materializing."""
         raise NotImplementedError
@@ -882,6 +894,10 @@ class _MapValuesRDD(_NarrowRDD):
     def _shard_fn(self, cols, count):
         return {KEY: cols[KEY], VALUE: jax.vmap(self._f)(cols[VALUE])}, count
 
+    @property
+    def hash_placed(self) -> bool:
+        return self.parent.hash_placed  # keys untouched
+
 
 class _FilterRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, pred):
@@ -902,6 +918,10 @@ class _FilterRDD(_NarrowRDD):
         keep = jax.vmap(self._pred)(_cols_to_row(cols, self._out_schema))
         keep = keep.astype(jnp.bool_) & kernels.valid_mask(cap, count)
         return kernels.compact(cols, keep, cap)
+
+    @property
+    def hash_placed(self) -> bool:
+        return self.parent.hash_placed  # surviving rows keep their keys
 
 
 def _fixed_payload_schema(payload, width: int, what: str):
@@ -1148,6 +1168,10 @@ class _SelectRDD(_NarrowRDD):
     def _shard_fn(self, cols, count):
         return {n: cols[n] for n in self._names}, count
 
+    @property
+    def hash_placed(self) -> bool:
+        return KEY in self._names and self.parent.hash_placed
+
 
 class _ProjectRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, col: str):
@@ -1171,9 +1195,14 @@ class _ProjectRDD(_NarrowRDD):
 
 
 class _SourceRDD(DenseRDD):
-    def __init__(self, ctx, blk: Block):
+    def __init__(self, ctx, blk: Block, hash_placed: bool = False):
         super().__init__(ctx, blk.mesh)
         self._block = blk
+        self._hash_placed = hash_placed
+
+    @property
+    def hash_placed(self) -> bool:
+        return self._hash_placed
 
     def _materialize(self) -> Block:
         return self._block
@@ -1254,8 +1283,8 @@ def dense_from_columns(ctx, columns: Optional[dict] = None,
     return _SourceRDD(ctx, blk)
 
 
-def dense_from_block(ctx, blk: Block) -> DenseRDD:
-    return _SourceRDD(ctx, blk)
+def dense_from_block(ctx, blk: Block, hash_placed: bool = False) -> DenseRDD:
+    return _SourceRDD(ctx, blk, hash_placed=hash_placed)
 
 
 def dense_load_npz(ctx, path: str, chunk_rows: Optional[int] = None):
@@ -1317,8 +1346,9 @@ def _exchange_capacities(counts: np.ndarray, n_shards: int,
     return slot, out
 
 
-def _histogram_capacities(hists: List[np.ndarray],
-                          attempt: int) -> Tuple[int, int]:
+def _histogram_capacities(hists: List[np.ndarray], attempt: int,
+                          slot_hists: Optional[List[np.ndarray]] = None
+                          ) -> Tuple[int, int]:
     """Exact slot/out capacities from per-shard destination histograms.
 
     Each hist is [n_shards, n_shards]: hist[s, t] = rows shard s sends to
@@ -1327,9 +1357,15 @@ def _histogram_capacities(hists: List[np.ndarray],
     distribution, overflow retries (which recompile a bigger program,
     multi-second jit stalls on TPU) become an anomaly instead of the
     expected path under skew. Growth on retry is kept as a safety net for
-    exchanges whose histogram is an estimate (none today)."""
+    exchanges whose histogram is an estimate (none today).
+
+    slot_hists, when given, restricts the slot (send-buffer) sizing to
+    those hists: elided (diagonal) sides never send, and letting their
+    per-shard totals into the slot max would oversize the other side's
+    [n_shards, slot] buffers."""
     grow = 2 ** attempt
-    slot = max(int(h.max()) for h in hists)
+    src = hists if slot_hists is None else slot_hists
+    slot = max((int(h.max()) for h in src), default=1)
     out = max(int(h.sum(axis=0).max()) for h in hists)
     return _cap_round(max(slot, 1) * grow), _cap_round(max(out, 1) * grow)
 
@@ -1411,13 +1447,16 @@ class _ExchangeRDD(DenseRDD):
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
     def _run_exchange(self, build_program, counts: np.ndarray,
-                      hists: Optional[List[np.ndarray]] = None):
+                      hists: Optional[List[np.ndarray]] = None,
+                      slot_hists: Optional[List[np.ndarray]] = None):
         import time as _time
 
         from vega_tpu.scheduler import events as ev
 
         n = self.mesh.size
         hists = [h for h in (hists or []) if h is not None]
+        if slot_hists is not None:
+            slot_hists = [h for h in slot_hists if h is not None]
         bus = getattr(self.context, "bus", None)
         t_start = _time.time()
         if bus is not None:
@@ -1430,7 +1469,8 @@ class _ExchangeRDD(DenseRDD):
         try:
             for attempt in range(5):
                 if hists:
-                    slot, out_cap = _histogram_capacities(hists, attempt)
+                    slot, out_cap = _histogram_capacities(hists, attempt,
+                                                          slot_hists)
                 else:
                     slot, out_cap = _exchange_capacities(counts, n, attempt)
                 prog, args = build_program(slot, out_cap)
@@ -1452,6 +1492,8 @@ class _ExchangeRDD(DenseRDD):
 
 
 class _ReduceByKeyRDD(_ExchangeRDD):
+    hash_placed = True  # output rows live on shard hash(key) % n
+
     def __init__(self, parent: DenseRDD, op: Optional[str], func):
         super().__init__(parent.context, parent.mesh, [parent])
         self.parent = parent
@@ -1493,12 +1535,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         names = list(blk.cols)
         counts_host = np.asarray(jax.device_get(blk.counts))
         exchange = _get_exchange(self.exchange_mode)
+        # Partitioner-equality elision, device edition: a hash-placed
+        # parent already has every key's rows on their reducer shard, so
+        # the whole exchange (hash + multi-key sort + collective)
+        # collapses to one per-shard segment reduce — zero collectives.
+        elide = self.parent.hash_placed and n > 1
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
-                if n > 1:
+                if n > 1 and not elide:
                     # 2-sort exchange: ONE multi-key sort (bucket major,
                     # key minor) feeds both the presorted map-side combine
                     # (reference: dependency.rs:176-223) and a pregrouped
@@ -1517,19 +1564,27 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     # combiner rows' buckets from their keys (hash is cheap
                     # and deterministic).
                     bucket = pallas_kernels.hash_bucket(cols[KEY], n)
-                else:
+                    cols, count, overflow = exchange(
+                        cols, count, bucket, n, slot, out_cap,
+                        pregrouped=True,
+                    )
+                elif not elide:
                     bucket = jnp.zeros_like(cols[KEY])
-                cols, count, overflow = exchange(
-                    cols, count, bucket, n, slot, out_cap,
-                    pregrouped=(n > 1),
-                )
+                    cols, count, overflow = exchange(
+                        cols, count, bucket, n, slot, out_cap,
+                    )
+                else:
+                    capacity = cols[KEY].shape[0]
+                    cols, count, overflow = kernels.passthrough_exchange(
+                        cols, count, capacity, out_cap
+                    )
                 # reduce-side merge (reference: shuffled_rdd.rs:149-170)
                 cols, count = self._segment_reduce(cols, count, presorted=False)
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
-            key = ("rbk", self.mesh, tuple(names), n, slot, out_cap,
+            key = ("rbk", self.mesh, tuple(names), n, slot, out_cap, elide,
                    self.exchange_mode, self._op or _fp(self._func))
             prog = _cached_program(
                 key,
@@ -1540,8 +1595,15 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in names])
 
+        # Elided: rows stay put, so the exact "histogram" is the diagonal
+        # (shard s keeps counts[s] rows) — one attempt, exact out capacity;
+        # slot is unused by the passthrough, so size it from nothing.
+        self._elided = elide
+        hists = ([np.diag(counts_host)] if elide
+                 else [self._hash_histogram(blk)])
         outs, out_cap = self._run_exchange(
-            build, counts_host, hists=[self._hash_histogram(blk)]
+            build, counts_host, hists=hists,
+            slot_hists=[] if elide else None,
         )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
@@ -1550,6 +1612,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
 
 class _GroupByKeyRDD(_ExchangeRDD):
     """Exchange + local sort; block holds key-sorted runs per shard."""
+
+    hash_placed = True  # output rows live on shard hash(key) % n
 
     def __init__(self, parent: DenseRDD):
         super().__init__(parent.context, parent.mesh, [parent])
@@ -1564,22 +1628,28 @@ class _GroupByKeyRDD(_ExchangeRDD):
         names = list(blk.cols)
         counts_host = np.asarray(jax.device_get(blk.counts))
         exchange = _get_exchange(self.exchange_mode)
+        elide = self.parent.hash_placed and n > 1  # rows already placed
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
-                bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
-                          if n > 1 else jnp.zeros_like(cols[KEY]))
-                cols, count, overflow = exchange(
-                    cols, count, bucket, n, slot, out_cap
-                )
+                if elide:
+                    cols, count, overflow = kernels.passthrough_exchange(
+                        cols, count, cols[KEY].shape[0], out_cap
+                    )
+                else:
+                    bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
+                              if n > 1 else jnp.zeros_like(cols[KEY]))
+                    cols, count, overflow = exchange(
+                        cols, count, bucket, n, slot, out_cap
+                    )
                 cols = kernels.sort_by_column(cols, count, KEY)
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
-            key = ("gbk", self.mesh, tuple(names), n, slot, out_cap,
+            key = ("gbk", self.mesh, tuple(names), n, slot, out_cap, elide,
                    self.exchange_mode)
             prog = _cached_program(
                 key,
@@ -1590,8 +1660,12 @@ class _GroupByKeyRDD(_ExchangeRDD):
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in names])
 
+        self._elided = elide
+        hists = ([np.diag(counts_host)] if elide
+                 else [self._hash_histogram(blk)])
         outs, out_cap = self._run_exchange(
-            build, counts_host, hists=[self._hash_histogram(blk)]
+            build, counts_host, hists=hists,
+            slot_hists=[] if elide else None,
         )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
@@ -1621,8 +1695,11 @@ class _GroupByKeyRDD(_ExchangeRDD):
 class _JoinRDD(_ExchangeRDD):
     """Device sort-merge join with full duplicate-key semantics (dup x dup
     product, reference pair_rdd.rs:104-121) — no host fallback on the dense
-    path. Output expansion beyond the exchange capacity is caught by the
-    kernel's overflow flag and retried with grown capacities."""
+    path. Output expansion beyond the exchange capacity is reported exactly
+    by the kernel and rerun once at the right capacity. A hash-placed side
+    (e.g. a reduce_by_key output) skips its exchange entirely."""
+
+    hash_placed = True  # joined rows stay on their key's shard
 
     def __init__(self, left: DenseRDD, right: DenseRDD,
                  outer: bool = False, fill_value=0):
@@ -1644,27 +1721,34 @@ class _JoinRDD(_ExchangeRDD):
         l_counts = np.asarray(jax.device_get(lblk.counts))
         r_counts = np.asarray(jax.device_get(rblk.counts))
         exchange = _get_exchange(self.exchange_mode)
+        # Per-side exchange elision: a hash-placed side's rows are already
+        # on their key's shard (reduce/group/join outputs), so only the
+        # other side moves — the north-star reduced.join(table) pipeline
+        # pays ONE collective instead of two.
+        l_elide = self.left.hash_placed and n > 1
+        r_elide = self.right.hash_placed and n > 1
         join_cap_override: List[Optional[int]] = [None]
         join_cap_used: List[int] = [0]
+
+        def one_side(cols, count, elide, slot_pair, out_cap):
+            if elide:
+                return kernels.passthrough_exchange(
+                    cols, count, cols[KEY].shape[0], out_cap
+                )
+            bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
+                      if n > 1 else jnp.zeros_like(cols[KEY]))
+            return exchange(cols, count, bucket, n, slot_pair, out_cap)
 
         def build(slot_pair, out_cap):
             join_cap = join_cap_override[0] or out_cap
             join_cap_used[0] = join_cap
 
             def prog_fn(lc, lk, lv, rc, rk, rv):
-                lcols, lcount = {KEY: lk, VALUE: lv}, lc[0]
-                rcols, rcount = {KEY: rk, VALUE: rv}, rc[0]
-                if n > 1:
-                    lb = pallas_kernels.hash_bucket(lcols[KEY], n)
-                    rb = pallas_kernels.hash_bucket(rcols[KEY], n)
-                else:
-                    lb = jnp.zeros_like(lcols[KEY])
-                    rb = jnp.zeros_like(rcols[KEY])
-                lcols, lcount, lof = exchange(
-                    lcols, lcount, lb, n, slot_pair, out_cap
+                lcols, lcount, lof = one_side(
+                    {KEY: lk, VALUE: lv}, lc[0], l_elide, slot_pair, out_cap
                 )
-                rcols, rcount, rof = exchange(
-                    rcols, rcount, rb, n, slot_pair, out_cap
+                rcols, rcount, rof = one_side(
+                    {KEY: rk, VALUE: rv}, rc[0], r_elide, slot_pair, out_cap
                 )
                 joined, jcount, jtotal = kernels.merge_join_expand(
                     lcols, lcount, rcols, rcount, KEY, join_cap,
@@ -1678,6 +1762,7 @@ class _JoinRDD(_ExchangeRDD):
 
             prog = _cached_program(
                 ("join", self.mesh, n, slot_pair, out_cap, join_cap,
+                 l_elide, r_elide,
                  self.exchange_mode, self.outer, self.fill_value),
                 lambda: _shard_program(self.mesh, prog_fn, 6, (_SPEC,) * 6),
             )
@@ -1687,8 +1772,16 @@ class _JoinRDD(_ExchangeRDD):
             )
 
         counts = np.concatenate([l_counts, r_counts])
-        hists = [self._hash_histogram(lblk), self._hash_histogram(rblk)]
-        outs, _ = self._run_exchange(build, counts, hists=hists)
+        self._elided = (l_elide, r_elide)
+        hists = [
+            np.diag(l_counts) if l_elide else self._hash_histogram(lblk),
+            np.diag(r_counts) if r_elide else self._hash_histogram(rblk),
+        ]
+        # Elided (diag) sides never send: keep them out of slot sizing.
+        slot_hists = [h for h, el in zip(hists, (l_elide, r_elide))
+                      if not el]
+        outs, _ = self._run_exchange(build, counts, hists=hists,
+                                     slot_hists=slot_hists)
         jcounts, jtotals = outs[0], np.asarray(jax.device_get(outs[1]))
         if int(jtotals.max(initial=0)) >= 2**31 - 1:
             raise VegaError(
@@ -1700,7 +1793,8 @@ class _JoinRDD(_ExchangeRDD):
             # kernel reported the exact product size, so ONE resized rerun
             # is guaranteed to fit (no geometric-growth walk).
             join_cap_override[0] = _cap_round(int(jtotals.max()))
-            outs, _ = self._run_exchange(build, counts, hists=hists)
+            outs, _ = self._run_exchange(build, counts, hists=hists,
+                                     slot_hists=slot_hists)
             jcounts = outs[0]
         _, _, jk, jlv, jrv = outs
         return Block(
@@ -1910,6 +2004,11 @@ class _DenseUnionRDD(DenseRDD):
         super().__init__(first.context, first.mesh, [first, second])
         self.first = first
         self.second = second
+
+    @property
+    def hash_placed(self) -> bool:
+        # Same placement function on both sides -> concat preserves it.
+        return self.first.hash_placed and self.second.hash_placed
 
     def _schema(self):
         return self.first._schema()

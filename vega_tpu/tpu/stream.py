@@ -194,9 +194,11 @@ class StreamedDenseRDD:
                           func, partitioner_or_num, op=op, exchange=exchange))
             # Materialize now and keep only the block: drops the lineage
             # references to this chunk's source so its HBM frees before the
-            # next chunk builds.
+            # next chunk builds. hash_placed: both union sides are exchange
+            # outputs, so the per-chunk merge reduce elides its exchange
+            # (zero collectives in the accumulator fold).
             blk = merged.block()
-            acc = dense_from_block(self.context, blk)
+            acc = dense_from_block(self.context, blk, hash_placed=True)
             log.info(
                 "streamed reduce_by_key: chunk %d/%d -> %d keys "
                 "(accumulator %.1f MiB device-resident)",
