@@ -764,3 +764,36 @@ def test_hash_placed_propagation_and_elision(dctx):
     both = reduced.join(kv.map_values(lambda v: v * 0).reduce_by_key(op="add"))
     assert dict(both.collect()) == {key: (base[key], 0) for key in base}
     assert both._elided == (True, True)
+
+
+def test_key_sorted_propagation_skips_sorts(dctx):
+    """key_sorted propagates with hash_placed; sorted-elided pipelines
+    still produce exact results (the skipped sorts were redundant)."""
+    kv = dctx.dense_range(20_000).map(lambda x: (x % 101, x))
+    reduced = kv.reduce_by_key(op="add")
+    assert reduced.key_sorted and reduced.map_values(lambda v: v).key_sorted
+    assert not kv.key_sorted
+
+    base = dict(reduced.collect())
+    # reduce-of-reduce with presorted segment reduce
+    rr = dict(reduced.map_values(lambda v: v).reduce_by_key(op="min")
+              .collect())
+    assert rr == base  # single-row segments: min == value
+
+    # MULTI-row presorted segments: a group_by_key output (duplicate keys
+    # in sorted runs) feeds reduce_by_key, exercising the presorted
+    # boundary detection over real segments.
+    grouped = kv.group_by_key()
+    assert grouped.key_sorted
+    regrouped = dict(grouped.reduce_by_key(op="add").collect())
+    full = {}
+    for x in range(20_000):
+        full[x % 101] = full.get(x % 101, 0) + x
+    assert regrouped == full
+    # sorted-elided group_by (sort skipped)
+    g = dict(reduced.group_by_key().collect())
+    assert {key: vals[0] for key, vals in g.items()} == base
+    # sorted-elided join on both sides (both sorts skipped)
+    other = kv.map_values(lambda v: v * 2).reduce_by_key(op="add")
+    j = dict(reduced.join(other).collect())
+    assert j == {key: (base[key], 2 * base[key]) for key in base}

@@ -131,6 +131,15 @@ class DenseRDD(RDD):
         keys resets it."""
         return False
 
+    @property
+    def key_sorted(self) -> bool:
+        """True when each shard's valid rows are provably key-sorted
+        (reduce/group/join outputs). Together with hash_placed this lets
+        downstream keyed ops skip their own sort: order survives the
+        stable compact of an elided (passthrough) exchange, but NOT a real
+        exchange or a union concat."""
+        return False
+
     def _schema(self) -> Tuple[Tuple[str, jnp.dtype], ...]:
         """(name, dtype) of columns without materializing."""
         raise NotImplementedError
@@ -898,6 +907,10 @@ class _MapValuesRDD(_NarrowRDD):
     def hash_placed(self) -> bool:
         return self.parent.hash_placed  # keys untouched
 
+    @property
+    def key_sorted(self) -> bool:
+        return self.parent.key_sorted  # order untouched
+
 
 class _FilterRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, pred):
@@ -922,6 +935,10 @@ class _FilterRDD(_NarrowRDD):
     @property
     def hash_placed(self) -> bool:
         return self.parent.hash_placed  # surviving rows keep their keys
+
+    @property
+    def key_sorted(self) -> bool:
+        return self.parent.key_sorted  # compact is stable
 
 
 def _fixed_payload_schema(payload, width: int, what: str):
@@ -1171,6 +1188,10 @@ class _SelectRDD(_NarrowRDD):
     @property
     def hash_placed(self) -> bool:
         return KEY in self._names and self.parent.hash_placed
+
+    @property
+    def key_sorted(self) -> bool:
+        return KEY in self._names and self.parent.key_sorted
 
 
 class _ProjectRDD(_NarrowRDD):
@@ -1493,6 +1514,7 @@ class _ExchangeRDD(DenseRDD):
 
 class _ReduceByKeyRDD(_ExchangeRDD):
     hash_placed = True  # output rows live on shard hash(key) % n
+    key_sorted = True   # segment ends come out in key order
 
     def __init__(self, parent: DenseRDD, op: Optional[str], func):
         super().__init__(parent.context, parent.mesh, [parent])
@@ -1540,6 +1562,9 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # the whole exchange (hash + multi-key sort + collective)
         # collapses to one per-shard segment reduce — zero collectives.
         elide = self.parent.hash_placed and n > 1
+        # Order survives the elided passthrough's stable compact, letting
+        # the reduce run presorted (no sort at all in reduce-of-reduce).
+        elide_sorted = elide and self.parent.key_sorted
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -1579,12 +1604,14 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                         cols, count, capacity, out_cap
                     )
                 # reduce-side merge (reference: shuffled_rdd.rs:149-170)
-                cols, count = self._segment_reduce(cols, count, presorted=False)
+                cols, count = self._segment_reduce(cols, count,
+                                                   presorted=elide_sorted)
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
             key = ("rbk", self.mesh, tuple(names), n, slot, out_cap, elide,
+                   elide_sorted,
                    self.exchange_mode, self._op or _fp(self._func))
             prog = _cached_program(
                 key,
@@ -1614,6 +1641,7 @@ class _GroupByKeyRDD(_ExchangeRDD):
     """Exchange + local sort; block holds key-sorted runs per shard."""
 
     hash_placed = True  # output rows live on shard hash(key) % n
+    key_sorted = True   # the whole point of the grouped block
 
     def __init__(self, parent: DenseRDD):
         super().__init__(parent.context, parent.mesh, [parent])
@@ -1629,6 +1657,7 @@ class _GroupByKeyRDD(_ExchangeRDD):
         counts_host = np.asarray(jax.device_get(blk.counts))
         exchange = _get_exchange(self.exchange_mode)
         elide = self.parent.hash_placed and n > 1  # rows already placed
+        elide_sorted = elide and self.parent.key_sorted
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -1644,13 +1673,14 @@ class _GroupByKeyRDD(_ExchangeRDD):
                     cols, count, overflow = exchange(
                         cols, count, bucket, n, slot, out_cap
                     )
-                cols = kernels.sort_by_column(cols, count, KEY)
+                if not elide_sorted:  # already sorted rows skip the sort
+                    cols = kernels.sort_by_column(cols, count, KEY)
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
             key = ("gbk", self.mesh, tuple(names), n, slot, out_cap, elide,
-                   self.exchange_mode)
+                   elide_sorted, self.exchange_mode)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -1700,6 +1730,7 @@ class _JoinRDD(_ExchangeRDD):
     (e.g. a reduce_by_key output) skips its exchange entirely."""
 
     hash_placed = True  # joined rows stay on their key's shard
+    key_sorted = True   # output follows the left sort order
 
     def __init__(self, left: DenseRDD, right: DenseRDD,
                  outer: bool = False, fill_value=0):
@@ -1727,6 +1758,9 @@ class _JoinRDD(_ExchangeRDD):
         # pays ONE collective instead of two.
         l_elide = self.left.hash_placed and n > 1
         r_elide = self.right.hash_placed and n > 1
+        # Sortedness survives only the elided (stable passthrough) path.
+        l_sorted = l_elide and self.left.key_sorted
+        r_sorted = r_elide and self.right.key_sorted
         join_cap_override: List[Optional[int]] = [None]
         join_cap_used: List[int] = [0]
 
@@ -1753,6 +1787,7 @@ class _JoinRDD(_ExchangeRDD):
                 joined, jcount, jtotal = kernels.merge_join_expand(
                     lcols, lcount, rcols, rcount, KEY, join_cap,
                     outer=self.outer, fill_value=self.fill_value,
+                    left_sorted=l_sorted, right_sorted=r_sorted,
                 )
                 return (
                     jcount.reshape(1), jtotal.reshape(1), joined[KEY],
@@ -1762,7 +1797,7 @@ class _JoinRDD(_ExchangeRDD):
 
             prog = _cached_program(
                 ("join", self.mesh, n, slot_pair, out_cap, join_cap,
-                 l_elide, r_elide,
+                 l_elide, r_elide, l_sorted, r_sorted,
                  self.exchange_mode, self.outer, self.fill_value),
                 lambda: _shard_program(self.mesh, prog_fn, 6, (_SPEC,) * 6),
             )

@@ -385,6 +385,8 @@ def merge_join_expand(
     out_capacity: int,
     outer: bool = False,
     fill_value: float = 0,
+    left_sorted: bool = False,   # caller guarantees valid-prefix + sorted
+    right_sorted: bool = False,
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """General sort-merge join with duplicate keys on BOTH sides.
 
@@ -406,8 +408,10 @@ def merge_join_expand(
     """
     lcap = left[key_name].shape[0]
     rcap = right[key_name].shape[0]
-    left = sort_by_column(left, left_count, key_name)
-    right = sort_by_column(right, right_count, key_name)
+    if not left_sorted:
+        left = sort_by_column(left, left_count, key_name)
+    if not right_sorted:
+        right = sort_by_column(right, right_count, key_name)
     lkeys = left[key_name]
     rkeys = right[key_name]
     rmask = valid_mask(rcap, right_count)
