@@ -797,3 +797,88 @@ def test_key_sorted_propagation_skips_sorts(dctx):
     other = kv.map_values(lambda v: v * 2).reduce_by_key(op="add")
     j = dict(reduced.join(other).collect())
     assert j == {key: (base[key], 2 * base[key]) for key in base}
+
+
+def test_dense_multicolumn_tuple_combiner(dctx):
+    """reduce_by_key with a tuple-valued traced binop over a multi-column
+    block: streaming mean/variance components stay on device."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 20, 5_000).astype(np.int32)
+    x = rng.rand(5_000).astype(np.float32)
+    blk = dctx.dense_from_columns(
+        {"k": keys, "s": x, "ss": x * x,
+         "cnt": np.ones(5_000, np.float32)}, key="k",
+    )
+
+    def comb(a, b):
+        return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+
+    got = blk.reduce_by_key(comb)
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    assert isinstance(got, DenseRDD)
+    cols = got.collect_arrays()
+    by_key = {int(k_): (s, ss, c) for k_, s, ss, c in zip(
+        cols["k"], cols["s"], cols["ss"], cols["cnt"])}
+    for k_ in range(20):
+        sel = x[keys == k_]
+        s, ss, c = by_key[k_]
+        assert c == len(sel)
+        assert s == pytest.approx(float(sel.sum()), rel=1e-4)
+        mean = s / c
+        var = ss / c - mean * mean
+        assert var == pytest.approx(float(sel.var()), rel=1e-3, abs=1e-5)
+
+    # Arity mismatch on a multi-column block has no host fallback form:
+    # it must raise crisply, never feed the host tier tuples it can't fold.
+    def bad(a, b):
+        return a[0] + b[0]  # scalar, not a 3-tuple
+
+    with pytest.raises(v.VegaError, match="tuple binop"):
+        blk.reduce_by_key(bad)
+
+
+def test_dense_map_values_multicolumn_rejected(dctx):
+    blk = dctx.dense_from_columns({"k": np.arange(10), "a": np.arange(10),
+                                   "b": np.arange(10)}, key="k")
+    with pytest.raises(v.VegaError, match="exactly one value column"):
+        blk.map_values(lambda x: x)
+
+
+def test_single_named_value_column_ops(dctx):
+    """A block with one value column under a non-canonical name works with
+    map_values and traced reduce_by_key on device."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    blk = dctx.dense_from_columns(
+        {"k": (np.arange(1000) % 9).astype(np.int32),
+         "s": np.arange(1000, dtype=np.int32)}, key="k")
+    mapped = blk.map_values(lambda x: x * 2)
+    assert isinstance(mapped, DenseRDD)
+    red = mapped.reduce_by_key(lambda a, b: a + b)
+    assert isinstance(red, DenseRDD)
+    cols = red.collect_arrays()
+    got = dict(zip(cols["k"].tolist(), cols["s"].tolist()))
+    assert got == {key: 2 * sum(range(key, 1000, 9)) for key in range(9)}
+
+    # untraceable binop on a named block: crisp error, not silent garbage
+    with pytest.raises(v.VegaError, match="traceable binop"):
+        blk.reduce_by_key(lambda a, b: max(int(a), int(b)))
+
+
+def test_dtype_changing_binop_keeps_schema_truthful(dctx):
+    """A binop that changes the value dtype cannot run on device (the
+    block schema would lie); on canonical (k, v) blocks it falls back to
+    the host tier with correct (retyped) results."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    kv = dctx.dense_range(100).map(lambda x: (x % 5, x))
+    # int -> float promotion; associative, and sums stay exact in float,
+    # so the result is order-independent and host-comparable.
+    r = kv.reduce_by_key(lambda a, b: a + b + 0.0)
+    assert not isinstance(r, DenseRDD)  # host fallback
+    assert dict(r.collect()) == {
+        key: float(sum(range(key, 100, 5))) for key in range(5)
+    }

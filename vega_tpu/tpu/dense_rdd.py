@@ -268,6 +268,13 @@ class DenseRDD(RDD):
     def map_values(self, f: Callable):
         if not self.is_pair:
             raise VegaError("map_values on non-pair DenseRDD")
+        value_names = [nm for nm, _ in self._schema() if nm != KEY]
+        if len(value_names) != 1:
+            raise VegaError(
+                "map_values needs exactly one value column (have "
+                f"{value_names}); use select(...) or a tuple-valued "
+                "reduce_by_key on multi-column blocks"
+            )
         try:
             return _MapValuesRDD(self, f)
         except _NotTraceable as e:
@@ -298,6 +305,17 @@ class DenseRDD(RDD):
             return _with_exchange(_ReduceByKeyRDD(self, op=None, func=func),
                                   exchange)
         except _NotTraceable as e:
+            if {nm for nm, _ in self._schema()} != {KEY, VALUE}:
+                # Named/multi-column blocks have no host-tier row form a
+                # binary func could fold (compute() yields schema-order
+                # tuples, not (k, v) pairs) — the silent fallback would
+                # produce WRONG results, so this is the documented
+                # exception to the fallback-never-error contract.
+                raise VegaError(
+                    "reduce_by_key over a named/multi-column block needs a "
+                    f"traceable binop (not traceable: {e}); use "
+                    "op='add'/'min'/'max'/'prod' or a traceable tuple binop"
+                ) from e
             log.info("dense reduce_by_key fell back to host tier: %s", e)
             return super().reduce_by_key(func, partitioner_or_num)
 
@@ -890,18 +908,26 @@ class _MapRDD(_NarrowRDD):
 class _MapValuesRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, f):
         pschema = dict(parent._schema())
+        # The single value column, whatever its name (canonical 'v' or a
+        # named column from dense_from_columns).
+        self._vname = next(nm for nm in pschema if nm != KEY)
         try:
-            out = jax.eval_shape(f, jax.ShapeDtypeStruct((), pschema[VALUE]))
+            out = jax.eval_shape(
+                f, jax.ShapeDtypeStruct((), pschema[self._vname])
+            )
         except Exception as e:  # noqa: BLE001
             raise _NotTraceable(str(e)) from e
         if not hasattr(out, "shape") or out.shape != ():
             raise _NotTraceable("map_values fn must return a scalar")
-        super().__init__(parent, ((KEY, pschema[KEY]), (VALUE, out.dtype)))
+        super().__init__(
+            parent, ((KEY, pschema[KEY]), (self._vname, out.dtype))
+        )
         self._f = f
         self._user_fn = f
 
     def _shard_fn(self, cols, count):
-        return {KEY: cols[KEY], VALUE: jax.vmap(self._f)(cols[VALUE])}, count
+        return {KEY: cols[KEY],
+                self._vname: jax.vmap(self._f)(cols[self._vname])}, count
 
     @property
     def hash_placed(self) -> bool:
@@ -1520,18 +1546,45 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         super().__init__(parent.context, parent.mesh, [parent])
         self.parent = parent
         self._op = op
+        pschema = parent._schema()
+        self._value_names = [nm for nm, _ in pschema if nm != KEY]
         if func is not None:
-            pschema = dict(parent._schema())
+            dtypes = dict(pschema)
+            structs = [jax.ShapeDtypeStruct((), dtypes[nm])
+                       for nm in self._value_names]
+            # Single value column: func is scalar x scalar -> scalar.
+            # Multi-column block: func is tuple x tuple -> tuple, one
+            # scalar per value column (device mean/variance etc. without
+            # leaving the columnar layout).
+            arg = structs[0] if len(structs) == 1 else tuple(structs)
             try:
-                out = jax.eval_shape(
-                    func,
-                    jax.ShapeDtypeStruct((), pschema[VALUE]),
-                    jax.ShapeDtypeStruct((), pschema[VALUE]),
-                )
+                out = jax.eval_shape(func, arg, arg)
             except Exception as e:  # noqa: BLE001
                 raise _NotTraceable(str(e)) from e
-            if not hasattr(out, "shape") or out.shape != ():
-                raise _NotTraceable("binop must return a scalar")
+            if len(structs) == 1:
+                if not hasattr(out, "shape") or out.shape != ():
+                    raise _NotTraceable("binop must return a scalar")
+                if out.dtype != structs[0].dtype:
+                    raise _NotTraceable(
+                        f"binop changes the value dtype "
+                        f"({structs[0].dtype} -> {out.dtype}); cast the "
+                        "column first so the block schema stays truthful"
+                    )
+            else:
+                if not (isinstance(out, tuple) and len(out) == len(structs)):
+                    raise _NotTraceable(
+                        f"binop over {len(structs)} value columns must "
+                        f"return a {len(structs)}-tuple"
+                    )
+                for nm, s, o in zip(self._value_names, structs, out):
+                    if getattr(o, "shape", None) != ():
+                        raise _NotTraceable("binop outputs must be scalars")
+                    if o.dtype != s.dtype:
+                        raise _NotTraceable(
+                            f"binop changes dtype of column {nm!r} "
+                            f"({s.dtype} -> {o.dtype}); cast the column "
+                            "first so the block schema stays truthful"
+                        )
         self._func = func
 
     def _schema(self):
@@ -1543,9 +1596,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 cols, count, KEY, self._op, presorted=presorted
             )
         f = self._func
+        names = self._value_names
+        if len(names) == 1:
+            nm0 = names[0]
 
-        def combine(a, b):
-            return {VALUE: f(a[VALUE], b[VALUE])}
+            def combine(a, b):
+                return {nm0: f(a[nm0], b[nm0])}
+        else:
+            def combine(a, b):
+                out = f(tuple(a[nm] for nm in names),
+                        tuple(b[nm] for nm in names))
+                return dict(zip(names, out))
 
         return kernels.segment_reduce_sorted(
             cols, count, KEY, combine, presorted=presorted
