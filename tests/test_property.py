@@ -74,3 +74,80 @@ def test_random_skewed_distribution(ctx):
         host[k] = host.get(k, 0) + 1
     assert len(collected) == len(host)
     assert dict(collected) == host
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12])
+def test_random_dup_join_parity(ctx, seed):
+    """Dup x dup joins over random key multisets: device == brute force."""
+    from collections import defaultdict
+
+    rng = np.random.RandomState(seed)
+    n_left = int(rng.randint(1, 4_000))
+    n_right = int(rng.randint(1, 800))
+    key_space = int(rng.randint(1, 300))
+    lk = rng.randint(0, key_space, n_left).astype(np.int32)
+    rk = rng.randint(0, key_space, n_right).astype(np.int32)
+    lv = rng.randint(0, 10**6, n_left).astype(np.int32)
+    rv = rng.randint(0, 10**6, n_right).astype(np.int32)
+
+    dev = sorted(ctx.dense_from_numpy(lk, lv)
+                 .join(ctx.dense_from_numpy(rk, rv)).collect())
+    rmap = defaultdict(list)
+    for k, x in zip(rk.tolist(), rv.tolist()):
+        rmap[k].append(x)
+    brute = sorted((k, (a, b)) for k, a in zip(lk.tolist(), lv.tolist())
+                   for b in rmap.get(k, []))
+    assert dev == brute
+
+
+@pytest.mark.parametrize("seed", [13, 14])
+def test_random_streamed_reduce_parity(ctx, seed):
+    """Streamed chunked reduce == resident reduce on random int data."""
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(5_000, 120_000))
+    chunk = int(rng.randint(1_000, max(2_000, n // 3)))
+    n_keys = int(rng.randint(1, 2_000))
+    s = (ctx.dense_range(n, chunk_rows=chunk)
+         .map(lambda x: (x % n_keys, x)).reduce_by_key(op="add")).collect()
+    r = (ctx.dense_range(n)
+         .map(lambda x: (x % n_keys, x)).reduce_by_key(op="add")).collect()
+    # No duplicate keys may survive either reduce (dict() would mask them).
+    assert len(s) == len(r) == min(n, n_keys)
+    assert dict(s) == dict(r)
+
+
+@pytest.mark.parametrize("seed", [15, 16])
+def test_random_flat_map_ragged_parity(ctx, seed):
+    """Random per-row arities: device expansion == python expansion."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(100, 20_000))
+    mod = int(rng.randint(2, 7))
+    cap = mod - 1  # max arity == capacity: exercises the full-slot boundary
+
+    def emit(x):
+        return jnp.full((cap,), x * 3), x % mod
+
+    got = sorted(ctx.dense_range(n).flat_map_ragged(emit, cap).collect())
+    exp = sorted(x * 3 for x in range(n) for _ in range(x % mod))
+    assert got == exp
+
+
+@pytest.mark.parametrize("seed", [17, 18])
+def test_random_elided_chain_parity(ctx, seed):
+    """Random chains over hash-placed data (elided shuffles) == host."""
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(2_000, 50_000))
+    n_keys = int(rng.randint(1, 500))
+    reduced = (ctx.dense_range(n).map(lambda x: (x % n_keys, x))
+               .reduce_by_key(op="add"))
+    dev_rows = (reduced.map_values(lambda s: s % 10_007)
+                .reduce_by_key(op="max").collect())
+    assert len(dev_rows) == min(n, n_keys)  # no duplicate keys survive
+    dev = dict(dev_rows)
+    host = {}
+    for x in range(n):
+        host[x % n_keys] = host.get(x % n_keys, 0) + x
+    host = {k: s % 10_007 for k, s in host.items()}
+    assert dev == host
