@@ -1,0 +1,72 @@
+"""A/B: shuffle elision over hash-placed data (round-2 optimization).
+
+reduce-of-reduce and reduced.join(table) skip the hash + multi-key sort +
+collective for sides that are provably hash-placed. This measures the
+second-stage cost with and without a placed input. To keep the comparison
+fair, BOTH variants process the same n_keys rows (the reduce output):
+
+  A) the rows re-ingested as a fresh (unplaced) source -> full exchange
+  B) the placed reduce output directly -> elided passthrough
+
+Runs on the 8-virtual-device CPU mesh (forced below): elision only
+matters on multi-shard meshes, and a single real chip has no exchange to
+elide. Usage: python benchmarks/elision_ab.py [rows] [n_keys]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+    n_keys = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000
+
+    import jax
+
+    import vega_tpu as v
+
+    ctx = v.Context("local")
+    try:
+        reduced = (ctx.dense_range(rows).map(lambda x: (x % n_keys, x))
+                   .reduce_by_key(op="add"))
+        reduced.block()  # materialize the placed input
+
+        # Unplaced copy of the same rows (fresh source, same data).
+        cols = reduced.collect_arrays()
+        unplaced = ctx.dense_from_numpy(cols["k"], cols["v"])
+
+        def timed(node_fn, label):
+            warm = node_fn()
+            jax.block_until_ready(list(warm.block().cols.values()))
+            t0 = time.time()
+            n_iter = 5
+            for _ in range(n_iter):
+                fresh = node_fn()
+                jax.block_until_ready(list(fresh.block().cols.values()))
+            dt = (time.time() - t0) / n_iter
+            print(f"{label}: {dt*1e3:.1f} ms "
+                  f"({len(cols['k'])/dt/1e6:.2f} M rows/s)")
+            return dt
+
+        a = timed(lambda: unplaced.map_values(lambda s: s % 1009)
+                  .reduce_by_key(op="max"), "A_full_exchange")
+        b = timed(lambda: reduced.map_values(lambda s: s % 1009)
+                  .reduce_by_key(op="max"), "B_elided")
+        ga = dict(unplaced.map_values(lambda s: s % 1009)
+                  .reduce_by_key(op="max").collect())
+        gb = dict(reduced.map_values(lambda s: s % 1009)
+                  .reduce_by_key(op="max").collect())
+        assert ga == gb, "elided and full-exchange results must match"
+        print(f"backend={jax.default_backend()} speedup A/B = {a/b:.2f}x")
+    finally:
+        ctx.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
