@@ -882,3 +882,18 @@ def test_dtype_changing_binop_keeps_schema_truthful(dctx):
     assert dict(r.collect()) == {
         key: float(sum(range(key, 100, 5))) for key in range(5)
     }
+
+
+def test_cogroup_collect_grouped_columnar(dctx):
+    """Columnar cogroup result matches the per-group collect() exactly."""
+    left = dctx.dense_range(4_000).map(lambda x: (x % 60, x))
+    right = dctx.dense_range(900).map(lambda x: (x % 75, x * 10))
+    cg = left.cogroup(right)
+    keys, lo, lv, ro, rv = cg.collect_grouped()
+    assert lo[-1] == 4_000 and ro[-1] == 900
+    ref = dict(cg.collect())
+    assert len(keys) == len(ref)
+    for i, key in enumerate(keys.tolist()):
+        lvs, rvs = ref[key]
+        assert sorted(lv[lo[i]:lo[i + 1]].tolist()) == sorted(lvs)
+        assert sorted(rv[ro[i]:ro[i + 1]].tolist()) == sorted(rvs)

@@ -2092,6 +2092,64 @@ class _DenseCoGroupRDD(RDD):
             out.extend(self.compute(Split(s)))
         return out
 
+    def collect_grouped(self):
+        """Columnar cogroup: (keys, l_offsets, l_values, r_offsets,
+        r_values) — group i's left values are
+        l_values[l_offsets[i]:l_offsets[i+1]] (resp. right). No per-row or
+        per-key Python: keys are hash-disjoint across shards and sorted
+        within one, so each shard's two sides align with one union +
+        searchsorted pass and value arrays concatenate untouched."""
+        def expand_offsets(gk, goff, union):
+            # gk is a subset of the sorted union, so one scatter places
+            # each group's length at its union slot.
+            lengths = np.zeros(len(union), dtype=np.int64)
+            lengths[np.searchsorted(union, gk)] = goff[1:] - goff[:-1]
+            return np.concatenate([[0], np.cumsum(lengths)])
+
+        # One device gather per side (counts fetched once, columns whole);
+        # shard boundaries are then host-side splits — no per-shard
+        # device round-trips.
+        lblk = self.left_grouped.block()
+        rblk = self.right_grouped.block()
+        l_counts = np.asarray(jax.device_get(lblk.counts))
+        r_counts = np.asarray(jax.device_get(rblk.counts))
+        lall = lblk.to_numpy()
+        rall = rblk.to_numpy()
+
+        def shard_parts(all_cols, counts):
+            splits = np.cumsum(counts)[:-1]
+            return (np.split(all_cols[KEY], splits),
+                    np.split(all_cols[VALUE], splits))
+
+        lk_s, lv_s = shard_parts(lall, l_counts)
+        rk_s, rv_s = shard_parts(rall, r_counts)
+
+        keys_parts, lv_parts, rv_parts = [], [], []
+        lo_parts, ro_parts = [np.zeros(1, np.int64)], [np.zeros(1, np.int64)]
+        l_base = r_base = 0
+        for s in range(self.num_partitions):
+            lk, loff, lv = _grouped_columnar(lk_s[s], lv_s[s])
+            rk, roff, rv = _grouped_columnar(rk_s[s], rv_s[s])
+            union = np.union1d(lk, rk)
+            if not len(union):
+                continue
+            keys_parts.append(union)
+            lo = expand_offsets(lk, loff, union)
+            ro = expand_offsets(rk, roff, union)
+            lo_parts.append(lo[1:] + l_base)
+            ro_parts.append(ro[1:] + r_base)
+            l_base += lo[-1]
+            r_base += ro[-1]
+            lv_parts.append(lv)
+            rv_parts.append(rv)
+        if not keys_parts:
+            zero = np.zeros(1, np.int64)
+            return (lall[KEY][:0], zero, lall[VALUE][:0],
+                    zero, rall[VALUE][:0])
+        return (np.concatenate(keys_parts),
+                np.concatenate(lo_parts), np.concatenate(lv_parts),
+                np.concatenate(ro_parts), np.concatenate(rv_parts))
+
 
 class _DenseUnionRDD(DenseRDD):
     """Per-shard concatenation of two same-schema dense RDDs."""
