@@ -1,6 +1,5 @@
 """Pair-op golden tests (reference: tests/test_pair_rdd.rs)."""
 
-import pytest
 
 import vega_tpu as v
 

@@ -4,7 +4,6 @@ Mirrors the reference's integration suite one test per op
 (tests/test_rdd.rs:33-699); reference line cites on each test.
 """
 
-import math
 import os
 
 import pytest

@@ -11,7 +11,7 @@ import pytest
 
 import vega_tpu as v
 from vega_tpu.env import Env
-from vega_tpu.errors import FetchFailedError, TaskError
+from vega_tpu.errors import TaskError
 
 
 def test_stage_cutting(ctx):

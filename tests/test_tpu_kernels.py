@@ -1,7 +1,6 @@
 """Device-kernel unit tests: pallas kernels (interpret mode on CPU), ring
 vs all_to_all exchange parity, shard-local kernel correctness."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -5,7 +5,6 @@ balancing src/io/local_file_reader.rs:479-553, cache, samplers, heaps)."""
 import os
 
 import numpy as np
-import pytest
 
 from vega_tpu.cache import BoundedMemoryCache, KeySpace
 from vega_tpu.io.readers import assign_files_to_partitions

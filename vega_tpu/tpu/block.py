@@ -151,7 +151,7 @@ def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
     the device analogue of ctx.range (reference: context.rs:422-442), built
     on device with no host materialization. `start` offsets the whole range
     (used by the chunked/streamed source)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     mesh = mesh or mesh_lib.default_mesh()
     n_shards = mesh.size
