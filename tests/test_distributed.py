@@ -77,3 +77,23 @@ def test_executor_loss_recovery(dist_ctx):
     assert dict(shuffled.collect()) == {0: 10, 1: 10, 2: 10, 3: 10}
     # fresh work still schedules on the survivor
     assert dist_ctx.parallelize(list(range(20)), 4).map(lambda x: x + 1).count() == 20
+
+
+def test_dense_rdd_crosses_process_boundary(dist_ctx):
+    """A dense RDD consumed by distributed host-tier tasks ships as host
+    numpy (jax arrays/meshes are process-local): mixing tiers works in
+    distributed mode, not just locally."""
+    dense = dist_ctx.dense_range(1_000).map(lambda x: (x % 7, x))
+    got = dict(
+        dense.to_rdd().map_values(lambda x: x * 2)
+        .reduce_by_key(lambda a, b: a + b, 3).collect()
+    )
+    exp = {}
+    for x in range(1_000):
+        exp[x % 7] = exp.get(x % 7, 0) + 2 * x
+    assert got == exp
+
+    host_side = dist_ctx.parallelize([(i, f"h{i}") for i in range(7)], 2)
+    cg = dict(dense.cogroup(host_side).collect())
+    assert sorted(cg[2][0]) == [x for x in range(1_000) if x % 7 == 2]
+    assert cg[2][1] == ["h2"]

@@ -93,6 +93,30 @@ def _cached_program(key, build):
     return prog
 
 
+class _HostMeshStub:
+    """Stands in for a jax Mesh on the far side of a pickle: Block only
+    reads .size, and jax.device_get passes numpy through, so a Block whose
+    columns are host numpy works unchanged for reading."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+
+def _yield_rows(rows: dict):
+    """Host-facing row iteration over one shard's columns — shared by
+    DenseRDD.compute and the unpickled _HostDenseView so the two tiers'
+    row semantics cannot drift."""
+    names = list(rows)
+    if names == [VALUE]:
+        yield from rows[VALUE].tolist()
+    elif set(names) == {KEY, VALUE}:
+        yield from zip(rows[KEY].tolist(), rows[VALUE].tolist())
+    else:
+        cols = [rows[n] for n in names]
+        for i in range(len(cols[0])):
+            yield tuple(c[i] for c in cols)
+
+
 class DenseRDD(RDD):
     """Base dense node. Subclasses implement _materialize() -> Block."""
 
@@ -102,6 +126,53 @@ class DenseRDD(RDD):
         super().__init__(ctx, deps=[OneToOneDependency(r) for r in deps_rdds])
         self.mesh = mesh
         self._block: Optional[Block] = None
+
+    # --- process portability ------------------------------------------------
+    def __getstate__(self):
+        """Dense nodes cross process boundaries as HOST data: jax arrays,
+        meshes, and traced programs are process-local, so the block is
+        materialized at pickle time (driver side) and ships as numpy
+        columns. The restored object is a _HostDenseView — same shard
+        structure, iteration-only (the moral analogue of the reference's
+        ParallelCollectionSplit carrying its data slice inside the split,
+        parallel_collection_rdd.rs:30-56).
+
+        Memoized: a host-tier stage with P tasks pickles this node P times
+        (one dumps per task, distributed/backend.py), so the device->host
+        gather happens once, not per task. NOTE pickling is intended for
+        driver-side task serialization; an incidental pickle (e.g. a user
+        closure capturing a DenseRDD) also materializes the node here."""
+        memo = getattr(self, "_pickle_state_memo", None)
+        if memo is None:
+            blk = self.block()
+            memo = {
+                "context": self.context,
+                "rdd_id": self.rdd_id,
+                "should_cache": self.should_cache,
+                "_pinned": self._pinned,
+                "cols": {n: np.asarray(jax.device_get(c))
+                         for n, c in blk.cols.items()},
+                "counts": np.asarray(jax.device_get(blk.counts)),
+                "capacity": blk.capacity,
+            }
+            self._pickle_state_memo = memo
+        return memo
+
+    def __setstate__(self, state):
+        self.__class__ = _HostDenseView
+        self.context = state["context"]
+        self.rdd_id = state["rdd_id"]
+        self._deps = []
+        self._partitioner = None
+        self.should_cache = state["should_cache"]
+        self._pinned = state["_pinned"]
+        self._checkpoint_dir = None
+        self._checkpointed_rdd = None
+        self._host_block = Block(
+            cols=state["cols"], counts=state["counts"],
+            capacity=state["capacity"],
+            mesh=_HostMeshStub(len(state["counts"])),
+        )
 
     # --- device plane -------------------------------------------------------
     def block(self) -> Block:
@@ -153,16 +224,7 @@ class DenseRDD(RDD):
         return [Split(i) for i in range(self.num_partitions)]
 
     def compute(self, split: Split, task_context=None):
-        rows = self.block().shard_rows(split.index)
-        names = list(rows)
-        if names == [VALUE]:
-            yield from rows[VALUE].tolist()
-        elif set(names) == {KEY, VALUE}:
-            yield from zip(rows[KEY].tolist(), rows[VALUE].tolist())
-        else:
-            cols = [rows[n] for n in names]
-            for i in range(len(cols[0])):
-                yield tuple(c[i] for c in cols)
+        yield from _yield_rows(self.block().shard_rows(split.index))
 
     @property
     def columns(self) -> List[str]:
@@ -1239,6 +1301,32 @@ class _ProjectRDD(_NarrowRDD):
 # ---------------------------------------------------------------------------
 # source nodes
 # ---------------------------------------------------------------------------
+
+
+class _HostDenseView(RDD):
+    """What an unpickled DenseRDD is: the materialized rows as host numpy,
+    original shard structure preserved, iteration-only surface (compute /
+    iterator / collect). Device ops are not available — a shipped dense
+    node is consumed by host-tier tasks, never re-launched as SPMD."""
+
+    def __init__(self, *a, **kw):  # pragma: no cover — pickle-only
+        raise TypeError("_HostDenseView is created by unpickling a DenseRDD")
+
+    @property
+    def num_partitions(self) -> int:
+        return self._host_block.n_shards
+
+    def block(self) -> Block:
+        return self._host_block
+
+    def __getstate__(self):
+        return self.__dict__.copy()
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    def compute(self, split: Split, task_context=None):
+        yield from _yield_rows(self._host_block.shard_rows(split.index))
 
 
 class _SourceRDD(DenseRDD):
