@@ -178,3 +178,41 @@ def test_streamed_as_resident_operand(ctx):
     # union with a streamed operand goes through the same delegation
     u = ctx.dense_range(100).union(ctx.dense_range(100, chunk_rows=30))
     assert u.count() == 200
+
+
+def test_streamed_join_and_expansions(ctx):
+    """join/map_expand/flat_map_ragged compose per chunk and stay
+    streamed; results match the resident pipeline."""
+    import jax.numpy as jnp
+
+    n, k, chunk = 90_000, 1_000, 20_000
+    table = ctx.dense_from_numpy(np.arange(k, dtype=np.int32),
+                                 np.arange(k, dtype=np.int32) * 3)
+    s = (ctx.dense_range(n, chunk_rows=chunk)
+         .map(lambda x: (x % k, x)).join(table))
+    assert isinstance(s, StreamedDenseRDD)
+    assert s.count() == n
+    r = ctx.dense_range(n).map(lambda x: (x % k, x)).join(table)
+    assert r.count() == n
+    # value parity, not just row counts
+    assert sorted(s.collect()) == sorted(r.collect())
+
+    # streamed right side: materialized resident once, then per-chunk join
+    s2 = (ctx.dense_range(n, chunk_rows=chunk).map(lambda x: (x % k, x))
+          .join(ctx.dense_range(k, chunk_rows=300)
+                .map(lambda x: (x, x * 3))))
+    assert isinstance(s2, StreamedDenseRDD)
+    assert s2.count() == n
+
+    def dup(x):
+        return jnp.stack([x, x + 1_000_000]), jnp.int32(2)
+
+    se = ctx.dense_range(30_000, chunk_rows=7_000).flat_map_ragged(dup, 2)
+    assert isinstance(se, StreamedDenseRDD)
+    assert se.count() == 60_000
+    assert se.max() == 29_999 + 1_000_000
+
+    me = ctx.dense_range(10_000, chunk_rows=3_000).map_expand(
+        lambda x: jnp.stack([x, x]), 2)
+    assert isinstance(me, StreamedDenseRDD)
+    assert me.count() == 20_000

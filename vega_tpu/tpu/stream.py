@@ -158,6 +158,51 @@ class StreamedDenseRDD:
     def map_values(self, f: Callable):
         return self._per_chunk("map_values", lambda c: c.map_values(f))
 
+    def map_expand(self, f: Callable, factor: int):
+        return self._per_chunk("map_expand",
+                               lambda c: c.map_expand(f, factor))
+
+    def flat_map_ragged(self, f: Callable, max_out_per_row: int):
+        return self._per_chunk(
+            "flat_map_ragged",
+            lambda c: c.flat_map_ragged(f, max_out_per_row),
+        )
+
+    def join(self, other, partitioner_or_num=None, *,
+             exchange: Optional[str] = None):
+        """Streamed join against a RESIDENT right side: each chunk joins
+        independently (a left row's matches depend only on the table), so
+        the result streams too — a 1B-row enrichment join never
+        materializes whole. The right side is hash-placed ONCE up front
+        (one exchange+sort total; every per-chunk join then elides its
+        side), and must itself fit the HBM budget — this is the
+        broadcast-style enrichment join, not a stream-stream shuffle.
+        A streamed right side is materialized resident first; non-dense
+        right sides or explicit partitioners delegate to the resident
+        build."""
+        from vega_tpu.env import Env
+        from vega_tpu.tpu.dense_rdd import DenseRDD, _GroupByKeyRDD
+
+        if isinstance(other, StreamedDenseRDD):
+            other = other.resident()
+        if isinstance(other, DenseRDD) and partitioner_or_num is None:
+            if not other.hash_placed:
+                # One exchange+sort re-places the table; per-chunk joins
+                # then skip the right side's exchange AND sort entirely.
+                other = _GroupByKeyRDD(other)
+            budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
+            blk = getattr(other, "_block", None)
+            if blk is not None and blk.nbytes * 3 > budget:
+                log.warning(
+                    "streamed join: right side is %.1f MiB — chunk sizing "
+                    "does not account for it; lower chunk_rows if HBM "
+                    "overflows", blk.nbytes / 2**20,
+                )
+            return self._per_chunk(
+                "join", lambda c: c.join(other, exchange=exchange)
+            )
+        return self.resident().join(other, partitioner_or_num)
+
     # --- streaming aggregations ------------------------------------------
     def reduce_by_key(self, func=None, partitioner_or_num=None, *,
                       op: Optional[str] = None,
