@@ -461,13 +461,23 @@ def test_dense_left_outer_join(dctx):
                   (3, (30, 0)), (4, (40, 0))]
 
 
-def test_dense_int64_out_of_range_rejected(dctx):
-    with pytest.raises(v.VegaError):
-        dctx.dense_from_numpy(np.array([2**40, 1], dtype=np.int64),
-                              np.array([1, 2], dtype=np.int64))
-    # in-range int64 narrows safely
+def test_dense_int64_out_of_range_falls_back_to_host(dctx):
+    """int64 data the device cannot hold faithfully degrades to the host
+    tier (exact int64 semantics preserved) instead of erroring — the
+    two-tier contract applied to dtypes."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    big = dctx.dense_from_numpy(
+        np.array([2**40, 1, 2**40], dtype=np.int64),
+        np.array([1, 2, 3], dtype=np.int64),
+    )
+    assert not isinstance(big, DenseRDD)
+    got = dict(big.reduce_by_key(lambda a, b: a + b, 2).collect())
+    assert got == {2**40: 4, 1: 2}  # exact int64 keys and sums
+    # in-range int64 narrows safely and stays dense
     r = dctx.dense_from_numpy(np.array([5, 6], dtype=np.int64),
                               np.array([50, 60], dtype=np.int64))
+    assert isinstance(r, DenseRDD)
     assert sorted(r.collect()) == [(5, 50), (6, 60)]
 
 
@@ -897,3 +907,56 @@ def test_cogroup_collect_grouped_columnar(dctx):
         lvs, rvs = ref[key]
         assert sorted(lv[lo[i]:lo[i + 1]].tolist()) == sorted(lvs)
         assert sorted(rv[ro[i]:ro[i + 1]].tolist()) == sorted(rvs)
+
+
+def test_dense_cartesian_parity_and_budget_gate(dctx):
+    """Device cartesian (BASELINE config 4) matches the host tier; an
+    over-budget product degrades to the lazy host cartesian."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD, _CartesianDenseRDD
+
+    a = dctx.dense_range(300)
+    b = dctx.dense_from_numpy(np.array([10, 20, 30], dtype=np.int32))
+    cart = a.cartesian(b)
+    assert isinstance(cart, _CartesianDenseRDD)
+    got = sorted(cart.collect())
+    exp = sorted((x, y) for x in range(300) for y in (10, 20, 30))
+    assert got == exp
+    assert cart.count() == 900
+
+    # pair ops compose on the device product (canonical (KEY, VALUE))
+    red = dict(cart.reduce_by_key(op="add").collect())
+    assert red == {x: 60 for x in range(300)}
+
+    # over-budget: operands stay RESIDENT (10 MB budget) but the ~300 MB
+    # product trips the gate inside _CartesianDenseRDD -> lazy host path
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_hbm_budget
+    Env.get().conf.dense_hbm_budget = 10 << 20
+    try:
+        left = dctx.dense_range(10_000)
+        assert isinstance(left, DenseRDD)  # resident, gate actually runs
+        big = left.cartesian(dctx.dense_range(10_000))
+        assert not isinstance(big, DenseRDD)
+        assert big.take(2) == [(0, 0), (0, 1)]
+    finally:
+        Env.get().conf.dense_hbm_budget = old
+
+    # empty right side
+    empty = dctx.dense_range(50).cartesian(
+        dctx.dense_range(100).filter(lambda x: x < 0))
+    assert empty.count() == 0
+
+
+def test_dense_from_columns_int64_fallback(dctx):
+    """The canonical (key, value) from_columns face degrades like
+    dense_from_numpy; named/multi-column blocks keep the crisp error."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    r = dctx.dense_from_columns({"k": [2**40, 2**40, 1], "v": [1, 2, 3]},
+                                key="k")
+    assert not isinstance(r, DenseRDD)
+    assert dict(r.reduce_by_key(lambda a, b: a + b, 2).collect()) == {
+        2**40: 3, 1: 3}
+    with pytest.raises(v.VegaError):
+        dctx.dense_from_columns({"k": [2**40], "x": [1], "y": [2]}, key="k")

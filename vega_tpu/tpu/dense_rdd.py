@@ -496,6 +496,25 @@ class DenseRDD(RDD):
             return _DenseCoGroupRDD(self, others[0])
         return super().cogroup(*others, partitioner_or_num=partitioner_or_num)
 
+    def cartesian(self, other):
+        """Device cross product (BASELINE config 4; reference
+        cartesian_rdd.rs): the right side replicates to every shard and
+        each shard ragged-expands its left rows against it — one program,
+        no collectives beyond the replication. Products too big for the
+        HBM budget (or non-dense/multi-column operands) use the host
+        tier's lazy cartesian, which streams instead of materializing."""
+        from vega_tpu.env import Env
+
+        if (isinstance(other, DenseRDD) and other.mesh == self.mesh
+                and [n for n, _ in self._schema()] == [VALUE]
+                and [n for n, _ in other._schema()] == [VALUE]):
+            budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
+            try:
+                return _CartesianDenseRDD(self, other, budget)
+            except _NotTraceable as e:
+                log.info("dense cartesian fell back to host tier: %s", e)
+        return RDD.cartesian(self, other)
+
     def sort_by_key(self, ascending: bool = True, num_partitions=None,
                     sample_size_hint: int = 4096,
                     exchange: Optional[str] = None):
@@ -1368,16 +1387,32 @@ def dense_range(ctx, n: int, num_partitions=None, dtype=None,
     return _SourceRDD(ctx, block_lib.block_range(n, mesh, dtype))
 
 
-def dense_from_numpy(ctx, columns, num_partitions=None) -> DenseRDD:
-    """columns: one array (values) or two arrays (keys, values)."""
+def dense_from_numpy(ctx, columns, num_partitions=None):
+    """columns: one array (values) or two arrays (keys, values).
+
+    Data the device tier cannot represent faithfully (int64 beyond int32
+    range without jax x64 — keys would silently collide) degrades to the
+    HOST tier, never errors: the two-tier contract applied to dtypes. The
+    host tier keeps exact int64 semantics."""
     mesh = mesh_lib.default_mesh()
-    if len(columns) == 1:
-        blk = block_lib.single_column(columns[0], mesh)
-    elif len(columns) == 2:
-        blk = block_lib.pair_block(columns[0], columns[1], mesh)
-    else:
-        named = {f"c{i}": np.asarray(c) for i, c in enumerate(columns)}
-        blk = block_lib.from_numpy(named, mesh)
+    try:
+        if len(columns) == 1:
+            blk = block_lib.single_column(columns[0], mesh)
+        elif len(columns) == 2:
+            blk = block_lib.pair_block(columns[0], columns[1], mesh)
+        else:
+            named = {f"c{i}": np.asarray(c) for i, c in enumerate(columns)}
+            blk = block_lib.from_numpy(named, mesh)
+    except VegaError as e:
+        log.info("dense_from_numpy fell back to host tier: %s", e)
+        arrays = [np.asarray(c) for c in columns]
+        if len(arrays) == 1:
+            data = arrays[0].tolist()
+        elif len(arrays) == 2:
+            data = list(zip(arrays[0].tolist(), arrays[1].tolist()))
+        else:
+            data = list(zip(*[a.tolist() for a in arrays]))
+        return ctx.parallelize(data, num_partitions)
     return _SourceRDD(ctx, blk)
 
 
@@ -1414,7 +1449,18 @@ def dense_from_columns(ctx, columns: Optional[dict] = None,
                 f"overwrite it — rename one of them"
             )
         named[KEY] = named.pop(key)
-    blk = block_lib.from_numpy(named, mesh_lib.default_mesh())
+    try:
+        blk = block_lib.from_numpy(named, mesh_lib.default_mesh())
+    except VegaError as e:
+        if set(named) == {KEY, VALUE}:
+            # Same dtype degrade as dense_from_numpy: the canonical pair
+            # layout has a host row form, so fall back instead of erroring.
+            log.info("dense_from_columns fell back to host tier: %s", e)
+            return ctx.parallelize(
+                list(zip(np.asarray(named[KEY]).tolist(),
+                         np.asarray(named[VALUE]).tolist()))
+            )
+        raise  # named/multi-column blocks: documented crisp-error exception
     return _SourceRDD(ctx, blk)
 
 
@@ -2082,6 +2128,79 @@ class _SortByKeyRDD(_ExchangeRDD):
         )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
+                     capacity=out_cap, mesh=self.mesh)
+
+
+class _CartesianDenseRDD(DenseRDD):
+    """Device cross product: right side replicated, each shard
+    ragged-expands its left rows against all right rows (m = rtotal per
+    valid left row -> ragged_expand slot ownership). Parents materialize
+    at construction: the product-size budget gate needs real counts, and
+    an over-budget product must fall back to the host tier's lazy
+    cartesian BEFORE a node type is fixed."""
+
+    def __init__(self, left: DenseRDD, right: DenseRDD, budget: int):
+        lblk = left.block()
+        rblk = right.block()
+        r_total = rblk.num_rows
+        l_counts = np.asarray(jax.device_get(lblk.counts))
+        max_l = int(l_counts.max()) if l_counts.size else 0
+        out_cap = block_lib._round_capacity(max(max_l * max(r_total, 1), 1))
+        row_bytes = sum(c.dtype.itemsize for c in lblk.cols.values()) + \
+            sum(c.dtype.itemsize for c in rblk.cols.values())
+        if out_cap * row_bytes * 3 > budget:
+            raise _NotTraceable(
+                f"cartesian product (~{out_cap} rows/shard) exceeds the "
+                "HBM budget — host tier streams it lazily instead"
+            )
+        super().__init__(left.context, left.mesh, [left, right])
+        self.left = left
+        self.right = right
+        self._r_total = r_total
+        self._out_cap = out_cap
+
+    def _schema(self):
+        # Canonical (KEY, VALUE) so the product is a pair RDD on BOTH
+        # tiers: host cartesian's (x, y) tuples are pairs, and the dense
+        # result must accept the same downstream pair ops.
+        ldt = dict(self.left._schema())[VALUE]
+        rdt = dict(self.right._schema())[VALUE]
+        return ((KEY, ldt), (VALUE, rdt))
+
+    def _materialize(self) -> Block:
+        lblk = self.left.block()
+        rblk = self.right.block()
+        n = self.mesh.size
+        r_total, out_cap = self._r_total, self._out_cap
+        if r_total == 0:
+            # Empty right side: the product is empty; build it directly
+            # (a zero-length replicated operand cannot be gathered from).
+            schema = dict(self._schema())
+            return block_lib.from_numpy(
+                {KEY: np.zeros(0, schema[KEY]),
+                 VALUE: np.zeros(0, schema[VALUE])},
+                self.mesh,
+            )
+        rvals_host = rblk.to_numpy()[VALUE]
+        rvals = jax.device_put(rvals_host,
+                               mesh_lib.replicated_spec(self.mesh))
+
+        def prog_fn(rv, counts, lvals):
+            cap = lvals.shape[0]
+            m = jnp.where(kernels.valid_mask(cap, counts[0]),
+                          jnp.int32(r_total), 0)
+            owner, off, total = kernels.ragged_expand(m, out_cap)
+            a = jnp.take(lvals, owner)
+            b = jnp.take(rv, jnp.clip(off, 0, max(r_total - 1, 0)))
+            return total.reshape(1), a, b
+
+        prog = _cached_program(
+            ("cart", self.mesh, n, lblk.capacity, r_total, out_cap),
+            lambda: _shard_program(self.mesh, prog_fn,
+                                   (_REPL, _SPEC, _SPEC), (_SPEC,) * 3),
+        )
+        counts, a, b = prog(rvals, lblk.counts, lblk.cols[VALUE])
+        return Block(cols={KEY: a, VALUE: b}, counts=counts,
                      capacity=out_cap, mesh=self.mesh)
 
 
