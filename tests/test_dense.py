@@ -960,3 +960,41 @@ def test_dense_from_columns_int64_fallback(dctx):
         2**40: 3, 1: 3}
     with pytest.raises(v.VegaError):
         dctx.dense_from_columns({"k": [2**40], "x": [1], "y": [2]}, key="k")
+
+
+def test_dense_intersection_subtract(dctx):
+    """Set ops compose on device and match the host tier exactly."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    a_vals = [1, 2, 2, 3, 5, 8, 8, 13]
+    b_vals = [2, 3, 21, 34]
+    a = dctx.dense_from_numpy(np.array(a_vals, dtype=np.int32))
+    b = dctx.dense_from_numpy(np.array(b_vals, dtype=np.int32))
+
+    inter = a.intersection(b)
+    assert isinstance(inter, DenseRDD)
+    assert sorted(inter.collect()) == [2, 3]
+
+    sub = a.subtract(b)
+    assert isinstance(sub, DenseRDD)
+    assert sorted(sub.collect()) == [1, 5, 8, 8, 13]  # dups preserved
+
+    host_a = dctx.parallelize(a_vals, 3)
+    host_b = dctx.parallelize(b_vals, 2)
+    assert sorted(inter.collect()) == sorted(host_a.intersection(host_b).collect())
+    assert sorted(sub.collect()) == sorted(host_a.subtract(host_b).collect())
+
+
+def test_dense_set_ops_dtype_mismatch_falls_back(dctx):
+    """int32 vs float32 operands hash differently on device but compare
+    equal on the host — mismatched dtypes must take the host path."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    a = dctx.dense_from_numpy(np.array([1, 2, 3, 100], dtype=np.int32))
+    b = dctx.dense_from_numpy(np.array([2.0, 3.0, 7.0], dtype=np.float32))
+    inter = a.intersection(b)
+    assert not isinstance(inter, DenseRDD)
+    assert sorted(inter.collect()) == [2, 3]
+    sub = a.subtract(b)
+    assert not isinstance(sub, DenseRDD)
+    assert sorted(sub.collect()) == [1, 100]

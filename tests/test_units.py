@@ -280,3 +280,15 @@ def test_coalescer_no_empty_groups_mixed_locality():
     assert len(groups) == 8
     assert all(groups), [len(g) for g in groups]
     assert sorted(p for g in groups for p in g) == list(range(30))
+
+
+def test_hash_equal_keys_hash_equal_across_types():
+    """The hash contract requires equal keys to hash equal: 2 == 2.0 ==
+    np.int64(2) in Python, so they must share a partition — integral
+    floats used to hash their bit pattern and silently split groups."""
+    assert hash_key(2) == hash_key(2.0) == hash_key(np.float64(2.0))
+    assert hash_key(0) == hash_key(-0.0) == hash_key(0.0)
+    assert hash_key(True) == hash_key(1) == hash_key(1.0)
+    # non-integral floats keep bit-pattern hashing (stable across np/py)
+    assert hash_key(1.5) == hash_key(np.float64(1.5))
+    assert hash_key(2.5) != hash_key(2)

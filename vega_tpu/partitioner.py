@@ -54,8 +54,15 @@ def hash_key(key: Any) -> int:
     if isinstance(key, (int, np.integer)):
         return splitmix64(int(key) & _MASK)
     if isinstance(key, (float, np.floating)):
-        # Hash the bit pattern, not the float, for exact CPU/TPU agreement.
-        return splitmix64(struct.unpack("<Q", struct.pack("<d", float(key)))[0])
+        f = float(key)
+        # Equal keys MUST hash equal: 2.0 == 2 in Python, so integral
+        # floats hash like their integer value (as Python's own hash()
+        # does) — otherwise mixed int/float keys silently split groups
+        # across partitions. Also canonicalizes -0.0 == 0. Non-integral
+        # floats hash their bit pattern (equal ones are bit-identical).
+        if f.is_integer() and -2.0**63 <= f < 2.0**63:
+            return splitmix64(int(f) & _MASK)
+        return splitmix64(struct.unpack("<Q", struct.pack("<d", f))[0])
     if isinstance(key, str):
         key = key.encode("utf-8")
     if isinstance(key, bytes):

@@ -531,6 +531,49 @@ class DenseRDD(RDD):
         keyed = _MapRDD(self, lambda v: (v, jnp.int32(0)))
         return _ReduceByKeyRDD(keyed, op="min", func=None).keys_dense()
 
+    def _dense_set_op_ok(self, other) -> bool:
+        """Device set ops need value RDDs on one mesh with MATCHING value
+        dtypes: an int32 2 and a float32 2.0 hash to different buckets on
+        device but compare equal on the host, so mismatched dtypes must
+        take the host path (Python equality semantics), never silently
+        miss matches."""
+        return (isinstance(other, DenseRDD) and other.mesh == self.mesh
+                and not self.is_pair and not other.is_pair
+                and dict(self._schema())[VALUE]
+                == dict(other._schema())[VALUE])
+
+    def intersection(self, other, num_partitions=None):
+        """Device set intersection of value RDDs: each side dedups
+        through a keyed reduce (output hash-placed and key-sorted, so the
+        join elides BOTH exchanges and sorts), then keeps the joined keys
+        (reference semantics: rdd.rs:831-841, deduplicated)."""
+        if self._dense_set_op_ok(other):
+            a = _ReduceByKeyRDD(_MapRDD(self, lambda v: (v, jnp.int32(0))),
+                                op="min", func=None)
+            b = _ReduceByKeyRDD(_MapRDD(other, lambda v: (v, jnp.int32(0))),
+                                op="min", func=None)
+            return _JoinRDD(a, b).keys_dense()
+        return RDD.intersection(self, other, num_partitions)
+
+    def subtract(self, other, num_partitions=None):
+        """Device set subtraction: keep self's elements (duplicates
+        included) whose value never appears in `other` — a left outer
+        join against other's deduped values with an unambiguous marker
+        (right values are all 1; fill is 0), filtered on the device.
+        The marks side is a reduce output, so its exchange elides
+        (reference semantics: rdd.rs:843-870)."""
+        if self._dense_set_op_ok(other):
+            keyed = _MapRDD(self, lambda v: (v, jnp.int32(1)))
+            marks = _ReduceByKeyRDD(
+                _MapRDD(other, lambda v: (v, jnp.int32(1))),
+                op="min", func=None,
+            )
+            joined = _JoinRDD(keyed, marks, outer=True, fill_value=0)
+            return joined.select(KEY, "rv").filter(
+                lambda row: row[1] == 0
+            ).keys_dense()
+        return RDD.subtract(self, other, num_partitions)
+
     def keys_dense(self):
         return _ProjectRDD(self, KEY)
 
