@@ -151,3 +151,30 @@ def test_random_elided_chain_parity(ctx, seed):
         host[x % n_keys] = host.get(x % n_keys, 0) + x
     host = {k: s % 10_007 for k, s in host.items()}
     assert dev == host
+
+
+@pytest.mark.parametrize("seed", [19, 20])
+def test_random_set_ops_parity(ctx, seed):
+    """Device intersection/subtract == host tier on random multisets."""
+    rng = np.random.RandomState(seed)
+    a = rng.randint(0, 400, int(rng.randint(10, 5_000))).astype(np.int32)
+    b = rng.randint(200, 600, int(rng.randint(10, 2_000))).astype(np.int32)
+    da, db = ctx.dense_from_numpy(a), ctx.dense_from_numpy(b)
+    ha = ctx.parallelize(a.tolist(), 4)
+    hb = ctx.parallelize(b.tolist(), 4)
+    assert sorted(da.intersection(db).collect()) == \
+        sorted(ha.intersection(hb).collect())
+    assert sorted(da.subtract(db).collect()) == \
+        sorted(ha.subtract(hb).collect())
+
+
+@pytest.mark.parametrize("seed", [21])
+def test_random_cartesian_parity(ctx, seed):
+    rng = np.random.RandomState(seed)
+    a = rng.randint(0, 1000, 400).astype(np.int32)
+    b = rng.randint(0, 1000, 9).astype(np.int32)
+    dev = sorted(ctx.dense_from_numpy(a).cartesian(
+        ctx.dense_from_numpy(b)).collect())
+    host = sorted(ctx.parallelize(a.tolist(), 4).cartesian(
+        ctx.parallelize(b.tolist(), 2)).collect())
+    assert dev == host
