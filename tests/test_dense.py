@@ -461,24 +461,178 @@ def test_dense_left_outer_join(dctx):
                   (3, (30, 0)), (4, (40, 0))]
 
 
-def test_dense_int64_out_of_range_falls_back_to_host(dctx):
-    """int64 data the device cannot hold faithfully degrades to the host
-    tier (exact int64 semantics preserved) instead of erroring — the
-    two-tier contract applied to dtypes."""
+def test_dense_int64_values_fall_back_keys_stay_dense(dctx):
+    """int64 VALUES beyond int32 range degrade to the host tier (device
+    arithmetic would wrap); int64 KEYS beyond int32 range stay dense via
+    the (k, k.lo) two-column encoding — keys are only hashed/compared,
+    never summed. In-range int64 narrows and stays dense."""
+    from vega_tpu.tpu.block import KEY_LO
     from vega_tpu.tpu.dense_rdd import DenseRDD
 
-    big = dctx.dense_from_numpy(
-        np.array([2**40, 1, 2**40], dtype=np.int64),
-        np.array([1, 2, 3], dtype=np.int64),
+    big_vals = dctx.dense_from_numpy(
+        np.array([1, 2, 1], dtype=np.int64),
+        np.array([2**40, 2, 3], dtype=np.int64),
     )
-    assert not isinstance(big, DenseRDD)
-    got = dict(big.reduce_by_key(lambda a, b: a + b, 2).collect())
-    assert got == {2**40: 4, 1: 2}  # exact int64 keys and sums
-    # in-range int64 narrows safely and stays dense
+    assert not isinstance(big_vals, DenseRDD)
+    got = dict(big_vals.reduce_by_key(lambda a, b: a + b, 2).collect())
+    assert got == {1: 2**40 + 3, 2: 2}  # exact int64 sums
+    # int64 keys beyond int32 range: composite encoding, still a DenseRDD
+    big_keys = dctx.dense_from_numpy(
+        np.array([2**40, 1, 2**40], dtype=np.int64),
+        np.array([1, 2, 3], dtype=np.int32),
+    )
+    assert isinstance(big_keys, DenseRDD)
+    assert KEY_LO in big_keys.columns
+    got = dict(big_keys.reduce_by_key(op="add").collect())
+    assert got == {2**40: 4, 1: 2}  # exact int64 keys
+    # in-range int64 narrows safely and stays dense (single-column key)
     r = dctx.dense_from_numpy(np.array([5, 6], dtype=np.int64),
                               np.array([50, 60], dtype=np.int64))
     assert isinstance(r, DenseRDD)
+    assert KEY_LO not in r.columns
     assert sorted(r.collect()) == [(5, 50), (6, 60)]
+
+
+def _i64_fixture(seed=0, n=3000):
+    rng = np.random.RandomState(seed)
+    keys = (rng.randint(-5, 5, size=n).astype(np.int64) * 3_000_000_000
+            + rng.randint(0, 3, size=n))
+    vals = rng.randint(0, 1000, size=n).astype(np.int32)
+    return keys, vals
+
+
+def test_dense_int64_key_roundtrip_and_encoding(dctx):
+    """encode/decode is exact and order-preserving at the numpy level and
+    through a block round trip."""
+    from vega_tpu.tpu import block as block_lib
+
+    edge = np.array([-2**63, -2**32 - 1, -2**32, -1, 0, 1, 2**31,
+                     2**32, 2**40 + 7, 2**63 - 1], dtype=np.int64)
+    hi, lo = block_lib.encode_i64(edge)
+    assert hi.dtype == np.int32 and lo.dtype == np.int32
+    np.testing.assert_array_equal(block_lib.decode_i64(hi, lo), edge)
+    # lexicographic (hi, lo-signed) order == int64 order
+    order = np.lexsort((lo, hi))
+    np.testing.assert_array_equal(edge[order], np.sort(edge))
+
+    keys, vals = _i64_fixture()
+    d = dctx.dense_from_numpy(keys, vals)
+    got = d.collect()
+    np.testing.assert_array_equal(
+        np.array([k for k, _ in got], np.int64), keys
+    )
+
+
+def test_dense_int64_key_reduce_group_parity(dctx):
+    keys, vals = _i64_fixture(1)
+    d = dctx.dense_from_numpy(keys, vals)
+    host = host_expected_reduce_by_key(
+        zip(keys.tolist(), vals.tolist()), lambda a, b: a + b
+    )
+    assert dict(d.reduce_by_key(op="add").collect()) == host
+    grouped = {k: sorted(vs) for k, vs in d.group_by_key().collect()}
+    hostg = {}
+    for k, x in zip(keys.tolist(), vals.tolist()):
+        hostg.setdefault(k, []).append(x)
+    assert grouped == {k: sorted(vs) for k, vs in hostg.items()}
+
+
+def test_dense_int64_key_join_and_sort_parity(dctx):
+    keys, vals = _i64_fixture(2, n=2000)
+    d = dctx.dense_from_numpy(keys, vals)
+    reduced = d.reduce_by_key(op="add")
+    host = host_expected_reduce_by_key(
+        zip(keys.tolist(), vals.tolist()), lambda a, b: a + b
+    )
+    table_keys = np.unique(keys)[::2]
+    table = dctx.dense_from_numpy(
+        table_keys, np.arange(len(table_keys), dtype=np.int32)
+    )
+    got = sorted(reduced.join(table).collect())
+    exp = sorted(
+        (int(k), (host[int(k)], i)) for i, k in enumerate(table_keys)
+    )
+    assert got == exp
+    # sample sort over int64 keys, both directions
+    s = d.sort_by_key()
+    assert [k for k, _ in s.collect()] == sorted(keys.tolist())
+    s_desc = d.sort_by_key(ascending=False)
+    assert [k for k, _ in s_desc.collect()] == sorted(keys.tolist(),
+                                                      reverse=True)
+
+
+def test_dense_int64_key_mixed_width_join_widens(dctx):
+    """Joining an int64-keyed side with an int32-keyed side widens the
+    narrow side on device (same logical key -> same shard); float keys
+    against int64 keys take the host path (Python equality semantics)."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD, _JoinRDD
+
+    fact = dctx.dense_from_numpy(
+        np.array([0, -7, 2**40, 2**40], dtype=np.int64),
+        np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32),
+    )
+    t32 = dctx.dense_from_numpy(
+        np.array([0, -7, 9], dtype=np.int32),
+        np.array([10.0, 20.0, 90.0], dtype=np.float32),
+    )
+    j = fact.join(t32)
+    assert isinstance(j, _JoinRDD)
+    assert sorted(j.collect()) == [(-7, (2.0, 20.0)), (0, (1.0, 10.0))]
+    # reversed orientation widens the other side
+    j2 = t32.join(fact)
+    assert isinstance(j2, _JoinRDD)
+    assert sorted(j2.collect()) == [(-7, (20.0, 2.0)), (0, (10.0, 1.0))]
+    # float-keyed side cannot widen: host path, still correct
+    tf = dctx.dense_from_numpy(np.array([0.0, 2.0], dtype=np.float32),
+                               np.array([5.0, 6.0], dtype=np.float32))
+    j3 = fact.join(tf)
+    assert not isinstance(j3, DenseRDD)
+    assert sorted(j3.collect()) == [(0, (1.0, 5.0))]
+
+
+def test_dense_int64_key_cogroup_and_outer_join(dctx):
+    fact = dctx.dense_from_numpy(
+        np.array([2**40, 2**40, 5], dtype=np.int64),
+        np.array([1, 2, 3], dtype=np.int32),
+    )
+    other = dctx.dense_from_numpy(
+        np.array([2**40, -2**40], dtype=np.int64),
+        np.array([7, 8], dtype=np.int32),
+    )
+    cg = dict(fact.cogroup(other).collect())
+    assert cg[2**40] == ([1, 2], [7])
+    assert cg[5] == ([3], [])
+    assert cg[-2**40] == ([], [8])
+    lo = sorted(fact.left_outer_join(other, fill_value=0).collect())
+    assert lo == [(5, (3, 0)), (2**40, (1, 7)), (2**40, (2, 7))]
+
+
+def test_dense_int64_key_row_closures_fall_back(dctx):
+    """Row-wise closures over int64-keyed blocks have no device form (the
+    int64 scalar is untraceable without x64) — they silently take the host
+    tier with decoded keys; map_values stays on device."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD, _MapValuesRDD
+
+    keys = np.array([2**40, 1, 2**40], dtype=np.int64)
+    d = dctx.dense_from_numpy(keys, np.array([1, 2, 3], dtype=np.int32))
+    m = d.map(lambda kv: (kv[0], kv[1] * 10))
+    assert not isinstance(m, DenseRDD)
+    assert sorted(m.collect()) == [(1, 20), (2**40, 10), (2**40, 30)]
+    mv = d.map_values(lambda x: x * 10)
+    assert isinstance(mv, _MapValuesRDD)
+    assert sorted(mv.collect()) == [(1, 20), (2**40, 10), (2**40, 30)]
+    # keys over the composite block decode on the host tier
+    assert sorted(mv.keys().collect()) == [1, 2**40, 2**40]
+
+
+def test_dense_int64_key_save_load_npz(dctx, tmp_path):
+    keys, vals = _i64_fixture(3, n=500)
+    d = dctx.dense_from_numpy(keys, vals)
+    p = str(tmp_path / "i64.npz")
+    d.save_npz(p)
+    loaded = dctx.dense_load_npz(p)
+    assert sorted(loaded.collect()) == sorted(zip(keys.tolist(),
+                                                  vals.tolist()))
 
 
 def test_histogram_sizing_no_retries_under_skew(ctx):
@@ -948,18 +1102,28 @@ def test_dense_cartesian_parity_and_budget_gate(dctx):
     assert empty.count() == 0
 
 
-def test_dense_from_columns_int64_fallback(dctx):
-    """The canonical (key, value) from_columns face degrades like
-    dense_from_numpy; named/multi-column blocks keep the crisp error."""
+def test_dense_from_columns_int64_keys_stay_dense(dctx):
+    """int64 KEYS stay on device via the two-column encoding — both the
+    canonical (key, value) face and named/multi-column blocks; int64
+    VALUES on named blocks keep the crisp error (no host row form)."""
     from vega_tpu.tpu.dense_rdd import DenseRDD
 
     r = dctx.dense_from_columns({"k": [2**40, 2**40, 1], "v": [1, 2, 3]},
                                 key="k")
-    assert not isinstance(r, DenseRDD)
-    assert dict(r.reduce_by_key(lambda a, b: a + b, 2).collect()) == {
-        2**40: 3, 1: 3}
+    assert isinstance(r, DenseRDD)
+    assert dict(r.reduce_by_key(op="add").collect()) == {2**40: 3, 1: 3}
+    multi = dctx.dense_from_columns({"k": [2**40, 1], "x": [1, 2],
+                                     "y": [2, 4]}, key="k")
+    assert isinstance(multi, DenseRDD)
+    got = multi.reduce_by_key(op="add")
+    arrays = got.collect_arrays()
+    by_key = dict(zip(arrays["k"].tolist(),
+                      zip(arrays["x"].tolist(), arrays["y"].tolist())))
+    assert by_key == {2**40: (1, 2), 1: (2, 4)}
     with pytest.raises(v.VegaError):
-        dctx.dense_from_columns({"k": [2**40], "x": [1], "y": [2]}, key="k")
+        # int64 VALUE column on a named block: crisp error, never silent
+        dctx.dense_from_columns({"k": [1], "x": [2**40], "y": [2]},
+                                key="k")
 
 
 def test_dense_intersection_subtract(dctx):
@@ -998,3 +1162,13 @@ def test_dense_set_ops_dtype_mismatch_falls_back(dctx):
     sub = a.subtract(b)
     assert not isinstance(sub, DenseRDD)
     assert sorted(sub.collect()) == [1, 100]
+
+
+def test_dense_from_columns_rejects_reserved_lo_name(dctx):
+    """A user column named 'k.lo' would be silently consumed as the low
+    word of a composite key — reject it crisply."""
+    with pytest.raises(v.VegaError):
+        dctx.dense_from_columns(
+            {"k": np.array([1, 2], np.int32),
+             "k.lo": np.array([5, 6], np.int32)}, key="k",
+        )

@@ -216,3 +216,26 @@ def test_streamed_join_and_expansions(ctx):
         lambda x: jnp.stack([x, x]), 2)
     assert isinstance(me, StreamedDenseRDD)
     assert me.count() == 20_000
+
+
+def test_streamed_npz_int64_keys_consistent_chunks(ctx, tmp_path):
+    """An int64 key column encodes ONCE over the full array, so chunks
+    whose local keys happen to fit int32 still get the same (k, k.lo)
+    schema as chunks whose keys don't — the accumulator union requires
+    every chunk block to agree."""
+    import numpy as np
+
+    from vega_tpu.tpu.stream import streamed_npz
+
+    # first half small keys (fit int32), second half huge (composite)
+    keys = np.concatenate([
+        np.arange(0, 500, dtype=np.int64) % 7,
+        (np.arange(0, 500, dtype=np.int64) % 7) + 2**40,
+    ])
+    vals = np.ones(1000, dtype=np.int32)
+    s = streamed_npz(ctx, {"k": keys, "v": vals}, chunk_rows=250)
+    got = dict(s.reduce_by_key(op="add").collect())
+    exp = {}
+    for k in keys.tolist():
+        exp[k] = exp.get(k, 0) + 1
+    assert got == exp

@@ -156,3 +156,36 @@ def test_pregrouped_counts_match_group_by_bucket():
     assert jnp.array_equal(counts_all[:n_shards], counts)
     assert jnp.array_equal(
         (jnp.cumsum(counts_all) - counts_all)[:n_shards], starts)
+
+
+def test_searchsorted2_matches_numpy_lexicographic():
+    """The two-word binary search must agree with numpy searchsorted over
+    the decoded int64 keys, both sides."""
+    from vega_tpu.tpu import block as block_lib
+
+    rng = np.random.RandomState(7)
+    ref = np.sort(rng.randint(-2**62, 2**62, size=257, dtype=np.int64))
+    q = np.concatenate([
+        ref[rng.randint(0, len(ref), size=100)],  # exact hits
+        rng.randint(-2**62, 2**62, size=100, dtype=np.int64),
+    ])
+    rh, rl = block_lib.encode_i64(ref)
+    qh, ql = block_lib.encode_i64(q)
+    for side in ("left", "right"):
+        got = kernels.searchsorted2(
+            jnp.asarray(rh), jnp.asarray(rl),
+            jnp.asarray(qh), jnp.asarray(ql), side,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.searchsorted(ref, q, side=side)
+        )
+
+
+def test_hash32_pair_distributes_over_low_word():
+    """Keys differing only in the low word must spread over buckets (a
+    hi-only hash would put every such key in one bucket)."""
+    hi = jnp.zeros(4096, jnp.int32)
+    lo = jnp.arange(4096, dtype=jnp.int32)
+    buckets = (kernels.hash32_pair(hi, lo) % jnp.uint32(8)).astype(np.int32)
+    counts = np.bincount(np.asarray(buckets), minlength=8)
+    assert counts.min() > 4096 // 8 // 4  # roughly uniform

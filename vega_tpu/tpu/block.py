@@ -23,6 +23,43 @@ from vega_tpu.tpu import mesh as mesh_lib
 
 KEY = "k"  # canonical key column
 VALUE = "v"  # canonical value column
+# Low word of a two-column int64 key. TPUs have no native int64 and jax
+# x64 is off, so an int64 key column beyond int32 range splits into
+# KEY = high 32 bits (signed: preserves order) and KEY_LO = low 32 bits
+# stored sign-bit-flipped (signed compare of the stored word == unsigned
+# compare of the true low word), making lexicographic (KEY, KEY_LO) order
+# equal int64 order. Host-facing reads reassemble the int64 transparently.
+KEY_LO = "k.lo"
+_LO_BIAS = np.uint32(0x80000000)
+
+
+def encode_i64(src: np.ndarray):
+    """int64 column -> (hi int32, biased-lo int32), order-preserving."""
+    a = src.astype(np.int64, copy=False)
+    hi = (a >> 32).astype(np.int32)
+    lo = ((a & np.int64(0xFFFFFFFF)).astype(np.uint32)
+          ^ _LO_BIAS).view(np.int32)
+    return hi, lo
+
+
+def decode_i64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Inverse of encode_i64."""
+    lo_u = (np.asarray(lo).view(np.uint32) ^ _LO_BIAS).astype(np.int64)
+    return (np.asarray(hi).astype(np.int64) << 32) | lo_u
+
+
+def _decode_key_cols(cols: dict) -> dict:
+    """Reassemble a (KEY, KEY_LO) pair into one int64 KEY column for
+    host-facing reads; other columns pass through (order preserved)."""
+    if KEY_LO not in cols:
+        return cols
+    out = {}
+    for name, col in cols.items():
+        if name == KEY:
+            out[KEY] = decode_i64(col, cols[KEY_LO])
+        elif name != KEY_LO:
+            out[name] = col
+    return out
 
 
 @dataclasses.dataclass
@@ -54,7 +91,9 @@ class Block:
                    for c in self.cols.values())
 
     def to_numpy(self) -> Dict[str, np.ndarray]:
-        """Gather valid rows to host, shard order preserved."""
+        """Gather valid rows to host, shard order preserved. Two-column
+        int64 keys (KEY_LO) come back as one int64 KEY column — host-facing
+        consumers never see the encoding."""
         counts = np.asarray(jax.device_get(self.counts))
         host_cols = {name: np.asarray(jax.device_get(col))
                      for name, col in self.cols.items()}
@@ -64,17 +103,18 @@ class Block:
             c = int(counts[s])
             for name in self.cols:
                 out[name].append(host_cols[name][lo:lo + c])
-        return {n: np.concatenate(parts) if parts else np.empty((0,))
-                for n, parts in out.items()}
+        gathered = {n: np.concatenate(parts) if parts else np.empty((0,))
+                    for n, parts in out.items()}
+        return _decode_key_cols(gathered)
 
     def shard_rows(self, shard: int) -> Dict[str, np.ndarray]:
         counts = np.asarray(jax.device_get(self.counts))
         lo = shard * self.capacity
         c = int(counts[shard])
-        return {
+        return _decode_key_cols({
             name: np.asarray(jax.device_get(col[lo:lo + c]))
             for name, col in self.cols.items()
-        }
+        })
 
 
 def _round_capacity(c: int) -> int:
@@ -121,11 +161,60 @@ def _check_dtype(name: str, src: np.ndarray) -> np.ndarray:
     return src
 
 
+def encode_key_columns(columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Split an int64 KEY column that does not fit int32 into the
+    (KEY, KEY_LO) two-column encoding; in-range integer keys keep the
+    cheaper single-column narrow path (block._check_dtype). Idempotent —
+    already-encoded columns pass through (the streamed source pre-encodes
+    on the FULL column so every chunk gets the same schema regardless of
+    its local key range)."""
+    if KEY_LO in columns:
+        if KEY not in columns or \
+                np.asarray(columns[KEY_LO]).dtype != np.int32:
+            from vega_tpu.errors import VegaError
+
+            raise VegaError(
+                f"column name {KEY_LO!r} is reserved for the low word of "
+                "two-column int64 keys"
+            )
+        return columns
+    src = columns.get(KEY)
+    if src is None:
+        return columns
+    src = np.asarray(src)
+    if src.dtype not in (np.int64, np.uint64):
+        return columns
+    if len(src) == 0:
+        return columns
+    if src.dtype == np.uint64 and src.max() > np.uint64(2**63 - 1):
+        from vega_tpu.errors import VegaError
+
+        raise VegaError(
+            "uint64 keys beyond int64 range are not representable on "
+            "device — use the host tier for this data"
+        )
+    info = np.iinfo(np.int32)
+    if info.min <= src.min() and src.max() <= info.max:
+        return columns  # fits int32; _check_dtype narrows it
+    hi, lo = encode_i64(src)
+    out: Dict[str, np.ndarray] = {}
+    for name, col in columns.items():
+        if name == KEY:
+            out[KEY] = hi
+            out[KEY_LO] = lo
+        else:
+            out[name] = col
+    return out
+
+
 def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
                capacity: Optional[int] = None) -> Block:
-    """Build a row-sharded Block from host columns (equal lengths)."""
+    """Build a row-sharded Block from host columns (equal lengths). int64
+    KEY columns beyond int32 range are transparently stored as the
+    (KEY, KEY_LO) two-column encoding (see KEY_LO above)."""
     mesh = mesh or mesh_lib.default_mesh()
     n_shards = mesh.size
+    columns = encode_key_columns(dict(columns))
     names = list(columns)
     n = len(columns[names[0]]) if names else 0
     per = -(-n // n_shards) if n else 0

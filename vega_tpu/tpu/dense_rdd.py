@@ -45,7 +45,7 @@ from vega_tpu.tpu import block as block_lib
 from vega_tpu.tpu import kernels
 from vega_tpu.tpu import pallas_kernels
 from vega_tpu.tpu import mesh as mesh_lib
-from vega_tpu.tpu.block import KEY, VALUE, Block
+from vega_tpu.tpu.block import KEY, KEY_LO, VALUE, Block
 
 log = logging.getLogger("vega_tpu")
 
@@ -231,11 +231,20 @@ class DenseRDD(RDD):
         return [n for n, _ in self._schema()]
 
     def select(self, *names: str) -> "DenseRDD":
-        """Project a subset of columns (narrow, fused)."""
+        """Project a subset of columns (narrow, fused). Selecting the key
+        of an int64-keyed block implicitly keeps its low-word column
+        (KEY_LO) — the two columns are one logical key."""
         schema = dict(self._schema())
         for n in names:
             if n not in schema:
                 raise VegaError(f"no such column: {n!r}")
+        if KEY in names and KEY_LO in schema and KEY_LO not in names:
+            expanded = []
+            for n in names:
+                expanded.append(n)
+                if n == KEY:
+                    expanded.append(KEY_LO)
+            names = tuple(expanded)
         return _SelectRDD(self, names)
 
     def to_rdd(self) -> RDD:
@@ -330,7 +339,8 @@ class DenseRDD(RDD):
     def map_values(self, f: Callable):
         if not self.is_pair:
             raise VegaError("map_values on non-pair DenseRDD")
-        value_names = [nm for nm, _ in self._schema() if nm != KEY]
+        value_names = [nm for nm, _ in self._schema()
+                       if nm not in (KEY, KEY_LO)]
         if len(value_names) != 1:
             raise VegaError(
                 "map_values needs exactly one value column (have "
@@ -443,7 +453,9 @@ class DenseRDD(RDD):
         the host cogroup-based join only when `other` is not dense or an
         explicit partitioner is requested."""
         if self._dense_joinable(other, partitioner_or_num):
-            return _with_exchange(_JoinRDD(self, other), exchange)
+            pair = _align_keys(self, other)
+            if pair is not None:
+                return _with_exchange(_JoinRDD(*pair), exchange)
         return super().join(other, partitioner_or_num)
 
     def _dense_joinable(self, other, partitioner_or_num) -> bool:
@@ -463,10 +475,12 @@ class DenseRDD(RDD):
         fill_value so results don't depend on which path ran."""
         if fill_value is not None and \
                 self._dense_joinable(other, partitioner_or_num):
-            return _with_exchange(
-                _JoinRDD(self, other, outer=True, fill_value=fill_value),
-                exchange,
-            )
+            pair = _align_keys(self, other)
+            if pair is not None:
+                return _with_exchange(
+                    _JoinRDD(*pair, outer=True, fill_value=fill_value),
+                    exchange,
+                )
         if fill_value is None:
             # Host None semantics (a dense column can't hold None).
             return super().left_outer_join(other, partitioner_or_num)
@@ -493,7 +507,10 @@ class DenseRDD(RDD):
             # An explicit partitioner request or a mesh mismatch must honor
             # host-path semantics (and mismatched meshes would pair
             # unrelated shards) — those fall through to the host cogroup.
-            return _DenseCoGroupRDD(self, others[0])
+            # Key widths/dtypes must align so co-keyed rows share a shard.
+            pair = _align_keys(self, others[0])
+            if pair is not None:
+                return _DenseCoGroupRDD(*pair)
         return super().cogroup(*others, partitioner_or_num=partitioner_or_num)
 
     def cartesian(self, other):
@@ -575,6 +592,10 @@ class DenseRDD(RDD):
         return RDD.subtract(self, other, num_partitions)
 
     def keys_dense(self):
+        if KEY_LO in dict(self._schema()):
+            # int64 keys cannot live in a single device value column;
+            # hand off to the host tier (decoded rows).
+            return self.to_rdd().map(lambda kv: kv[0])
         return _ProjectRDD(self, KEY)
 
     def values_dense(self):
@@ -895,6 +916,11 @@ class _NotTraceable(Exception):
 def _row_struct(schema):
     """Abstract per-row value for tracing: scalar v, or (k, v) pair."""
     cols = dict(schema)
+    if KEY_LO in cols:
+        # Two-column int64 keys have no device row form (the int64 scalar
+        # cannot be traced without x64); row-wise closures take the host
+        # tier, which sees the reassembled int64 keys.
+        raise _NotTraceable("int64 keys: no device row form")
     if set(cols) == {KEY, VALUE}:
         return (jax.ShapeDtypeStruct((), cols[KEY]),
                 jax.ShapeDtypeStruct((), cols[VALUE]))
@@ -1034,7 +1060,7 @@ class _MapValuesRDD(_NarrowRDD):
         pschema = dict(parent._schema())
         # The single value column, whatever its name (canonical 'v' or a
         # named column from dense_from_columns).
-        self._vname = next(nm for nm in pschema if nm != KEY)
+        self._vname = next(nm for nm in pschema if nm not in (KEY, KEY_LO))
         try:
             out = jax.eval_shape(
                 f, jax.ShapeDtypeStruct((), pschema[self._vname])
@@ -1043,15 +1069,19 @@ class _MapValuesRDD(_NarrowRDD):
             raise _NotTraceable(str(e)) from e
         if not hasattr(out, "shape") or out.shape != ():
             raise _NotTraceable("map_values fn must return a scalar")
-        super().__init__(
-            parent, ((KEY, pschema[KEY]), (self._vname, out.dtype))
-        )
+        key_schema = ((KEY, pschema[KEY]),)
+        if KEY_LO in pschema:
+            key_schema += ((KEY_LO, pschema[KEY_LO]),)
+        super().__init__(parent, key_schema + ((self._vname, out.dtype),))
         self._f = f
         self._user_fn = f
 
     def _shard_fn(self, cols, count):
-        return {KEY: cols[KEY],
-                self._vname: jax.vmap(self._f)(cols[self._vname])}, count
+        out = {KEY: cols[KEY],
+               self._vname: jax.vmap(self._f)(cols[self._vname])}
+        if KEY_LO in cols:
+            out[KEY_LO] = cols[KEY_LO]
+        return out, count
 
     @property
     def hash_placed(self) -> bool:
@@ -1344,6 +1374,61 @@ class _SelectRDD(_NarrowRDD):
         return KEY in self._names and self.parent.key_sorted
 
 
+class _WidenKeyRDD(_NarrowRDD):
+    """Re-encode an int32 KEY as the (hi, lo) two-column int64 encoding so
+    the side can join/cogroup an int64-keyed block (same logical keys ->
+    same bucket under the composite hash). hash_placed intentionally resets
+    (default False): placement under the single-key hash says nothing
+    about placement under the composite hash."""
+
+    def __init__(self, parent: DenseRDD):
+        out = []
+        for nm, dt in parent._schema():
+            if nm == KEY:
+                out.append((KEY, jnp.int32))
+                out.append((KEY_LO, jnp.int32))
+            else:
+                out.append((nm, dt))
+        super().__init__(parent, tuple(out))
+        self._user_fn = "widen_key"
+
+    def _shard_fn(self, cols, count):
+        k = cols[KEY]
+        # hi = sign word (== int64(k) >> 32); lo = bits of k with the sign
+        # bit flipped (signed compare == unsigned compare of true low word)
+        # — identical to block.encode_i64 on the host.
+        hi = k >> jnp.int32(31)
+        lo = lax.bitcast_convert_type(
+            lax.bitcast_convert_type(k, jnp.uint32) ^ jnp.uint32(0x80000000),
+            jnp.int32,
+        )
+        out = {KEY: hi, KEY_LO: lo}
+        for nm, c in cols.items():
+            if nm != KEY:
+                out[nm] = c
+        return out, count
+
+
+def _align_keys(a: DenseRDD, b: DenseRDD):
+    """Make two dense pair sides key-compatible for device matching
+    (join/cogroup): equal logical keys must hash to the same shard and
+    compare equal in the merge kernel. Returns the (possibly widened)
+    sides, or None when only the host tier can match them faithfully
+    (mismatched key dtypes — e.g. int32 2 vs float32 2.0 hash apart on
+    device but compare equal under Python semantics)."""
+    sa, sb = dict(a._schema()), dict(b._schema())
+    wide_a, wide_b = KEY_LO in sa, KEY_LO in sb
+    if wide_a == wide_b:
+        if jnp.dtype(sa[KEY]) == jnp.dtype(sb[KEY]):
+            return a, b
+        return None
+    narrow = b if wide_a else a
+    if jnp.dtype(dict(narrow._schema())[KEY]) != jnp.dtype(jnp.int32):
+        return None
+    widened = _WidenKeyRDD(narrow)
+    return (a, widened) if wide_a else (widened, b)
+
+
 class _ProjectRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, col: str):
         pschema = dict(parent._schema())
@@ -1479,6 +1564,14 @@ def dense_from_columns(ctx, columns: Optional[dict] = None,
         for name, col in source.items():
             if name in named:
                 raise VegaError(f"duplicate column {name!r}")
+            if name == KEY_LO:
+                # Reserved for the low word of two-column int64 keys: a
+                # user column with this name would be silently consumed
+                # as key bits (wrong int64 keys, vanished data).
+                raise VegaError(
+                    f"column name {KEY_LO!r} is reserved for the low word "
+                    "of int64 keys — rename the column"
+                )
             named[name] = np.asarray(col)
     lengths = {name: len(col) for name, col in named.items()}
     if len(set(lengths.values())) > 1:
@@ -1608,6 +1701,22 @@ def _get_exchange(mode: str):
     return kernels.bucket_exchange
 
 
+def _lo_of(names) -> Optional[str]:
+    """KEY_LO when the schema carries a two-column int64 key, else None —
+    the switch every keyed device kernel takes."""
+    return KEY_LO if KEY_LO in names else None
+
+
+def _bucket_cols(cols, n: int) -> jax.Array:
+    """Hash-bucket rows by key, two-column int64 keys included. The
+    composite hash mixes BOTH words (hash32_pair) so placement keeps its
+    contract: equal int64 keys — and only those — share a bucket."""
+    if KEY_LO in cols:
+        return (kernels.hash32_pair(cols[KEY], cols[KEY_LO])
+                % jnp.uint32(n)).astype(jnp.int32)
+    return pallas_kernels.hash_bucket(cols[KEY], n)
+
+
 class _ExchangeRDD(DenseRDD):
     """Common driver loop: run the fused exchange program, check overflow
     flags, retry with grown capacities (capacity-factor pattern). The
@@ -1635,39 +1744,59 @@ class _ExchangeRDD(DenseRDD):
         n = self.mesh.size
         if n == 1:
             return None
+        composite = KEY_LO in blk.cols
 
-        def prog_fn(counts, keys):
-            cap = keys.shape[0]
-            bucket = pallas_kernels.hash_bucket(keys, n)
+        def prog_fn(counts, *keys):
+            cap = keys[0].shape[0]
+            kcols = {KEY: keys[0]}
+            if composite:
+                kcols[KEY_LO] = keys[1]
+            bucket = _bucket_cols(kcols, n)
             bucket = jnp.where(kernels.valid_mask(cap, counts[0]), bucket, n)
             return jnp.bincount(bucket, length=n + 1)[:n].astype(jnp.int32)
 
         prog = _cached_program(
-            ("hash_hist", self.mesh, n),
-            lambda: _shard_program(self.mesh, prog_fn, 2, _SPEC),
+            ("hash_hist", self.mesh, n, composite),
+            lambda: _shard_program(self.mesh, prog_fn, 2 + composite, _SPEC),
         )
-        out = prog(blk.counts, blk.cols[KEY])
+        key_arrays = [blk.cols[KEY]] + ([blk.cols[KEY_LO]] if composite
+                                        else [])
+        out = prog(blk.counts, *key_arrays)
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
     def _range_histogram(self, blk: Block, bounds_dev,
-                         ascending: bool) -> Optional[np.ndarray]:
-        """Destination histogram under range partitioning (sort_by_key)."""
+                         ascending: bool,
+                         bounds_lo_dev=None) -> Optional[np.ndarray]:
+        """Destination histogram under range partitioning (sort_by_key).
+        bounds_lo_dev carries the low-word bounds of two-column int64
+        keys."""
         n = self.mesh.size
         if n == 1:
             return None
+        composite = bounds_lo_dev is not None
 
-        def prog_fn(bnds, counts, keys):
+        def prog_fn(*args):
+            if composite:
+                bnds, bnds_lo, counts, keys, keys_lo = args
+            else:
+                (bnds, counts, keys), bnds_lo, keys_lo = args, None, None
             cap = keys.shape[0]
-            bucket = kernels.range_bucket(bnds, keys, ascending)
+            bucket = kernels.range_bucket(bnds, keys, ascending,
+                                          bounds_lo=bnds_lo,
+                                          keys_lo=keys_lo)
             bucket = jnp.where(kernels.valid_mask(cap, counts[0]), bucket, n)
             return jnp.bincount(bucket, length=n + 1)[:n].astype(jnp.int32)
 
+        in_specs = ((_REPL,) * (1 + composite)
+                    + (_SPEC,) * (2 + composite))
         prog = _cached_program(
-            ("range_hist", self.mesh, n, ascending),
-            lambda: _shard_program(self.mesh, prog_fn,
-                                   (_REPL, _SPEC, _SPEC), _SPEC),
+            ("range_hist", self.mesh, n, ascending, composite),
+            lambda: _shard_program(self.mesh, prog_fn, in_specs, _SPEC),
         )
-        out = prog(bounds_dev, blk.counts, blk.cols[KEY])
+        args = ((bounds_dev,) + ((bounds_lo_dev,) if composite else ())
+                + (blk.counts, blk.cols[KEY])
+                + ((blk.cols[KEY_LO],) if composite else ()))
+        out = prog(*args)
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
     def _run_exchange(self, build_program, counts: np.ndarray,
@@ -1724,7 +1853,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         self.parent = parent
         self._op = op
         pschema = parent._schema()
-        self._value_names = [nm for nm, _ in pschema if nm != KEY]
+        self._value_names = [nm for nm, _ in pschema
+                             if nm not in (KEY, KEY_LO)]
         if func is not None:
             dtypes = dict(pschema)
             structs = [jax.ShapeDtypeStruct((), dtypes[nm])
@@ -1768,9 +1898,11 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         return self.parent._schema()
 
     def _segment_reduce(self, cols, count, presorted):
+        lo_name = _lo_of(cols)
         if self._op is not None:
             return kernels.segment_reduce_named(
-                cols, count, KEY, self._op, presorted=presorted
+                cols, count, KEY, self._op, presorted=presorted,
+                lo_name=lo_name,
             )
         f = self._func
         names = self._value_names
@@ -1786,7 +1918,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 return dict(zip(names, out))
 
         return kernels.segment_reduce_sorted(
-            cols, count, KEY, combine, presorted=presorted
+            cols, count, KEY, combine, presorted=presorted, lo_name=lo_name
         )
 
     def _materialize(self) -> Block:
@@ -1816,17 +1948,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     # group-by-bucket + reduce-side sort.
                     capacity = cols[KEY].shape[0]
                     mask = kernels.valid_mask(capacity, count)
-                    bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                    bucket = _bucket_cols(cols, n)
                     bucket = jnp.where(mask, bucket, n)
                     cols, bucket = kernels.bucket_key_sort(
-                        cols, count, bucket, KEY
+                        cols, count, bucket, KEY, lo_name=_lo_of(cols)
                     )
                     cols, count = self._segment_reduce(cols, count,
                                                        presorted=True)
                     # compact kept (bucket, key) order; re-derive the
                     # combiner rows' buckets from their keys (hash is cheap
                     # and deterministic).
-                    bucket = pallas_kernels.hash_bucket(cols[KEY], n)
+                    bucket = _bucket_cols(cols, n)
                     cols, count, overflow = exchange(
                         cols, count, bucket, n, slot, out_cap,
                         pregrouped=True,
@@ -1906,13 +2038,14 @@ class _GroupByKeyRDD(_ExchangeRDD):
                         cols, count, cols[KEY].shape[0], out_cap
                     )
                 else:
-                    bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
+                    bucket = (_bucket_cols(cols, n)
                               if n > 1 else jnp.zeros_like(cols[KEY]))
                     cols, count, overflow = exchange(
                         cols, count, bucket, n, slot, out_cap
                     )
                 if not elide_sorted:  # already sorted rows skip the sort
-                    cols = kernels.sort_by_column(cols, count, KEY)
+                    cols = kernels.sort_by_column(cols, count, KEY,
+                                                  lo_name=_lo_of(cols))
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
@@ -1981,7 +2114,10 @@ class _JoinRDD(_ExchangeRDD):
     def _schema(self):
         ls = dict(self.left._schema())
         rs = dict(self.right._schema())
-        return ((KEY, ls[KEY]), ("lv", ls[VALUE]), ("rv", rs[VALUE]))
+        key_schema = ((KEY, ls[KEY]),)
+        if KEY_LO in ls:
+            key_schema += ((KEY_LO, ls[KEY_LO]),)
+        return key_schema + (("lv", ls[VALUE]), ("rv", rs[VALUE]))
 
     def _materialize(self) -> Block:
         lblk = self.left.block()
@@ -1990,6 +2126,10 @@ class _JoinRDD(_ExchangeRDD):
         l_counts = np.asarray(jax.device_get(lblk.counts))
         r_counts = np.asarray(jax.device_get(rblk.counts))
         exchange = _get_exchange(self.exchange_mode)
+        # Key layout is aligned by _align_keys before a _JoinRDD is built:
+        # both sides carry the same key columns (single, or (KEY, KEY_LO)).
+        key_names = [KEY] + ([KEY_LO] if KEY_LO in lblk.cols else [])
+        lo_name = _lo_of(lblk.cols)
         # Per-side exchange elision: a hash-placed side's rows are already
         # on their key's shard (reduce/group/join outputs), so only the
         # other side moves — the north-star reduced.join(table) pipeline
@@ -2001,13 +2141,14 @@ class _JoinRDD(_ExchangeRDD):
         r_sorted = r_elide and self.right.key_sorted
         join_cap_override: List[Optional[int]] = [None]
         join_cap_used: List[int] = [0]
+        n_side = 1 + len(key_names) + 1  # counts + key cols + value
 
         def one_side(cols, count, elide, slot_pair, out_cap):
             if elide:
                 return kernels.passthrough_exchange(
                     cols, count, cols[KEY].shape[0], out_cap
                 )
-            bucket = (pallas_kernels.hash_bucket(cols[KEY], n)
+            bucket = (_bucket_cols(cols, n)
                       if n > 1 else jnp.zeros_like(cols[KEY]))
             return exchange(cols, count, bucket, n, slot_pair, out_cap)
 
@@ -2015,33 +2156,42 @@ class _JoinRDD(_ExchangeRDD):
             join_cap = join_cap_override[0] or out_cap
             join_cap_used[0] = join_cap
 
-            def prog_fn(lc, lk, lv, rc, rk, rv):
+            def prog_fn(*args):
+                lc, *lkv = args[:n_side]
+                rc, *rkv = args[n_side:]
+                lcols = dict(zip(key_names + [VALUE], lkv))
+                rcols = dict(zip(key_names + [VALUE], rkv))
                 lcols, lcount, lof = one_side(
-                    {KEY: lk, VALUE: lv}, lc[0], l_elide, slot_pair, out_cap
+                    lcols, lc[0], l_elide, slot_pair, out_cap
                 )
                 rcols, rcount, rof = one_side(
-                    {KEY: rk, VALUE: rv}, rc[0], r_elide, slot_pair, out_cap
+                    rcols, rc[0], r_elide, slot_pair, out_cap
                 )
                 joined, jcount, jtotal = kernels.merge_join_expand(
                     lcols, lcount, rcols, rcount, KEY, join_cap,
                     outer=self.outer, fill_value=self.fill_value,
                     left_sorted=l_sorted, right_sorted=r_sorted,
+                    lo_name=lo_name,
                 )
                 return (
-                    jcount.reshape(1), jtotal.reshape(1), joined[KEY],
+                    jcount.reshape(1), jtotal.reshape(1),
+                ) + tuple(joined[nm] for nm in key_names) + (
                     joined[VALUE], joined[f"r_{VALUE}"],
                     (lof | rof).reshape(1),
                 )
 
             prog = _cached_program(
-                ("join", self.mesh, n, slot_pair, out_cap, join_cap,
-                 l_elide, r_elide, l_sorted, r_sorted,
+                ("join", self.mesh, n, tuple(key_names), slot_pair, out_cap,
+                 join_cap, l_elide, r_elide, l_sorted, r_sorted,
                  self.exchange_mode, self.outer, self.fill_value),
-                lambda: _shard_program(self.mesh, prog_fn, 6, (_SPEC,) * 6),
+                lambda: _shard_program(self.mesh, prog_fn, 2 * n_side,
+                                       (_SPEC,) * (5 + len(key_names))),
             )
             return prog, (
-                lblk.counts, lblk.cols[KEY], lblk.cols[VALUE],
-                rblk.counts, rblk.cols[KEY], rblk.cols[VALUE],
+                lblk.counts, *[lblk.cols[nm] for nm in key_names],
+                lblk.cols[VALUE],
+                rblk.counts, *[rblk.cols[nm] for nm in key_names],
+                rblk.cols[VALUE],
             )
 
         counts = np.concatenate([l_counts, r_counts])
@@ -2069,9 +2219,12 @@ class _JoinRDD(_ExchangeRDD):
             outs, _ = self._run_exchange(build, counts, hists=hists,
                                      slot_hists=slot_hists)
             jcounts = outs[0]
-        _, _, jk, jlv, jrv = outs
+        key_arrays = outs[2:2 + len(key_names)]
+        jlv, jrv = outs[2 + len(key_names):4 + len(key_names)]
+        cols = dict(zip(key_names, key_arrays))
+        cols.update({"lv": jlv, "rv": jrv})
         return Block(
-            cols={KEY: jk, "lv": jlv, "rv": jrv},
+            cols=cols,
             counts=jcounts, capacity=join_cap_used[0], mesh=self.mesh,
         )
 
@@ -2108,6 +2261,8 @@ class _SortByKeyRDD(_ExchangeRDD):
         blk = self.parent.block()
         n = self.mesh.size
         names = list(blk.cols)
+        lo_name = _lo_of(blk.cols)
+        composite = lo_name is not None
         counts_host = np.asarray(jax.device_get(blk.counts))
 
         # Driver-side bound sampling (tiny transfer): strided sample per shard.
@@ -2119,6 +2274,11 @@ class _SortByKeyRDD(_ExchangeRDD):
             stride = max(1, c // max(1, self.sample_size // blk.n_shards))
             lo = s * blk.capacity
             keys = np.asarray(jax.device_get(blk.cols[KEY][lo:lo + c:stride]))
+            if composite:
+                lo_words = np.asarray(
+                    jax.device_get(blk.cols[KEY_LO][lo:lo + c:stride])
+                )
+                keys = block_lib.decode_i64(keys, lo_words)
             samples.append(keys)
         if samples:
             allk = np.sort(np.concatenate(samples))
@@ -2126,27 +2286,43 @@ class _SortByKeyRDD(_ExchangeRDD):
                 allk = allk[::-1]
             idx = [int(len(allk) * i / n) for i in range(1, n)]
             bounds = allk[idx] if len(allk) else np.array([], allk.dtype)
+        elif composite:
+            bounds = np.zeros((n - 1,), np.int64)
         else:
             bounds = np.zeros((n - 1,), np.asarray(
                 jax.device_get(blk.cols[KEY][:1])).dtype)
-        bounds_dev = jnp.asarray(bounds)
+        if composite:
+            bounds_hi, bounds_lo = block_lib.encode_i64(bounds)
+            bounds_dev = jnp.asarray(bounds_hi)
+            bounds_lo_dev = jnp.asarray(bounds_lo)
+        else:
+            bounds_dev = jnp.asarray(bounds)
+            bounds_lo_dev = None
         ascending = self.ascending
         exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
-            def prog_fn(bnds, counts, *col_arrays):
+            def prog_fn(*args):
+                if composite:
+                    bnds, bnds_lo, counts, *col_arrays = args
+                else:
+                    (bnds, counts, *col_arrays), bnds_lo = args, None
                 cols = dict(zip(names, col_arrays))
                 count = counts[0]
                 keys = cols[KEY]
                 if n == 1:
                     bucket = jnp.zeros_like(keys, shape=keys.shape).astype(jnp.int32)
                 else:
-                    bucket = kernels.range_bucket(bnds, keys, ascending)
+                    bucket = kernels.range_bucket(
+                        bnds, keys, ascending, bounds_lo=bnds_lo,
+                        keys_lo=cols.get(lo_name) if composite else None,
+                    )
                 cols, count, overflow = exchange(
                     cols, count, bucket, n, slot, out_cap
                 )
                 cols = kernels.sort_by_column(
-                    cols, count, KEY, descending=not ascending
+                    cols, count, KEY, descending=not ascending,
+                    lo_name=lo_name,
                 )
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
@@ -2158,16 +2334,19 @@ class _SortByKeyRDD(_ExchangeRDD):
                 key,
                 lambda: _shard_program(
                     self.mesh, prog_fn,
-                    (_REPL,) + (_SPEC,) * (1 + len(names)),
+                    (_REPL,) * (1 + composite) + (_SPEC,) * (1 + len(names)),
                     (_SPEC,) * (2 + len(names)),
                 ),
             )
-            return prog, (bounds_dev, blk.counts,
+            dev_bounds = ((bounds_dev, bounds_lo_dev) if composite
+                          else (bounds_dev,))
+            return prog, (*dev_bounds, blk.counts,
                           *[blk.cols[nm] for nm in names])
 
         outs, out_cap = self._run_exchange(
             build, counts_host,
-            hists=[self._range_histogram(blk, bounds_dev, ascending)],
+            hists=[self._range_histogram(blk, bounds_dev, ascending,
+                                         bounds_lo_dev)],
         )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,

@@ -56,6 +56,46 @@ def hash32(col: jax.Array) -> jax.Array:
     return x
 
 
+def hash32_pair(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """Mix two 32-bit words into one 32-bit hash (the bucket hash for
+    (hi, lo)-encoded int64 keys, block.py KEY_LO). hash-combine of the two
+    lowbias32 digests followed by one more finalizer round; like hash32,
+    only bucket placement depends on it, so any good mixer is valid."""
+    a = hash32(hi)
+    b = hash32(lo)
+    x = a ^ (b + jnp.uint32(0x9E3779B9) + (a << jnp.uint32(6))
+             + (a >> jnp.uint32(2)))
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    return x
+
+
+def searchsorted2(rh: jax.Array, rl: jax.Array, qh: jax.Array,
+                  ql: jax.Array, side: str = "left") -> jax.Array:
+    """Vectorized lexicographic searchsorted over two-word keys: positions
+    of queries (qh, ql) in rows (rh, rl) sorted by (rh major, rl minor).
+    jnp.searchsorted cannot compare composite keys, so this is the classic
+    branchless binary search unrolled to ceil(log2(n))+1 rounds — O(log n)
+    vectorized gathers, no data-dependent control flow (jit-safe)."""
+    n = rh.shape[0]
+    lo = jnp.zeros(qh.shape, jnp.int32)
+    hi = jnp.full(qh.shape, n, jnp.int32)
+    for _ in range(max(1, int(n).bit_length())):
+        mid = (lo + hi) >> 1
+        safe = jnp.clip(mid, 0, max(n - 1, 0))
+        mh = jnp.take(rh, safe)
+        ml = jnp.take(rl, safe)
+        if side == "left":
+            go = (mh < qh) | ((mh == qh) & (ml < ql))
+        else:
+            go = (mh < qh) | ((mh == qh) & (ml <= ql))
+        active = lo < hi
+        lo = jnp.where(active & go, mid + 1, lo)
+        hi = jnp.where(active & ~go, mid, hi)
+    return lo
+
+
 def valid_mask(capacity: int, count: jax.Array) -> jax.Array:
     return lax.iota(jnp.int32, capacity) < count
 
@@ -126,7 +166,8 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
 
 
 def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
-                    key_name: str) -> Tuple[Cols, jax.Array]:
+                    key_name: str, lo_name: str = None
+                    ) -> Tuple[Cols, jax.Array]:
     """One stable multi-key sort by (bucket major, key minor).
 
     Rows become bucket-grouped with a key-sorted run per bucket, so a single
@@ -135,25 +176,47 @@ def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
     exchange's bucket grouping (the 3-sorts-to-2 restructuring of the
     reference's map-side combine, dependency.rs:176-223). Caller must have
     ghosted invalid rows (bucket = n_shards) so they sink to the end.
+    lo_name names the low word of a two-column int64 key (block.py KEY_LO):
+    it joins the sort keys so runs are sorted by the full 64-bit key.
     Returns (cols, bucket), both permuted."""
     capacity = bucket.shape[0]
     perm_src = lax.iota(jnp.int32, capacity)
-    sorted_bucket, sorted_key, perm = lax.sort(
-        (bucket, cols[key_name], perm_src), num_keys=2, is_stable=True
+    if lo_name is None:
+        sorted_bucket, sorted_key, perm = lax.sort(
+            (bucket, cols[key_name], perm_src), num_keys=2, is_stable=True
+        )
+        sorted_keys = {key_name: sorted_key}
+    else:
+        sorted_bucket, sk, sl, perm = lax.sort(
+            (bucket, cols[key_name], cols[lo_name], perm_src),
+            num_keys=3, is_stable=True,
+        )
+        sorted_keys = {key_name: sk, lo_name: sl}
+    out = gather_rows(
+        {n: c for n, c in cols.items() if n not in sorted_keys}, perm
     )
-    out = gather_rows({n: c for n, c in cols.items() if n != key_name}, perm)
-    out[key_name] = sorted_key  # already produced by the sort; skip a gather
+    out.update(sorted_keys)  # already produced by the sort; skip gathers
     return out, sorted_bucket
 
 
 def range_bucket(bounds: jax.Array, keys: jax.Array,
-                 ascending: bool) -> jax.Array:
+                 ascending: bool, bounds_lo: jax.Array = None,
+                 keys_lo: jax.Array = None) -> jax.Array:
     """Range-partition bucket ids from sorted split bounds (sort_by_key's
     partitioner). Shared by the exchange program and its sizing histogram —
-    exact capacity sizing depends on the two staying bit-identical."""
-    if ascending:
-        return jnp.searchsorted(bounds, keys).astype(jnp.int32)
-    return jnp.searchsorted(-bounds, -keys).astype(jnp.int32)
+    exact capacity sizing depends on the two staying bit-identical.
+    (bounds_lo, keys_lo) carry the low word of two-column int64 keys."""
+    if bounds_lo is None:
+        if ascending:
+            return jnp.searchsorted(bounds, keys).astype(jnp.int32)
+        return jnp.searchsorted(-bounds, -keys).astype(jnp.int32)
+    if not ascending:
+        # bitwise-not is order-reversing for int32 with no INT_MIN
+        # negation overflow; applied to both words it reverses the
+        # lexicographic order.
+        bounds, bounds_lo = ~bounds, ~bounds_lo
+        keys, keys_lo = ~keys, ~keys_lo
+    return searchsorted2(bounds, bounds_lo, keys, keys_lo).astype(jnp.int32)
 
 
 def pregrouped_group(bucket: jax.Array, n_shards: int):
@@ -232,11 +295,22 @@ def bucket_exchange(
 
 
 def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
-                   descending: bool = False) -> Cols:
-    """Stable sort valid rows by one column; invalid rows sink to the end."""
+                   descending: bool = False, lo_name: str = None) -> Cols:
+    """Stable sort valid rows by one column (or a (key, lo) two-column
+    int64 key when lo_name is given); invalid rows sink to the end."""
     key = cols[key_name]
     capacity = key.shape[0]
     mask = valid_mask(capacity, count)
+    if lo_name is not None:
+        hi_k, lo_k = key, cols[lo_name]
+        if descending:
+            hi_k, lo_k = ~hi_k, ~lo_k  # order-reversing, overflow-free
+        hi_k = jnp.where(mask, hi_k, _orderable_max(hi_k))
+        lo_k = jnp.where(mask, lo_k, _orderable_max(lo_k))
+        perm_src = lax.iota(jnp.int32, capacity)
+        _, _, order = lax.sort((hi_k, lo_k, perm_src), num_keys=2,
+                               is_stable=True)
+        return gather_rows(cols, order)
     if descending:
         order = jnp.argsort(
             jnp.where(mask, -_orderable(key), _orderable_max(key)), stable=True
@@ -265,24 +339,33 @@ def segment_reduce_sorted(
     key_name: str,
     combine: Callable,  # (value_cols_a, value_cols_b) -> value_cols
     presorted: bool = False,
+    lo_name: str = None,
 ) -> Tuple[Cols, jax.Array]:
     """Generic reduce_by_key over a shard: sort by key, then a segmented
     associative scan with an arbitrary traceable combiner; the last row of
     each segment carries the reduction. Returns compacted (cols, count).
+    lo_name names the low word of a two-column int64 key: it sorts and
+    segments with the key and rides to the output untouched.
 
     This is reference hot loop 2 (shuffled_rdd.rs:154-164 merge_combiners
     into a HashMap) recast as sort + scan so it vectorizes on the VPU instead
     of chasing hash buckets."""
     capacity = cols[key_name].shape[0]
     if not presorted:
-        cols = sort_by_column(cols, count, key_name)
+        cols = sort_by_column(cols, count, key_name, lo_name=lo_name)
     mask = valid_mask(capacity, count)
     keys = cols[key_name]
     first = jnp.concatenate([
         jnp.ones((1,), jnp.bool_),
         keys[1:] != keys[:-1],
     ])
-    value_cols = {n: c for n, c in cols.items() if n != key_name}
+    if lo_name is not None:
+        lo_col = cols[lo_name]
+        first = first | jnp.concatenate([
+            jnp.ones((1,), jnp.bool_), lo_col[1:] != lo_col[:-1],
+        ])
+    key_set = {key_name} if lo_name is None else {key_name, lo_name}
+    value_cols = {n: c for n, c in cols.items() if n not in key_set}
 
     def seg_combine(a, b):
         va, fa = a
@@ -303,6 +386,8 @@ def segment_reduce_sorted(
     is_end = mask & (next_first | (idx == count - 1))
     out = dict(scanned)
     out[key_name] = keys
+    if lo_name is not None:
+        out[lo_name] = cols[lo_name]
     return compact(out, is_end, capacity)
 
 
@@ -316,24 +401,32 @@ _FAST_SEGMENT_OPS = {
 
 def segment_reduce_named(
     cols: Cols, count: jax.Array, key_name: str, op: str,
-    presorted: bool = False,
+    presorted: bool = False, lo_name: str = None,
 ) -> Tuple[Cols, jax.Array]:
-    """Fast path for the common monoids via XLA segment ops."""
+    """Fast path for the common monoids via XLA segment ops. lo_name names
+    the low word of a two-column int64 key (sorts/segments with the key)."""
     seg_op = _FAST_SEGMENT_OPS[op]
     capacity = cols[key_name].shape[0]
     if not presorted:
-        cols = sort_by_column(cols, count, key_name)
+        cols = sort_by_column(cols, count, key_name, lo_name=lo_name)
     mask = valid_mask(capacity, count)
     keys = cols[key_name]
     first = jnp.concatenate(
         [jnp.ones((1,), jnp.bool_), keys[1:] != keys[:-1]]
-    ) & mask
+    )
+    if lo_name is not None:
+        lo_col = cols[lo_name]
+        first = first | jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), lo_col[1:] != lo_col[:-1]]
+        )
+    first = first & mask
     seg_ids = jnp.cumsum(first.astype(jnp.int32)) - 1
     seg_ids = jnp.where(mask, seg_ids, capacity - 1)
     n_segments = jnp.sum(first).astype(jnp.int32)
+    key_set = {key_name} if lo_name is None else {key_name, lo_name}
     out: Cols = {}
     for name, col in cols.items():
-        if name == key_name:
+        if name in key_set:
             continue
         if op == "add" or op == "prod":
             neutral = jnp.zeros((), col.dtype) if op == "add" else jnp.ones((), col.dtype)
@@ -346,6 +439,8 @@ def segment_reduce_named(
     # Key of segment i = key at the i-th segment start.
     start_rows = jnp.nonzero(first, size=capacity, fill_value=capacity - 1)[0]
     out[key_name] = jnp.take(keys, start_rows)
+    if lo_name is not None:
+        out[lo_name] = jnp.take(cols[lo_name], start_rows)
     seg_valid = lax.iota(jnp.int32, capacity) < n_segments
     comp, _ = compact(out, seg_valid, capacity)
     return comp, n_segments
@@ -387,6 +482,7 @@ def merge_join_expand(
     fill_value: float = 0,
     left_sorted: bool = False,   # caller guarantees valid-prefix + sorted
     right_sorted: bool = False,
+    lo_name: str = None,         # low word of a two-column int64 key
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """General sort-merge join with duplicate keys on BOTH sides.
 
@@ -409,9 +505,9 @@ def merge_join_expand(
     lcap = left[key_name].shape[0]
     rcap = right[key_name].shape[0]
     if not left_sorted:
-        left = sort_by_column(left, left_count, key_name)
+        left = sort_by_column(left, left_count, key_name, lo_name=lo_name)
     if not right_sorted:
-        right = sort_by_column(right, right_count, key_name)
+        right = sort_by_column(right, right_count, key_name, lo_name=lo_name)
     lkeys = left[key_name]
     rkeys = right[key_name]
     rmask = valid_mask(rcap, right_count)
@@ -420,10 +516,23 @@ def merge_join_expand(
 
     # Per-left-row match range in the sorted right block. The min() guards
     # clip sentinel-padded rows out when a valid key equals the sentinel.
-    lo = jnp.minimum(jnp.searchsorted(rkeys, lkeys, side="left"),
-                     right_count)
-    hi = jnp.minimum(jnp.searchsorted(rkeys, lkeys, side="right"),
-                     right_count)
+    if lo_name is None:
+        lo = jnp.minimum(jnp.searchsorted(rkeys, lkeys, side="left"),
+                         right_count)
+        hi = jnp.minimum(jnp.searchsorted(rkeys, lkeys, side="right"),
+                         right_count)
+    else:
+        lkeys_lo = left[lo_name]
+        rkeys_lo = jnp.where(rmask, right[lo_name],
+                             _orderable_max(right[lo_name]))
+        lo = jnp.minimum(
+            searchsorted2(rkeys, rkeys_lo, lkeys, lkeys_lo, "left"),
+            right_count,
+        )
+        hi = jnp.minimum(
+            searchsorted2(rkeys, rkeys_lo, lkeys, lkeys_lo, "right"),
+            right_count,
+        )
     n_match = hi - lo
     if outer:
         m = jnp.where(lmask, jnp.maximum(n_match, 1), 0)
@@ -437,12 +546,15 @@ def merge_join_expand(
     ri = jnp.clip(jnp.take(lo, li) + off, 0, rcap - 1)
     row_matched = jnp.take(n_match > 0, li)
 
+    key_set = {key_name} if lo_name is None else {key_name, lo_name}
     out: Cols = {key_name: jnp.take(lkeys, li)}
+    if lo_name is not None:
+        out[lo_name] = jnp.take(left[lo_name], li)
     for name, col in left.items():
-        if name != key_name:
+        if name not in key_set:
             out[name] = jnp.take(col, li, axis=0)
     for name, col in right.items():
-        if name == key_name:
+        if name in key_set:
             continue
         taken = jnp.take(col, ri, axis=0)
         if outer:
