@@ -1172,3 +1172,55 @@ def test_dense_from_columns_rejects_reserved_lo_name(dctx):
             {"k": np.array([1, 2], np.int32),
              "k.lo": np.array([5, 6], np.int32)}, key="k",
         )
+
+
+def test_capacity_hints_skip_histogram_on_rerun(dctx, monkeypatch):
+    """A structurally identical second pipeline over same-count inputs
+    reuses the memoized exchange capacities: no sizing-histogram device
+    pass (one round trip saved per exchange, which matters through the
+    TPU tunnel)."""
+    from vega_tpu.tpu import dense_rdd as dr
+
+    calls = {"n": 0}
+    real = dr._ExchangeRDD._hash_histogram
+
+    def counting(self, blk):
+        calls["n"] += 1
+        return real(self, blk)
+
+    monkeypatch.setattr(dr._ExchangeRDD, "_hash_histogram", counting)
+
+    def pipeline():
+        kv = dctx.dense_range(4_000).map(lambda x: (x % 97, x))
+        red = kv.reduce_by_key(op="add")
+        table = dctx.dense_from_numpy(
+            np.arange(97, dtype=np.int32), np.arange(97, dtype=np.int32)
+        )
+        return dict(red.join(table).collect())
+
+    first = pipeline()
+    n_first = calls["n"]
+    assert n_first > 0  # cold run sized via histograms
+    second = pipeline()
+    assert second == first
+    assert calls["n"] == n_first  # warm run: zero histogram passes
+    assert dctx._dense_capacity_hints  # hints recorded
+
+
+def test_capacity_hint_overflow_falls_back_to_histogram(dctx):
+    """A stale/bogus hint (e.g. the key distribution changed under equal
+    counts) must not break anything: the overflow flag triggers the exact
+    histogram path and results stay correct."""
+    n_keys = 2_000  # ~250 combiners per shard >> the poisoned capacity
+    kv = dctx.dense_range(3_000).map(lambda x: (x % n_keys, x))
+    node = kv.reduce_by_key(op="add")
+    # Poison the hint store for this exact lineage+counts with capacities
+    # too small for the real distribution, then materialize.
+    counts = kv.block().counts_np
+    key = node._hint_key(counts)
+    dctx.__dict__.setdefault("_dense_capacity_hints", {})[key] = (128, 128)
+    got = dict(node.collect())
+    assert got == {k: sum(x for x in range(3_000) if x % n_keys == k)
+                   for k in range(n_keys)}
+    # the bad hint was replaced by working capacities
+    assert dctx._dense_capacity_hints[key] != (128, 128)

@@ -68,14 +68,27 @@ class Block:
     counts: jax.Array  # int32[n_shards], valid rows per shard
     capacity: int  # per-shard row capacity (static)
     mesh: object  # jax.sharding.Mesh
+    # Host copy of counts, cached: every device_get is a driver<->device
+    # round trip (through the axon tunnel: a full network RTT), and the
+    # drivers of count()/exchanges/collect all need counts. Builders that
+    # know the counts (from_numpy, block_range, exchange drivers that
+    # already fetched them with the overflow flag) pass them in; otherwise
+    # the first counts_np fetches once.
+    counts_host: Optional[np.ndarray] = None
 
     @property
     def n_shards(self) -> int:
         return self.mesh.size
 
     @property
+    def counts_np(self) -> np.ndarray:
+        if self.counts_host is None:
+            self.counts_host = np.asarray(jax.device_get(self.counts))
+        return self.counts_host
+
+    @property
     def num_rows(self) -> int:
-        return int(np.sum(jax.device_get(self.counts)))
+        return int(np.sum(self.counts_np))
 
     @property
     def column_names(self) -> List[str]:
@@ -94,7 +107,7 @@ class Block:
         """Gather valid rows to host, shard order preserved. Two-column
         int64 keys (KEY_LO) come back as one int64 KEY column — host-facing
         consumers never see the encoding."""
-        counts = np.asarray(jax.device_get(self.counts))
+        counts = self.counts_np
         host_cols = {name: np.asarray(jax.device_get(col))
                      for name, col in self.cols.items()}
         out: Dict[str, List[np.ndarray]] = {n: [] for n in self.cols}
@@ -108,7 +121,7 @@ class Block:
         return _decode_key_cols(gathered)
 
     def shard_rows(self, shard: int) -> Dict[str, np.ndarray]:
-        counts = np.asarray(jax.device_get(self.counts))
+        counts = self.counts_np
         lo = shard * self.capacity
         c = int(counts[shard])
         return _decode_key_cols({
@@ -232,7 +245,8 @@ def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
                 dst[s * cap:s * cap + c] = src[lo:hi]
         cols[name] = jax.device_put(dst, mesh_lib.shard_spec(mesh))
     counts_arr = jax.device_put(counts, mesh_lib.shard_spec(mesh))
-    return Block(cols=cols, counts=counts_arr, capacity=cap, mesh=mesh)
+    return Block(cols=cols, counts=counts_arr, capacity=cap, mesh=mesh,
+                 counts_host=counts)
 
 
 def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
@@ -268,7 +282,8 @@ def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
     )
     vals = build_sharded(shard_ids)
     counts = jax.device_put(counts_host, mesh_lib.shard_spec(mesh))
-    return Block(cols={VALUE: vals}, counts=counts, capacity=cap, mesh=mesh)
+    return Block(cols={VALUE: vals}, counts=counts, capacity=cap, mesh=mesh,
+                 counts_host=counts_host)
 
 
 def single_column(values, mesh=None) -> Block:

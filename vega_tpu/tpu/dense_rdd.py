@@ -125,7 +125,38 @@ class DenseRDD(RDD):
 
         super().__init__(ctx, deps=[OneToOneDependency(r) for r in deps_rdds])
         self.mesh = mesh
+        self._dense_parents = tuple(deps_rdds)
         self._block: Optional[Block] = None
+
+    def _fp_extra(self):
+        """Node-type-specific part of the structural lineage fingerprint
+        (closure fingerprints, op names, flags)."""
+        return ()
+
+    def _lineage_fp(self):
+        """Structural identity of this node's lineage: node types + their
+        parameters, NOT rdd ids — two runs of the same pipeline (fresh
+        nodes, same shape) share a fingerprint. Keys the exchange capacity
+        hints so warm re-runs skip the sizing histogram's device round
+        trip (the overflow-retry loop remains the safety net if the data
+        distribution changed). Iterative walk + per-node memo: lineages
+        can be thousands of narrow nodes deep (the chain materializer
+        supports that depth, so this must too), and _fp_extra pickles
+        closures — compute each node's fingerprint once."""
+        if getattr(self, "_fp_memo", None) is None:
+            stack = [(self, False)]
+            while stack:
+                node, ready = stack.pop()
+                if getattr(node, "_fp_memo", None) is not None:
+                    continue
+                if ready:
+                    node._fp_memo = (
+                        type(node).__name__, node._fp_extra(),
+                    ) + tuple(p._fp_memo for p in node._dense_parents)
+                else:
+                    stack.append((node, True))
+                    stack.extend((p, False) for p in node._dense_parents)
+        return self._fp_memo
 
     # --- process portability ------------------------------------------------
     def __getstate__(self):
@@ -152,7 +183,7 @@ class DenseRDD(RDD):
                 "_pinned": self._pinned,
                 "cols": {n: np.asarray(jax.device_get(c))
                          for n, c in blk.cols.items()},
-                "counts": np.asarray(jax.device_get(blk.counts)),
+                "counts": blk.counts_np,
                 "capacity": blk.capacity,
             }
             self._pickle_state_memo = memo
@@ -1004,6 +1035,9 @@ class _NarrowRDD(DenseRDD):
         """Program-cache identity of this node (kind + closure fingerprint)."""
         return (type(self).__name__, _fp(getattr(self, "_user_fn", None)))
 
+    def _fp_extra(self):
+        return self._node_fp()
+
     def _materialize(self) -> Block:
         # Collect the narrow chain down to the nearest materialization
         # root (a non-narrow node, an already-materialized block, or a
@@ -1293,7 +1327,7 @@ class _ZipWithIndexRDD(DenseRDD):
 
     def _materialize(self) -> Block:
         blk = self.parent.block()
-        counts_host = np.asarray(jax.device_get(blk.counts))
+        counts_host = blk.counts_np
         offsets = np.concatenate(
             [[0], np.cumsum(counts_host)[:-1]]
         ).astype(np.int32)
@@ -1331,8 +1365,8 @@ class _DenseZipRDD(DenseRDD):
     def _materialize(self) -> Block:
         lb = self.left.block()
         rb = self.right.block()
-        lc = np.asarray(jax.device_get(lb.counts))
-        rc = np.asarray(jax.device_get(rb.counts))
+        lc = lb.counts_np
+        rc = rb.counts_np
         if not np.array_equal(lc, rc):
             raise VegaError(
                 "dense zip requires equal per-shard counts; repartition or "
@@ -1491,6 +1525,10 @@ class _SourceRDD(DenseRDD):
 
     def _schema(self):
         return tuple((n, c.dtype) for n, c in self._block.cols.items())
+
+    def _fp_extra(self):
+        return (tuple((n, str(c.dtype)) for n, c in self._block.cols.items()),
+                self._block.capacity, self._hash_placed)
 
 
 def dense_range(ctx, n: int, num_partitions=None, dtype=None,
@@ -1799,17 +1837,36 @@ class _ExchangeRDD(DenseRDD):
         out = prog(*args)
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
+    def _hint_key(self, counts: np.ndarray, *extra):
+        """Capacity-hint identity: structural lineage + input shard counts.
+        Same pipeline shape over same-count inputs (the steady-state rerun
+        and the streamed per-chunk case) reuses last run's capacities and
+        skips the sizing histogram's device round trip; a changed key
+        distribution under equal counts surfaces as an overflow retry,
+        which falls back to the exact histogram."""
+        return (self._lineage_fp(), counts.tobytes(), extra)
+
     def _run_exchange(self, build_program, counts: np.ndarray,
                       hists: Optional[List[np.ndarray]] = None,
-                      slot_hists: Optional[List[np.ndarray]] = None):
+                      slot_hists: Optional[List[np.ndarray]] = None,
+                      make_hists=None, hint_key=None):
+        """Run the fused exchange program with capacity sizing.
+
+        Sizing order: (1) a memoized capacity hint for this lineage+counts
+        (no device work), (2) exact histograms — passed eagerly via
+        `hists`/`slot_hists` or computed lazily by `make_hists()` (a device
+        pass, skipped entirely on a hint hit), (3) the heuristic growth
+        schedule. Overflow at any stage falls through to the next."""
         import time as _time
 
         from vega_tpu.scheduler import events as ev
 
         n = self.mesh.size
-        hists = [h for h in (hists or []) if h is not None]
-        if slot_hists is not None:
-            slot_hists = [h for h in slot_hists if h is not None]
+        hist_pair = (None if make_hists is not None
+                     else (hists, slot_hists))
+        hint_store = self.context.__dict__.setdefault(
+            "_dense_capacity_hints", {})
+        hinted = hint_key is not None and hint_key in hint_store
         bus = getattr(self.context, "bus", None)
         t_start = _time.time()
         if bus is not None:
@@ -1820,16 +1877,49 @@ class _ExchangeRDD(DenseRDD):
                 stage_id=-self.rdd_id, num_tasks=n, is_shuffle_map=True,
             ))
         try:
-            for attempt in range(5):
-                if hists:
-                    slot, out_cap = _histogram_capacities(hists, attempt,
-                                                          slot_hists)
+            attempt = 0  # histogram/heuristic growth step
+            for round_i in range(6):
+                if hinted and round_i == 0:
+                    slot, out_cap = hint_store[hint_key]
                 else:
-                    slot, out_cap = _exchange_capacities(counts, n, attempt)
+                    if hist_pair is None:
+                        hist_pair = make_hists()
+                    hs = [h for h in (hist_pair[0] or []) if h is not None]
+                    sh = hist_pair[1]
+                    if sh is not None:
+                        sh = [h for h in sh if h is not None]
+                    if hs:
+                        slot, out_cap = _histogram_capacities(hs, attempt,
+                                                              sh)
+                    else:
+                        slot, out_cap = _exchange_capacities(counts, n,
+                                                             attempt)
+                    attempt += 1
                 prog, args = build_program(slot, out_cap)
                 *outs, overflow = prog(*args)
-                self._last_attempts = attempt + 1
-                if not bool(np.any(np.asarray(jax.device_get(overflow)))):
+                self._last_attempts = round_i + 1
+                # One transfer for (counts, any extra driver-needed outputs,
+                # overflow): each separate device_get is a full round trip
+                # (a network RTT through the axon tunnel). Nodes that need
+                # more outputs on the host (join's exact product sizes) set
+                # _fetch_extra_outs to ride the same transfer.
+                extra = getattr(self, "_fetch_extra_outs", 0)
+                fetched, overflow_host = jax.device_get(
+                    (tuple(outs[:1 + extra]), overflow)
+                )
+                if not bool(np.any(np.asarray(overflow_host))):
+                    self._last_counts_host = np.asarray(
+                        fetched[0]
+                    ).reshape(-1)
+                    self._last_extra_host = [np.asarray(x)
+                                             for x in fetched[1:]]
+                    if hint_key is not None:
+                        hint_store[hint_key] = (slot, out_cap)
+                        # Bound the store: data-dependent counts (filters,
+                        # ragged tail chunks) mint fresh keys per run; drop
+                        # oldest entries past the cap (insertion-ordered).
+                        while len(hint_store) > 4096:
+                            hint_store.pop(next(iter(hint_store)))
                     return outs, out_cap
                 log.info("exchange overflow (slot=%d out=%d), retrying",
                          slot, out_cap)
@@ -1897,6 +1987,9 @@ class _ReduceByKeyRDD(_ExchangeRDD):
     def _schema(self):
         return self.parent._schema()
 
+    def _fp_extra(self):
+        return (self._op or _fp(self._func), self.exchange_mode)
+
     def _segment_reduce(self, cols, count, presorted):
         lo_name = _lo_of(cols)
         if self._op is not None:
@@ -1925,7 +2018,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         blk = self.parent.block()
         n = self.mesh.size
         names = list(blk.cols)
-        counts_host = np.asarray(jax.device_get(blk.counts))
+        counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
         # Partitioner-equality elision, device edition: a hash-placed
         # parent already has every key's rows on their reducer shard, so
@@ -1996,15 +2089,22 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # (shard s keeps counts[s] rows) — one attempt, exact out capacity;
         # slot is unused by the passthrough, so size it from nothing.
         self._elided = elide
-        hists = ([np.diag(counts_host)] if elide
-                 else [self._hash_histogram(blk)])
-        outs, out_cap = self._run_exchange(
-            build, counts_host, hists=hists,
-            slot_hists=[] if elide else None,
-        )
+        if elide:
+            # Exact "histogram" is the diagonal (rows stay put) — free.
+            outs, out_cap = self._run_exchange(
+                build, counts_host, hists=[np.diag(counts_host)],
+                slot_hists=[],
+            )
+        else:
+            outs, out_cap = self._run_exchange(
+                build, counts_host,
+                make_hists=lambda: ([self._hash_histogram(blk)], None),
+                hint_key=self._hint_key(counts_host),
+            )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh)
+                     capacity=out_cap, mesh=self.mesh,
+                     counts_host=self._last_counts_host)
 
 
 class _GroupByKeyRDD(_ExchangeRDD):
@@ -2020,11 +2120,14 @@ class _GroupByKeyRDD(_ExchangeRDD):
     def _schema(self):
         return self.parent._schema()
 
+    def _fp_extra(self):
+        return (self.exchange_mode,)
+
     def _materialize(self) -> Block:
         blk = self.parent.block()
         n = self.mesh.size
         names = list(blk.cols)
-        counts_host = np.asarray(jax.device_get(blk.counts))
+        counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
         elide = self.parent.hash_placed and n > 1  # rows already placed
         elide_sorted = elide and self.parent.key_sorted
@@ -2062,15 +2165,22 @@ class _GroupByKeyRDD(_ExchangeRDD):
             return prog, (blk.counts, *[blk.cols[nm] for nm in names])
 
         self._elided = elide
-        hists = ([np.diag(counts_host)] if elide
-                 else [self._hash_histogram(blk)])
-        outs, out_cap = self._run_exchange(
-            build, counts_host, hists=hists,
-            slot_hists=[] if elide else None,
-        )
+        if elide:
+            # Exact "histogram" is the diagonal (rows stay put) — free.
+            outs, out_cap = self._run_exchange(
+                build, counts_host, hists=[np.diag(counts_host)],
+                slot_hists=[],
+            )
+        else:
+            outs, out_cap = self._run_exchange(
+                build, counts_host,
+                make_hists=lambda: ([self._hash_histogram(blk)], None),
+                hint_key=self._hint_key(counts_host),
+            )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh)
+                     capacity=out_cap, mesh=self.mesh,
+                     counts_host=self._last_counts_host)
 
     def collect_grouped(self):
         """Columnar grouped collect: (keys, offsets, values) numpy arrays,
@@ -2111,6 +2221,11 @@ class _JoinRDD(_ExchangeRDD):
         self.outer = outer
         self.fill_value = fill_value
 
+    def _fp_extra(self):
+        # repr() keeps NaN fills hint-stable (nan != nan would make every
+        # hint lookup miss and leak a store entry per run).
+        return (self.outer, repr(self.fill_value), self.exchange_mode)
+
     def _schema(self):
         ls = dict(self.left._schema())
         rs = dict(self.right._schema())
@@ -2123,8 +2238,8 @@ class _JoinRDD(_ExchangeRDD):
         lblk = self.left.block()
         rblk = self.right.block()
         n = self.mesh.size
-        l_counts = np.asarray(jax.device_get(lblk.counts))
-        r_counts = np.asarray(jax.device_get(rblk.counts))
+        l_counts = lblk.counts_np
+        r_counts = rblk.counts_np
         exchange = _get_exchange(self.exchange_mode)
         # Key layout is aligned by _align_keys before a _JoinRDD is built:
         # both sides carry the same key columns (single, or (KEY, KEY_LO)).
@@ -2196,16 +2311,30 @@ class _JoinRDD(_ExchangeRDD):
 
         counts = np.concatenate([l_counts, r_counts])
         self._elided = (l_elide, r_elide)
-        hists = [
-            np.diag(l_counts) if l_elide else self._hash_histogram(lblk),
-            np.diag(r_counts) if r_elide else self._hash_histogram(rblk),
-        ]
-        # Elided (diag) sides never send: keep them out of slot sizing.
-        slot_hists = [h for h, el in zip(hists, (l_elide, r_elide))
-                      if not el]
-        outs, _ = self._run_exchange(build, counts, hists=hists,
-                                     slot_hists=slot_hists)
-        jcounts, jtotals = outs[0], np.asarray(jax.device_get(outs[1]))
+        self._fetch_extra_outs = 1  # jtotals rides the counts transfer
+
+        def make_hists():
+            hs = [
+                np.diag(l_counts) if l_elide else self._hash_histogram(lblk),
+                np.diag(r_counts) if r_elide else self._hash_histogram(rblk),
+            ]
+            # Elided (diag) sides never send: keep them out of slot sizing.
+            return hs, [h for h, el in zip(hs, (l_elide, r_elide))
+                        if not el]
+
+        hint = (None if (l_elide and r_elide)
+                else self._hint_key(counts))
+        # The dup x dup product size is also hint-memoized: without it, a
+        # join whose product exceeds the exchange-sized cap would repeat
+        # its full-launch resize on every warm rerun.
+        hint_store = self.context.__dict__.setdefault(
+            "_dense_capacity_hints", {})
+        jc_key = None if hint is None else (hint, "join_cap")
+        if jc_key is not None and jc_key in hint_store:
+            join_cap_override[0] = hint_store[jc_key]
+        outs, _ = self._run_exchange(build, counts, make_hists=make_hists,
+                                     hint_key=hint)
+        jcounts, jtotals = outs[0], self._last_extra_host[0]
         if int(jtotals.max(initial=0)) >= 2**31 - 1:
             raise VegaError(
                 "dense join product exceeds 2^31 rows on one shard — "
@@ -2216,9 +2345,12 @@ class _JoinRDD(_ExchangeRDD):
             # kernel reported the exact product size, so ONE resized rerun
             # is guaranteed to fit (no geometric-growth walk).
             join_cap_override[0] = _cap_round(int(jtotals.max()))
-            outs, _ = self._run_exchange(build, counts, hists=hists,
-                                     slot_hists=slot_hists)
+            outs, _ = self._run_exchange(build, counts,
+                                         make_hists=make_hists,
+                                         hint_key=hint)
             jcounts = outs[0]
+        if jc_key is not None and join_cap_override[0]:
+            hint_store[jc_key] = join_cap_override[0]
         key_arrays = outs[2:2 + len(key_names)]
         jlv, jrv = outs[2 + len(key_names):4 + len(key_names)]
         cols = dict(zip(key_names, key_arrays))
@@ -2226,6 +2358,7 @@ class _JoinRDD(_ExchangeRDD):
         return Block(
             cols=cols,
             counts=jcounts, capacity=join_cap_used[0], mesh=self.mesh,
+            counts_host=self._last_counts_host,
         )
 
     def collect(self) -> list:
@@ -2254,6 +2387,9 @@ class _SortByKeyRDD(_ExchangeRDD):
         self.ascending = ascending
         self.sample_size = sample_size
 
+    def _fp_extra(self):
+        return (self.ascending, self.sample_size, self.exchange_mode)
+
     def _schema(self):
         return self.parent._schema()
 
@@ -2263,7 +2399,7 @@ class _SortByKeyRDD(_ExchangeRDD):
         names = list(blk.cols)
         lo_name = _lo_of(blk.cols)
         composite = lo_name is not None
-        counts_host = np.asarray(jax.device_get(blk.counts))
+        counts_host = blk.counts_np
 
         # Driver-side bound sampling (tiny transfer): strided sample per shard.
         samples = []
@@ -2345,12 +2481,17 @@ class _SortByKeyRDD(_ExchangeRDD):
 
         outs, out_cap = self._run_exchange(
             build, counts_host,
-            hists=[self._range_histogram(blk, bounds_dev, ascending,
-                                         bounds_lo_dev)],
+            make_hists=lambda: ([self._range_histogram(
+                blk, bounds_dev, ascending, bounds_lo_dev)], None),
+            # Bounds are data-derived: same data -> same bounds, and a
+            # changed distribution changes the bounds, so they belong in
+            # the hint identity.
+            hint_key=self._hint_key(counts_host, bounds.tobytes()),
         )
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh)
+                     capacity=out_cap, mesh=self.mesh,
+                     counts_host=self._last_counts_host)
 
 
 class _CartesianDenseRDD(DenseRDD):
@@ -2365,7 +2506,7 @@ class _CartesianDenseRDD(DenseRDD):
         lblk = left.block()
         rblk = right.block()
         r_total = rblk.num_rows
-        l_counts = np.asarray(jax.device_get(lblk.counts))
+        l_counts = lblk.counts_np
         max_l = int(l_counts.max()) if l_counts.size else 0
         out_cap = block_lib._round_capacity(max(max_l * max(r_total, 1), 1))
         row_bytes = sum(c.dtype.itemsize for c in lblk.cols.values()) + \
@@ -2540,8 +2681,8 @@ class _DenseCoGroupRDD(RDD):
         # device round-trips.
         lblk = self.left_grouped.block()
         rblk = self.right_grouped.block()
-        l_counts = np.asarray(jax.device_get(lblk.counts))
-        r_counts = np.asarray(jax.device_get(rblk.counts))
+        l_counts = lblk.counts_np
+        r_counts = rblk.counts_np
         lall = lblk.to_numpy()
         rall = rblk.to_numpy()
 
