@@ -691,8 +691,8 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_reduce, 2, (_SPEC, _SPEC)),
         )
         partials, flags = prog(blk.cols[VALUE], blk.counts)
-        partials = np.asarray(jax.device_get(partials))
-        flags = np.asarray(jax.device_get(flags))
+        partials, flags = jax.device_get((partials, flags))  # one RTT
+        partials, flags = np.asarray(partials), np.asarray(flags)
         vals = [partials[i] for i in range(len(flags)) if flags[i]]
         if not vals:
             raise VegaError("reduce() of empty RDD")
@@ -789,8 +789,9 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_topk, 2, (_SPEC, _SPEC)),
         )
         best, n_valid = prog(blk.cols[VALUE], blk.counts)
-        best = np.asarray(jax.device_get(best)).reshape(blk.n_shards, k)
-        n_valid = np.asarray(jax.device_get(n_valid))
+        best, n_valid = jax.device_get((best, n_valid))  # one RTT
+        best = np.asarray(best).reshape(blk.n_shards, k)
+        n_valid = np.asarray(n_valid)
         candidates = np.concatenate(
             [best[s, : n_valid[s]] for s in range(blk.n_shards)]
         ) if blk.n_shards else np.empty((0,))
@@ -824,8 +825,9 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_stats, 2, (_SPEC, _SPEC)),
         )
         int_counts, parts = prog(blk.cols[VALUE], blk.counts)
-        int_counts = np.asarray(jax.device_get(int_counts)).reshape(-1)
-        parts = np.asarray(jax.device_get(parts))
+        int_counts, parts = jax.device_get((int_counts, parts))  # one RTT
+        int_counts = np.asarray(int_counts).reshape(-1)
+        parts = np.asarray(parts)
         n = int(int_counts.sum())
         s = float(parts[:, 0].sum())
         ss = float(parts[:, 1].sum())
@@ -853,8 +855,9 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_mm, 2, (_SPEC, _SPEC)),
         )
         parts, int_counts = prog(blk.cols[VALUE], blk.counts)
-        parts = np.asarray(jax.device_get(parts))
-        valid = np.asarray(jax.device_get(int_counts)).reshape(-1) > 0
+        parts, int_counts = jax.device_get((parts, int_counts))  # one RTT
+        parts = np.asarray(parts)
+        valid = np.asarray(int_counts).reshape(-1) > 0
         if not valid.any():
             raise VegaError("min/max of empty DenseRDD")
         return parts[valid, 0].min().item(), parts[valid, 1].max().item()
@@ -1344,7 +1347,8 @@ class _ZipWithIndexRDD(DenseRDD):
         )
         counts, vals, pos = prog(offsets_dev, blk.counts, blk.cols[VALUE])
         return Block(cols={KEY: vals, VALUE: pos}, counts=counts,
-                     capacity=blk.capacity, mesh=self.mesh)
+                     capacity=blk.capacity, mesh=self.mesh,
+                     counts_host=counts_host)
 
 
 class _DenseZipRDD(DenseRDD):
@@ -1386,7 +1390,7 @@ class _DenseZipRDD(DenseRDD):
         )
         counts, lv, rv = prog(lb.counts, lb.cols[VALUE], rb.cols[VALUE])
         return Block(cols={KEY: lv, VALUE: rv}, counts=counts, capacity=cap,
-                     mesh=self.mesh)
+                     mesh=self.mesh, counts_host=lc)
 
 
 class _SelectRDD(_NarrowRDD):
@@ -1914,10 +1918,15 @@ class _ExchangeRDD(DenseRDD):
                     self._last_extra_host = [np.asarray(x)
                                              for x in fetched[1:]]
                     if hint_key is not None:
+                        # pop-then-insert refreshes recency: eviction pops
+                        # the FRONT of the insertion-ordered dict, and the
+                        # hot steady-state key (re-stored every warm run)
+                        # must not be the one that goes.
+                        hint_store.pop(hint_key, None)
                         hint_store[hint_key] = (slot, out_cap)
                         # Bound the store: data-dependent counts (filters,
                         # ragged tail chunks) mint fresh keys per run; drop
-                        # oldest entries past the cap (insertion-ordered).
+                        # oldest entries past the cap.
                         while len(hint_store) > 4096:
                             hint_store.pop(next(iter(hint_store)))
                     return outs, out_cap
@@ -2350,7 +2359,10 @@ class _JoinRDD(_ExchangeRDD):
                                          hint_key=hint)
             jcounts = outs[0]
         if jc_key is not None and join_cap_override[0]:
+            hint_store.pop(jc_key, None)  # move-to-end (see _run_exchange)
             hint_store[jc_key] = join_cap_override[0]
+            while len(hint_store) > 4096:
+                hint_store.pop(next(iter(hint_store)))
         key_arrays = outs[2:2 + len(key_names)]
         jlv, jrv = outs[2 + len(key_names):4 + len(key_names)]
         cols = dict(zip(key_names, key_arrays))
@@ -2401,20 +2413,43 @@ class _SortByKeyRDD(_ExchangeRDD):
         composite = lo_name is not None
         counts_host = blk.counts_np
 
-        # Driver-side bound sampling (tiny transfer): strided sample per shard.
+        # Bound sampling: ONE device program gathers a strided sample per
+        # shard into a fixed [n_shards, 2m] buffer, fetched in a single
+        # transfer — the per-shard host slicing this replaces cost one
+        # driver<->device round trip PER SHARD (n RTTs through the
+        # tunnel). Validity is recomputed host-side from counts (free).
+        m = max(1, self.sample_size // max(1, blk.n_shards))
+
+        def samp_fn(counts_arg, *keycols):
+            count = counts_arg[0]
+            stride = jnp.maximum(jnp.int32(1), count // jnp.int32(m))
+            pos = jnp.clip(lax.iota(jnp.int32, 2 * m) * stride,
+                           0, max(blk.capacity - 1, 0))
+            return tuple(jnp.take(kc, pos).reshape(1, -1) for kc in keycols)
+
+        samp_prog = _cached_program(
+            ("sortsamp", self.mesh, m, blk.capacity, composite),
+            lambda: _shard_program(
+                self.mesh, samp_fn, 2 + composite,
+                (_SPEC,) * (1 + composite),
+            ),
+        )
+        key_cols_dev = ((blk.cols[KEY], blk.cols[KEY_LO]) if composite
+                        else (blk.cols[KEY],))
+        samp_out = jax.device_get(samp_prog(blk.counts, *key_cols_dev))
+        samp_hi = np.asarray(samp_out[0]).reshape(blk.n_shards, 2 * m)
+        if composite:
+            samp_lo = np.asarray(samp_out[1]).reshape(blk.n_shards, 2 * m)
         samples = []
         for s in range(blk.n_shards):
             c = int(counts_host[s])
             if c == 0:
                 continue
-            stride = max(1, c // max(1, self.sample_size // blk.n_shards))
-            lo = s * blk.capacity
-            keys = np.asarray(jax.device_get(blk.cols[KEY][lo:lo + c:stride]))
+            stride = max(1, c // m)
+            n_valid = min(2 * m, -(-c // stride))
+            keys = samp_hi[s, :n_valid]
             if composite:
-                lo_words = np.asarray(
-                    jax.device_get(blk.cols[KEY_LO][lo:lo + c:stride])
-                )
-                keys = block_lib.decode_i64(keys, lo_words)
+                keys = block_lib.decode_i64(keys, samp_lo[s, :n_valid])
             samples.append(keys)
         if samples:
             allk = np.sort(np.concatenate(samples))
