@@ -1184,9 +1184,9 @@ def test_capacity_hints_skip_histogram_on_rerun(dctx, monkeypatch):
     calls = {"n": 0}
     real = dr._ExchangeRDD._hash_histogram
 
-    def counting(self, blk):
+    def counting(self, blk, chain=()):
         calls["n"] += 1
-        return real(self, blk)
+        return real(self, blk, chain)
 
     monkeypatch.setattr(dr._ExchangeRDD, "_hash_histogram", counting)
 
@@ -1224,3 +1224,31 @@ def test_capacity_hint_overflow_falls_back_to_histogram(dctx):
                    for k in range(n_keys)}
     # the bad hint was replaced by working capacities
     assert dctx._dense_capacity_hints[key] != (128, 128)
+
+
+def test_narrow_chain_fuses_into_exchange(dctx):
+    """A pending map/filter chain above reduce/group rides the exchange
+    program: the intermediate narrow block is never materialized (one
+    launch instead of two, no intermediate HBM block)."""
+    kv = dctx.dense_range(10_000).map(lambda x: (x % 50, x))
+    red = kv.reduce_by_key(op="add")
+    got = dict(red.collect())
+    assert got == {k: sum(x for x in range(10_000) if x % 50 == k)
+                   for k in range(50)}
+    assert kv._block is None  # fused, not materialized
+
+    kv2 = dctx.dense_range(1_000).map(lambda x: (x % 7, x)).filter(
+        lambda kv: kv[1] % 2 == 0
+    )
+    grouped = dict(kv2.group_by_key().collect())
+    assert grouped == {
+        k: [x for x in range(0, 1_000, 2) if x % 7 == k] for k in range(7)
+    }
+    assert kv2._block is None
+
+    # a chain shared with another consumer materializes for that consumer
+    # and the exchange then uses the materialized block as its root
+    kv3 = dctx.dense_range(1_000).map(lambda x: (x % 3, x))
+    assert kv3.count() == 1_000  # materializes kv3
+    assert kv3._block is not None
+    assert dict(kv3.reduce_by_key(op="min").collect()) == {0: 0, 1: 1, 2: 2}

@@ -1043,15 +1043,9 @@ class _NarrowRDD(DenseRDD):
 
     def _materialize(self) -> Block:
         # Collect the narrow chain down to the nearest materialization
-        # root (a non-narrow node, an already-materialized block, or a
-        # chain-breaking expansion node).
-        chain: List[_NarrowRDD] = [self]
-        root = self.parent
-        while isinstance(root, _NarrowRDD) and root._block is None \
-                and root._chainable:
-            chain.append(root)
-            root = root.parent
-        chain.reverse()
+        # root via the shared walk (exchange fusion uses the same one, so
+        # the two sites cannot disagree about what a chain is).
+        chain, root = _narrow_chain(self)
         root_block = root.block()
         names = list(root_block.cols)
         out_names = [n for n, _ in self._out_schema]
@@ -1059,13 +1053,11 @@ class _NarrowRDD(DenseRDD):
 
         def fused(counts, *col_arrays):
             cols = dict(zip(names, col_arrays))
-            count = counts[0]
-            for node in chain:
-                cols, count = node._shard_fn(cols, count)
+            cols, count = _apply_chain(chain, cols, counts[0])
             return (count.reshape(1),) + tuple(cols[n] for n in out_names)
 
         key = ("narrow", self.mesh, tuple(names), tuple(out_names),
-               tuple(node._node_fp() for node in chain))
+               _chain_fp(chain))
         prog = _cached_program(
             key,
             lambda: _shard_program(
@@ -1749,6 +1741,34 @@ def _lo_of(names) -> Optional[str]:
     return KEY_LO if KEY_LO in names else None
 
 
+def _narrow_chain(node):
+    """(chain, root) where chain is the longest not-yet-materialized
+    chainable narrow run ending at `node` (possibly empty) and root is the
+    nearest materialization point above it. Exchanges fuse the chain into
+    their own program: the map/filter work runs inside the exchange launch
+    (one launch instead of two, no intermediate block in HBM) — XLA-style
+    rematerialization applied to the lineage. A chain parent that was
+    already materialized (shared by another consumer) is used as-is."""
+    chain: List[_NarrowRDD] = []
+    cur = node
+    while isinstance(cur, _NarrowRDD) and cur._block is None \
+            and cur._chainable:
+        chain.append(cur)
+        cur = cur.parent
+    chain.reverse()
+    return chain, cur
+
+
+def _apply_chain(chain, cols, count):
+    for nd in chain:
+        cols, count = nd._shard_fn(cols, count)
+    return cols, count
+
+
+def _chain_fp(chain) -> tuple:
+    return tuple(nd._node_fp() for nd in chain)
+
+
 def _bucket_cols(cols, n: int) -> jax.Array:
     """Hash-bucket rows by key, two-column int64 keys included. The
     composite hash mixes BOTH words (hash32_pair) so placement keeps its
@@ -1778,32 +1798,40 @@ class _ExchangeRDD(DenseRDD):
     def exchange_mode(self, mode: str) -> None:
         self._exchange_mode = mode
 
-    def _hash_histogram(self, blk: Block) -> Optional[np.ndarray]:
+    def _hash_histogram(self, blk: Block,
+                        chain=()) -> Optional[np.ndarray]:
         """One cheap counting pass over the keys: hist[s, t] = rows shard s
         will send to target t under hash bucketing. Costs a hash + bincount
         per shard (no sort, no value movement) and one tiny [n, n]
-        transfer; buys exactly-sized exchange capacities."""
+        transfer; buys exactly-sized exchange capacities. `chain` is a
+        fused narrow run applied to the root block's columns first (the
+        exchange recomputes it too — cheaper than materializing)."""
         n = self.mesh.size
         if n == 1:
             return None
-        composite = KEY_LO in blk.cols
+        chain = chain or ()
+        # Without a fused chain the histogram only needs the key columns:
+        # keep the program universal across value schemas (one compile)
+        # and skip staging value columns it never reads.
+        if chain:
+            in_names = list(blk.cols)
+        else:
+            in_names = [KEY] + ([KEY_LO] if KEY_LO in blk.cols else [])
 
-        def prog_fn(counts, *keys):
-            cap = keys[0].shape[0]
-            kcols = {KEY: keys[0]}
-            if composite:
-                kcols[KEY_LO] = keys[1]
-            bucket = _bucket_cols(kcols, n)
-            bucket = jnp.where(kernels.valid_mask(cap, counts[0]), bucket, n)
+        def prog_fn(counts, *col_arrays):
+            cols = dict(zip(in_names, col_arrays))
+            cols, count = _apply_chain(chain, cols, counts[0])
+            cap = cols[KEY].shape[0]
+            bucket = _bucket_cols(cols, n)
+            bucket = jnp.where(kernels.valid_mask(cap, count), bucket, n)
             return jnp.bincount(bucket, length=n + 1)[:n].astype(jnp.int32)
 
         prog = _cached_program(
-            ("hash_hist", self.mesh, n, composite),
-            lambda: _shard_program(self.mesh, prog_fn, 2 + composite, _SPEC),
+            ("hash_hist", self.mesh, n, tuple(in_names), _chain_fp(chain)),
+            lambda: _shard_program(self.mesh, prog_fn, 1 + len(in_names),
+                                   _SPEC),
         )
-        key_arrays = [blk.cols[KEY]] + ([blk.cols[KEY_LO]] if composite
-                                        else [])
-        out = prog(blk.counts, *key_arrays)
+        out = prog(blk.counts, *[blk.cols[nm] for nm in in_names])
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
     def _range_histogram(self, blk: Block, bounds_dev,
@@ -2024,9 +2052,16 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         )
 
     def _materialize(self) -> Block:
-        blk = self.parent.block()
+        # Fuse any pending narrow chain above the exchange into its own
+        # program: the map/filter work rides the exchange launch instead
+        # of materializing an intermediate block (one launch saved + no
+        # intermediate HBM traffic; the sizing histogram recomputes the
+        # chain — narrow work is cheap VPU math by construction).
+        chain, root = _narrow_chain(self.parent)
+        blk = root.block()
         n = self.mesh.size
-        names = list(blk.cols)
+        in_names = list(blk.cols)
+        names = [nm for nm, _ in self.parent._schema()]
         counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
         # Partitioner-equality elision, device edition: a hash-placed
@@ -2040,8 +2075,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
-                cols = dict(zip(names, col_arrays))
-                count = counts[0]
+                cols = dict(zip(in_names, col_arrays))
+                cols, count = _apply_chain(chain, cols, counts[0])
                 if n > 1 and not elide:
                     # 2-sort exchange: ONE multi-key sort (bucket major,
                     # key minor) feeds both the presorted map-side combine
@@ -2082,17 +2117,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
-            key = ("rbk", self.mesh, tuple(names), n, slot, out_cap, elide,
-                   elide_sorted,
+            key = ("rbk", self.mesh, tuple(in_names), tuple(names),
+                   _chain_fp(chain), n, slot, out_cap, elide, elide_sorted,
                    self.exchange_mode, self._op or _fp(self._func))
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
-                    self.mesh, prog_fn, 1 + len(names),
+                    self.mesh, prog_fn, 1 + len(in_names),
                     (_SPEC,) * (2 + len(names)),
                 ),
             )
-            return prog, (blk.counts, *[blk.cols[nm] for nm in names])
+            return prog, (blk.counts, *[blk.cols[nm] for nm in in_names])
 
         # Elided: rows stay put, so the exact "histogram" is the diagonal
         # (shard s keeps counts[s] rows) — one attempt, exact out capacity;
@@ -2107,7 +2142,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         else:
             outs, out_cap = self._run_exchange(
                 build, counts_host,
-                make_hists=lambda: ([self._hash_histogram(blk)], None),
+                make_hists=lambda: ([self._hash_histogram(blk, chain)],
+                                    None),
                 hint_key=self._hint_key(counts_host),
             )
         counts, col_arrays = outs[0], outs[1:]
@@ -2133,9 +2169,11 @@ class _GroupByKeyRDD(_ExchangeRDD):
         return (self.exchange_mode,)
 
     def _materialize(self) -> Block:
-        blk = self.parent.block()
+        chain, root = _narrow_chain(self.parent)  # fused (see reduce)
+        blk = root.block()
         n = self.mesh.size
-        names = list(blk.cols)
+        in_names = list(blk.cols)
+        names = [nm for nm, _ in self.parent._schema()]
         counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
         elide = self.parent.hash_placed and n > 1  # rows already placed
@@ -2143,8 +2181,8 @@ class _GroupByKeyRDD(_ExchangeRDD):
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
-                cols = dict(zip(names, col_arrays))
-                count = counts[0]
+                cols = dict(zip(in_names, col_arrays))
+                cols, count = _apply_chain(chain, cols, counts[0])
                 if elide:
                     cols, count, overflow = kernels.passthrough_exchange(
                         cols, count, cols[KEY].shape[0], out_cap
@@ -2162,16 +2200,17 @@ class _GroupByKeyRDD(_ExchangeRDD):
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
-            key = ("gbk", self.mesh, tuple(names), n, slot, out_cap, elide,
+            key = ("gbk", self.mesh, tuple(in_names), tuple(names),
+                   _chain_fp(chain), n, slot, out_cap, elide,
                    elide_sorted, self.exchange_mode)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
-                    self.mesh, prog_fn, 1 + len(names),
+                    self.mesh, prog_fn, 1 + len(in_names),
                     (_SPEC,) * (2 + len(names)),
                 ),
             )
-            return prog, (blk.counts, *[blk.cols[nm] for nm in names])
+            return prog, (blk.counts, *[blk.cols[nm] for nm in in_names])
 
         self._elided = elide
         if elide:
@@ -2183,7 +2222,8 @@ class _GroupByKeyRDD(_ExchangeRDD):
         else:
             outs, out_cap = self._run_exchange(
                 build, counts_host,
-                make_hists=lambda: ([self._hash_histogram(blk)], None),
+                make_hists=lambda: ([self._hash_histogram(blk, chain)],
+                                    None),
                 hint_key=self._hint_key(counts_host),
             )
         counts, col_arrays = outs[0], outs[1:]
