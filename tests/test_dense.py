@@ -1252,3 +1252,25 @@ def test_narrow_chain_fuses_into_exchange(dctx):
     assert kv3.count() == 1_000  # materializes kv3
     assert kv3._block is not None
     assert dict(kv3.reduce_by_key(op="min").collect()) == {0: 0, 1: 1, 2: 2}
+
+
+def test_narrow_chain_fuses_into_join_and_sort(dctx):
+    """Chain fusion covers join sides and sort_by_key (sampling included):
+    the narrow parents never materialize and results match the host
+    tier — including a fused FILTER, whose post-chain counts drive the
+    sort's stride/validity math."""
+    lk = dctx.dense_range(5_000).map(lambda x: (x % 100, x))
+    rk = dctx.dense_range(100).map(lambda x: (x, x * 2))
+    j = lk.join(rk)
+    got = sorted(j.collect())
+    exp = sorted((x % 100, (x, (x % 100) * 2)) for x in range(5_000))
+    assert got == exp
+    assert lk._block is None and rk._block is None  # fused
+
+    sk = (dctx.dense_range(10_000).map(lambda x: (x * 7919 % 10_000, x))
+          .filter(lambda kv: kv[0] % 2 == 0))
+    s = sk.sort_by_key()
+    keys = [k for k, _ in s.collect()]
+    assert keys == sorted(k for k in (x * 7919 % 10_000
+                                      for x in range(10_000)) if k % 2 == 0)
+    assert sk._block is None  # fused through sampling + exchange

@@ -108,8 +108,10 @@ class Block:
         int64 keys (KEY_LO) come back as one int64 KEY column — host-facing
         consumers never see the encoding."""
         counts = self.counts_np
-        host_cols = {name: np.asarray(jax.device_get(col))
-                     for name, col in self.cols.items()}
+        # One transfer for every column (a separate device_get per column
+        # is a round trip each through the axon tunnel).
+        host_cols = {name: np.asarray(c) for name, c in
+                     jax.device_get(dict(self.cols)).items()}
         out: Dict[str, List[np.ndarray]] = {n: [] for n in self.cols}
         for s in range(self.n_shards):
             lo = s * self.capacity
@@ -124,10 +126,12 @@ class Block:
         counts = self.counts_np
         lo = shard * self.capacity
         c = int(counts[shard])
-        return _decode_key_cols({
-            name: np.asarray(jax.device_get(col[lo:lo + c]))
-            for name, col in self.cols.items()
-        })
+        sliced = jax.device_get(
+            {name: col[lo:lo + c] for name, col in self.cols.items()}
+        )  # one transfer for all columns
+        return _decode_key_cols(
+            {name: np.asarray(col) for name, col in sliced.items()}
+        )
 
 
 def _round_capacity(c: int) -> int:

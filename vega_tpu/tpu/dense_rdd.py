@@ -181,8 +181,8 @@ class DenseRDD(RDD):
                 "rdd_id": self.rdd_id,
                 "should_cache": self.should_cache,
                 "_pinned": self._pinned,
-                "cols": {n: np.asarray(jax.device_get(c))
-                         for n, c in blk.cols.items()},
+                "cols": {n: np.asarray(c) for n, c in
+                         jax.device_get(dict(blk.cols)).items()},
                 "counts": blk.counts_np,
                 "capacity": blk.capacity,
             }
@@ -1835,37 +1835,47 @@ class _ExchangeRDD(DenseRDD):
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
     def _range_histogram(self, blk: Block, bounds_dev,
-                         ascending: bool,
-                         bounds_lo_dev=None) -> Optional[np.ndarray]:
+                         ascending: bool, bounds_lo_dev=None,
+                         chain=()) -> Optional[np.ndarray]:
         """Destination histogram under range partitioning (sort_by_key).
         bounds_lo_dev carries the low-word bounds of two-column int64
-        keys."""
+        keys; `chain` is a fused narrow run applied first."""
         n = self.mesh.size
         if n == 1:
             return None
         composite = bounds_lo_dev is not None
+        chain = chain or ()
+        if chain:
+            in_names = list(blk.cols)
+        else:
+            in_names = [KEY] + ([KEY_LO] if composite else [])
 
         def prog_fn(*args):
-            if composite:
-                bnds, bnds_lo, counts, keys, keys_lo = args
-            else:
-                (bnds, counts, keys), bnds_lo, keys_lo = args, None, None
+            n_bounds = 1 + composite
+            bnds = args[0]
+            bnds_lo = args[1] if composite else None
+            counts = args[n_bounds]
+            cols = dict(zip(in_names, args[n_bounds + 1:]))
+            cols, count = _apply_chain(chain, cols, counts[0])
+            keys = cols[KEY]
             cap = keys.shape[0]
-            bucket = kernels.range_bucket(bnds, keys, ascending,
-                                          bounds_lo=bnds_lo,
-                                          keys_lo=keys_lo)
-            bucket = jnp.where(kernels.valid_mask(cap, counts[0]), bucket, n)
+            bucket = kernels.range_bucket(
+                bnds, keys, ascending, bounds_lo=bnds_lo,
+                keys_lo=cols[KEY_LO] if composite else None,
+            )
+            bucket = jnp.where(kernels.valid_mask(cap, count), bucket, n)
             return jnp.bincount(bucket, length=n + 1)[:n].astype(jnp.int32)
 
         in_specs = ((_REPL,) * (1 + composite)
-                    + (_SPEC,) * (2 + composite))
+                    + (_SPEC,) * (1 + len(in_names)))
         prog = _cached_program(
-            ("range_hist", self.mesh, n, ascending, composite),
+            ("range_hist", self.mesh, n, ascending, composite,
+             tuple(in_names), _chain_fp(chain)),
             lambda: _shard_program(self.mesh, prog_fn, in_specs, _SPEC),
         )
         args = ((bounds_dev,) + ((bounds_lo_dev,) if composite else ())
-                + (blk.counts, blk.cols[KEY])
-                + ((blk.cols[KEY_LO],) if composite else ()))
+                + (blk.counts,)
+                + tuple(blk.cols[nm] for nm in in_names))
         out = prog(*args)
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
@@ -2052,18 +2062,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         )
 
     def _materialize(self) -> Block:
-        # Fuse any pending narrow chain above the exchange into its own
-        # program: the map/filter work rides the exchange launch instead
-        # of materializing an intermediate block (one launch saved + no
-        # intermediate HBM traffic; the sizing histogram recomputes the
-        # chain — narrow work is cheap VPU math by construction).
-        chain, root = _narrow_chain(self.parent)
-        blk = root.block()
         n = self.mesh.size
-        in_names = list(blk.cols)
-        names = [nm for nm, _ in self.parent._schema()]
-        counts_host = blk.counts_np
-        exchange = _get_exchange(self.exchange_mode)
         # Partitioner-equality elision, device edition: a hash-placed
         # parent already has every key's rows on their reducer shard, so
         # the whole exchange (hash + multi-key sort + collective)
@@ -2072,6 +2071,22 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # Order survives the elided passthrough's stable compact, letting
         # the reduce run presorted (no sort at all in reduce-of-reduce).
         elide_sorted = elide and self.parent.key_sorted
+        # Fuse any pending narrow chain above the exchange into its own
+        # program: the map/filter work rides the exchange launch instead
+        # of materializing an intermediate block (one launch saved + no
+        # intermediate HBM traffic; the sizing histogram recomputes the
+        # chain — narrow work is cheap VPU math by construction). Fusion
+        # only applies when a real exchange sizes itself from a histogram
+        # of post-chain rows: elided and single-shard paths size from raw
+        # counts, so a fused FILTER would leave them permanently
+        # oversized — those materialize the parent as before.
+        chain, root = (_narrow_chain(self.parent) if n > 1 and not elide
+                       else ([], self.parent))
+        blk = root.block()
+        in_names = list(blk.cols)
+        names = [nm for nm, _ in self.parent._schema()]
+        counts_host = blk.counts_np
+        exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -2169,15 +2184,18 @@ class _GroupByKeyRDD(_ExchangeRDD):
         return (self.exchange_mode,)
 
     def _materialize(self) -> Block:
-        chain, root = _narrow_chain(self.parent)  # fused (see reduce)
-        blk = root.block()
         n = self.mesh.size
+        elide = self.parent.hash_placed and n > 1  # rows already placed
+        elide_sorted = elide and self.parent.key_sorted
+        # Fused only on the real-exchange path (see reduce: elided/1-shard
+        # sizing uses raw counts, which a fused filter would inflate).
+        chain, root = (_narrow_chain(self.parent) if n > 1 and not elide
+                       else ([], self.parent))
+        blk = root.block()
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
         counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
-        elide = self.parent.hash_placed and n > 1  # rows already placed
-        elide_sorted = elide and self.parent.key_sorted
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -2284,28 +2302,39 @@ class _JoinRDD(_ExchangeRDD):
         return key_schema + (("lv", ls[VALUE]), ("rv", rs[VALUE]))
 
     def _materialize(self) -> Block:
-        lblk = self.left.block()
-        rblk = self.right.block()
         n = self.mesh.size
-        l_counts = lblk.counts_np
-        r_counts = rblk.counts_np
-        exchange = _get_exchange(self.exchange_mode)
-        # Key layout is aligned by _align_keys before a _JoinRDD is built:
-        # both sides carry the same key columns (single, or (KEY, KEY_LO)).
-        key_names = [KEY] + ([KEY_LO] if KEY_LO in lblk.cols else [])
-        lo_name = _lo_of(lblk.cols)
         # Per-side exchange elision: a hash-placed side's rows are already
         # on their key's shard (reduce/group/join outputs), so only the
         # other side moves — the north-star reduced.join(table) pipeline
         # pays ONE collective instead of two.
         l_elide = self.left.hash_placed and n > 1
         r_elide = self.right.hash_placed and n > 1
+        # Pending narrow chains fuse into the join program (same
+        # rematerialization trade as reduce/group) — only on sides whose
+        # exchange sizes from a post-chain histogram; elided/1-shard
+        # sides size from raw counts and materialize as before.
+        l_chain, l_root = (_narrow_chain(self.left)
+                           if n > 1 and not l_elide else ([], self.left))
+        r_chain, r_root = (_narrow_chain(self.right)
+                           if n > 1 and not r_elide else ([], self.right))
+        lblk = l_root.block()
+        rblk = r_root.block()
+        l_counts = lblk.counts_np
+        r_counts = rblk.counts_np
+        l_in = list(lblk.cols)
+        r_in = list(rblk.cols)
+        exchange = _get_exchange(self.exchange_mode)
+        # Key layout is aligned by _align_keys before a _JoinRDD is built:
+        # both sides carry the same key columns (single, or (KEY, KEY_LO)).
+        lschema = dict(self.left._schema())
+        key_names = [KEY] + ([KEY_LO] if KEY_LO in lschema else [])
+        lo_name = KEY_LO if KEY_LO in lschema else None
         # Sortedness survives only the elided (stable passthrough) path.
         l_sorted = l_elide and self.left.key_sorted
         r_sorted = r_elide and self.right.key_sorted
         join_cap_override: List[Optional[int]] = [None]
         join_cap_used: List[int] = [0]
-        n_side = 1 + len(key_names) + 1  # counts + key cols + value
+        n_l = 1 + len(l_in)  # counts + left root columns
 
         def one_side(cols, count, elide, slot_pair, out_cap):
             if elide:
@@ -2321,15 +2350,19 @@ class _JoinRDD(_ExchangeRDD):
             join_cap_used[0] = join_cap
 
             def prog_fn(*args):
-                lc, *lkv = args[:n_side]
-                rc, *rkv = args[n_side:]
-                lcols = dict(zip(key_names + [VALUE], lkv))
-                rcols = dict(zip(key_names + [VALUE], rkv))
+                lc, *lkv = args[:n_l]
+                rc, *rkv = args[n_l:]
+                lcols, lcount = _apply_chain(
+                    l_chain, dict(zip(l_in, lkv)), lc[0]
+                )
+                rcols, rcount = _apply_chain(
+                    r_chain, dict(zip(r_in, rkv)), rc[0]
+                )
                 lcols, lcount, lof = one_side(
-                    lcols, lc[0], l_elide, slot_pair, out_cap
+                    lcols, lcount, l_elide, slot_pair, out_cap
                 )
                 rcols, rcount, rof = one_side(
-                    rcols, rc[0], r_elide, slot_pair, out_cap
+                    rcols, rcount, r_elide, slot_pair, out_cap
                 )
                 joined, jcount, jtotal = kernels.merge_join_expand(
                     lcols, lcount, rcols, rcount, KEY, join_cap,
@@ -2345,17 +2378,18 @@ class _JoinRDD(_ExchangeRDD):
                 )
 
             prog = _cached_program(
-                ("join", self.mesh, n, tuple(key_names), slot_pair, out_cap,
+                ("join", self.mesh, n, tuple(key_names), tuple(l_in),
+                 tuple(r_in), _chain_fp(l_chain), _chain_fp(r_chain),
+                 slot_pair, out_cap,
                  join_cap, l_elide, r_elide, l_sorted, r_sorted,
-                 self.exchange_mode, self.outer, self.fill_value),
-                lambda: _shard_program(self.mesh, prog_fn, 2 * n_side,
+                 self.exchange_mode, self.outer, repr(self.fill_value)),
+                lambda: _shard_program(self.mesh, prog_fn,
+                                       2 + len(l_in) + len(r_in),
                                        (_SPEC,) * (5 + len(key_names))),
             )
             return prog, (
-                lblk.counts, *[lblk.cols[nm] for nm in key_names],
-                lblk.cols[VALUE],
-                rblk.counts, *[rblk.cols[nm] for nm in key_names],
-                rblk.cols[VALUE],
+                lblk.counts, *[lblk.cols[nm] for nm in l_in],
+                rblk.counts, *[rblk.cols[nm] for nm in r_in],
             )
 
         counts = np.concatenate([l_counts, r_counts])
@@ -2364,8 +2398,10 @@ class _JoinRDD(_ExchangeRDD):
 
         def make_hists():
             hs = [
-                np.diag(l_counts) if l_elide else self._hash_histogram(lblk),
-                np.diag(r_counts) if r_elide else self._hash_histogram(rblk),
+                np.diag(l_counts) if l_elide
+                else self._hash_histogram(lblk, l_chain),
+                np.diag(r_counts) if r_elide
+                else self._hash_histogram(rblk, r_chain),
             ]
             # Elided (diag) sides never send: keep them out of slot sizing.
             return hs, [h for h, el in zip(hs, (l_elide, r_elide))
@@ -2446,40 +2482,58 @@ class _SortByKeyRDD(_ExchangeRDD):
         return self.parent._schema()
 
     def _materialize(self) -> Block:
-        blk = self.parent.block()
         n = self.mesh.size
-        names = list(blk.cols)
-        lo_name = _lo_of(blk.cols)
+        # Fused only on the multi-shard path (1-shard sizing uses raw
+        # counts; see reduce). The range exchange itself never elides.
+        chain, root = (_narrow_chain(self.parent) if n > 1
+                       else ([], self.parent))
+        blk = root.block()
+        in_names = list(blk.cols)
+        names = [nm for nm, _ in self.parent._schema()]
+        lo_name = _lo_of(names)
         composite = lo_name is not None
-        counts_host = blk.counts_np
+        # Sampler inputs: key columns only when no chain is fused (one
+        # universal compile across value schemas, like the histograms).
+        samp_in = (in_names if chain
+                   else [KEY] + ([KEY_LO] if composite else []))
 
-        # Bound sampling: ONE device program gathers a strided sample per
-        # shard into a fixed [n_shards, 2m] buffer, fetched in a single
+        # Bound sampling: ONE device program applies the fused chain and
+        # gathers a strided sample per shard into a fixed [n_shards, 2m]
+        # buffer, fetched with the post-chain shard counts in a single
         # transfer — the per-shard host slicing this replaces cost one
         # driver<->device round trip PER SHARD (n RTTs through the
-        # tunnel). Validity is recomputed host-side from counts (free).
+        # tunnel). Post-chain counts also size the exchange exactly when
+        # the chain filters rows.
         m = max(1, self.sample_size // max(1, blk.n_shards))
 
-        def samp_fn(counts_arg, *keycols):
-            count = counts_arg[0]
+        def samp_fn(counts_arg, *col_arrays):
+            cols, count = _apply_chain(
+                chain, dict(zip(samp_in, col_arrays)), counts_arg[0]
+            )
+            keycols = ((cols[KEY], cols[lo_name]) if composite
+                       else (cols[KEY],))
             stride = jnp.maximum(jnp.int32(1), count // jnp.int32(m))
             pos = jnp.clip(lax.iota(jnp.int32, 2 * m) * stride,
                            0, max(blk.capacity - 1, 0))
-            return tuple(jnp.take(kc, pos).reshape(1, -1) for kc in keycols)
+            return (count.reshape(1),) + tuple(
+                jnp.take(kc, pos).reshape(1, -1) for kc in keycols
+            )
 
         samp_prog = _cached_program(
-            ("sortsamp", self.mesh, m, blk.capacity, composite),
+            ("sortsamp", self.mesh, m, blk.capacity, composite,
+             tuple(samp_in), _chain_fp(chain)),
             lambda: _shard_program(
-                self.mesh, samp_fn, 2 + composite,
-                (_SPEC,) * (1 + composite),
+                self.mesh, samp_fn, 1 + len(samp_in),
+                (_SPEC,) * (2 + composite),
             ),
         )
-        key_cols_dev = ((blk.cols[KEY], blk.cols[KEY_LO]) if composite
-                        else (blk.cols[KEY],))
-        samp_out = jax.device_get(samp_prog(blk.counts, *key_cols_dev))
-        samp_hi = np.asarray(samp_out[0]).reshape(blk.n_shards, 2 * m)
+        samp_out = jax.device_get(
+            samp_prog(blk.counts, *[blk.cols[nm] for nm in samp_in])
+        )
+        counts_host = np.asarray(samp_out[0]).reshape(-1)
+        samp_hi = np.asarray(samp_out[1]).reshape(blk.n_shards, 2 * m)
         if composite:
-            samp_lo = np.asarray(samp_out[1]).reshape(blk.n_shards, 2 * m)
+            samp_lo = np.asarray(samp_out[2]).reshape(blk.n_shards, 2 * m)
         samples = []
         for s in range(blk.n_shards):
             c = int(counts_host[s])
@@ -2500,8 +2554,8 @@ class _SortByKeyRDD(_ExchangeRDD):
         elif composite:
             bounds = np.zeros((n - 1,), np.int64)
         else:
-            bounds = np.zeros((n - 1,), np.asarray(
-                jax.device_get(blk.cols[KEY][:1])).dtype)
+            bounds = np.zeros((n - 1,),
+                              np.dtype(dict(self.parent._schema())[KEY]))
         if composite:
             bounds_hi, bounds_lo = block_lib.encode_i64(bounds)
             bounds_dev = jnp.asarray(bounds_hi)
@@ -2518,8 +2572,9 @@ class _SortByKeyRDD(_ExchangeRDD):
                     bnds, bnds_lo, counts, *col_arrays = args
                 else:
                     (bnds, counts, *col_arrays), bnds_lo = args, None
-                cols = dict(zip(names, col_arrays))
-                count = counts[0]
+                cols, count = _apply_chain(
+                    chain, dict(zip(in_names, col_arrays)), counts[0]
+                )
                 keys = cols[KEY]
                 if n == 1:
                     bucket = jnp.zeros_like(keys, shape=keys.shape).astype(jnp.int32)
@@ -2539,25 +2594,28 @@ class _SortByKeyRDD(_ExchangeRDD):
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
-            key = ("sort", self.mesh, tuple(names), n, slot, out_cap,
+            key = ("sort", self.mesh, tuple(in_names), tuple(names),
+                   _chain_fp(chain), n, slot, out_cap,
                    ascending, self.exchange_mode)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
                     self.mesh, prog_fn,
-                    (_REPL,) * (1 + composite) + (_SPEC,) * (1 + len(names)),
+                    (_REPL,) * (1 + composite)
+                    + (_SPEC,) * (1 + len(in_names)),
                     (_SPEC,) * (2 + len(names)),
                 ),
             )
             dev_bounds = ((bounds_dev, bounds_lo_dev) if composite
                           else (bounds_dev,))
             return prog, (*dev_bounds, blk.counts,
-                          *[blk.cols[nm] for nm in names])
+                          *[blk.cols[nm] for nm in in_names])
 
         outs, out_cap = self._run_exchange(
             build, counts_host,
             make_hists=lambda: ([self._range_histogram(
-                blk, bounds_dev, ascending, bounds_lo_dev)], None),
+                blk, bounds_dev, ascending, bounds_lo_dev,
+                chain=chain)], None),
             # Bounds are data-derived: same data -> same bounds, and a
             # changed distribution changes the bounds, so they belong in
             # the hint identity.
