@@ -41,27 +41,101 @@ def host_pipeline(ctx, n_rows: int, n_keys: int, partitions: int = 8):
     return reduced.join(table).count()
 
 
-def _arm_watchdog(seconds: float):
-    """Device init can hang if the TPU tunnel is unhealthy; always emit a
-    JSON line so the harness records the failure instead of timing out."""
+import threading
+
+# One-JSON-line contract: the measured path, the stall-rescue watchdog, and
+# the zeros watchdog all race to print; whoever claims the gate first is the
+# ONLY printer (a watchdog that fires while the main thread is finishing —
+# or vice versa — must not produce a second line).
+_PRINT_GATE = threading.Lock()
+_print_claimed = False
+
+
+def _claim_output() -> bool:
+    global _print_claimed
+    with _PRINT_GATE:
+        if _print_claimed:
+            return False
+        _print_claimed = True
+        return True
+
+
+def _emit_cpu_fallback(budget_s: float, reason: str) -> int:
+    """Re-run this script as a CPU-backend child and re-emit its JSON line.
+
+    Used when the accelerator tunnel is wedged (failed init probe, or a
+    mid-run stall — the tunnel historically answers in short windows and
+    can wedge between a healthy probe and the measured run). Caller must
+    hold the output claim. The parent re-emits the child's line, or an
+    error line if the child produced none, and both land within budget_s
+    even if the child wedges before arming its own watchdog (the
+    subprocess timeout is inside budget_s). Popping PALLAS_AXON_POOL_IPS
+    is what actually disarms the axon plugin in the child;
+    JAX_PLATFORMS=cpu alone does not (see _cpu_mesh.py)."""
     import os
-    import threading
+
+    env = dict(os.environ, VEGA_BENCH_CPU_FALLBACK="1", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # CPU-sized workload, even when the parent was asked for TPU scale.
+    env["VEGA_BENCH_SCALE"] = str(
+        min(float(os.environ.get("VEGA_BENCH_SCALE", "1.0")), 0.25))
+    env["VEGA_BENCH_TIMEOUT_S"] = str(max(60.0, budget_s - 40))
+    script = globals().get("__file__") or sys.argv[0]
+    try:
+        child = subprocess.run(
+            [sys.executable, script], env=env,
+            capture_output=True, text=True, timeout=max(70.0, budget_s - 10),
+        )
+        rc, out = child.returncode, child.stdout
+    except subprocess.TimeoutExpired as e:
+        # The child may have printed its result line before wedging in
+        # cleanup — salvage captured stdout rather than dropping it.
+        rc, out = 3, (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+    lines = [l for l in (out or "").splitlines() if l.strip()]
+    if lines:
+        print(lines[-1], flush=True)
+        return rc
+    return _zeros_line(f"{reason} and CPU fallback produced no result")
+
+
+def _arm_watchdog(seconds: float, on_fire):
+    """Arm a daemon timer that (if it wins the output claim) runs on_fire()
+    and exits the process. Device work can hang indefinitely when the TPU
+    tunnel wedges; a timer thread is the only reliable escape."""
+    import os
 
     def fire():
-        print(json.dumps({
-            "metric": "group_by+join rows/sec/chip",
-            "value": 0,
-            "unit": "rows/sec",
-            "vs_baseline": 0.0,
-            "error": f"watchdog: no result within {seconds}s "
-                     "(device backend hung?)",
-        }), flush=True)
-        os._exit(3)
+        if not _claim_output():
+            return  # main thread already printed (or is printing)
+        try:
+            rc = on_fire()
+        except BaseException:
+            # The claim is held: if this thread dies line-less the main
+            # thread (possibly parked in its claim-lost wait loop) would
+            # hang forever with no output. Zeros beat silence.
+            try:
+                rc = _zeros_line("watchdog rescue itself failed")
+            except BaseException:
+                rc = 3
+        os._exit(rc)
 
     timer = threading.Timer(seconds, fire)
     timer.daemon = True
     timer.start()
     return timer
+
+
+def _zeros_line(reason: str) -> int:
+    print(json.dumps({
+        "metric": "group_by+join rows/sec/chip",
+        "value": 0,
+        "unit": "rows/sec",
+        "vs_baseline": 0.0,
+        "error": reason,
+    }), flush=True)
+    return 3
 
 
 def _device_backend_healthy(probe_timeout_s: float = 180.0) -> bool:
@@ -81,61 +155,108 @@ def _device_backend_healthy(probe_timeout_s: float = 180.0) -> bool:
 def main():
     import os
 
+    t_start = time.time()
     budget = float(os.environ.get("VEGA_BENCH_TIMEOUT_S", "900"))
+    deadline = t_start + budget
+    on_fallback = os.environ.get("VEGA_BENCH_CPU_FALLBACK") == "1"
     # Probe only when the wedge-prone accelerator tunnel is in play; plain
     # CPU/TPU environments skip the duplicate runtime init entirely.
-    needs_probe = (os.environ.get("VEGA_BENCH_CPU_FALLBACK") != "1"
+    needs_probe = (not on_fallback
                    and bool(os.environ.get("PALLAS_AXON_POOL_IPS")))
-    probe_elapsed = 0.0
     if needs_probe:
         probe_budget = min(180.0, budget / 5)
-        probe_start = time.time()
         healthy = _device_backend_healthy(probe_budget)
-        probe_elapsed = time.time() - probe_start
         if not healthy:
-            # Device backend is wedged: re-run on the CPU backend so the
-            # harness still gets a real (clearly-labeled) measurement. The
-            # parent owns the one-JSON-line contract: it re-emits the
-            # child's line, or an error line if the child produced none.
-            env = dict(os.environ, VEGA_BENCH_CPU_FALLBACK="1",
-                       JAX_PLATFORMS="cpu")
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env.setdefault("VEGA_BENCH_SCALE", "0.25")  # CPU-sized workload
-            remaining = max(60.0, budget - (time.time() - probe_start) - 30)
-            env["VEGA_BENCH_TIMEOUT_S"] = str(remaining)
-            script = globals().get("__file__") or sys.argv[0]
-            try:
-                child = subprocess.run(
-                    [sys.executable, script], env=env,
-                    capture_output=True, text=True, timeout=remaining + 60,
-                )
-                lines = [l for l in child.stdout.splitlines() if l.strip()]
-            except subprocess.TimeoutExpired:
-                child, lines = None, []
-            if lines:
-                print(lines[-1], flush=True)
-                return 0 if child.returncode == 0 else child.returncode
-            print(json.dumps({
-                "metric": "group_by+join rows/sec/chip",
-                "value": 0,
-                "unit": "rows/sec",
-                "vs_baseline": 0.0,
-                "error": "device backend wedged and CPU fallback produced "
-                         "no result",
-            }), flush=True)
-            return 3
+            _claim_output()
+            return _emit_cpu_fallback(max(60.0, deadline - time.time() - 10),
+                                      "device backend wedged")
+
+    import jax as _jax
+
+    # Persistent compile cache on every backend: a flaky-tunnel TPU run
+    # that wedges after compiling still seeds the next attempt.
+    _jax.config.update("jax_compilation_cache_dir", "/tmp/vega_tpu_xla_cache")
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     import vega_tpu as v
 
-    # The watchdog's guaranteed-output deadline stays within the harness
-    # budget even after a slow-but-healthy probe.
-    watchdog = _arm_watchdog(max(60.0, budget - probe_elapsed - 10))
+    def _phase(msg):
+        print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+              file=sys.stderr, flush=True)
+
     scale = float(os.environ.get("VEGA_BENCH_SCALE", "1.0"))
     n_rows = max(1000, int(20_000_000 * scale))
     n_keys = min(n_rows, max(1000, int(1_000_000 * scale)))
 
+    # Watchdog choreography (all claim-gated, so exactly one JSON line
+    # lands whatever the interleaving):
+    #   - fallback child: a plain zeros watchdog is the last resort.
+    #   - axon-tunnel device path, before the device number exists: a
+    #     stall-rescue watchdog re-runs the bench as a CPU child — a real
+    #     measurement beats zeros when the tunnel wedges mid-run. Only the
+    #     tunnel can wedge; on plain backends a stall just means slow, and
+    #     a concurrent rescue child would fight the still-running main
+    #     thread for the single core.
+    #   - device path, after the device number is banked: a partial-result
+    #     watchdog that reports the banked device throughput even if the
+    #     (slow, pure-CPU) host baseline can't finish inside the budget.
+    if on_fallback or not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        watchdog = _arm_watchdog(
+            max(60.0, deadline - time.time() - 10),
+            lambda: _zeros_line(
+                f"watchdog: no result within {budget}s (backend hung?)"),
+        )
+    else:
+        rescue = max(120.0, min(300.0, budget / 3))
+        watchdog = _arm_watchdog(
+            max(60.0, deadline - time.time() - rescue - 10),
+            lambda: _emit_cpu_fallback(
+                max(60.0, deadline - time.time() - 10),
+                "device run stalled (tunnel wedged?)"),
+        )
+
     ctx = v.Context("local")
     try:
+        # --- device tier FIRST: on the wedge-prone tunnel the device
+        # measurement is the scarce one — bank it before the (safe,
+        # CPU-only) host baseline. Warmup on IDENTICAL shapes so program
+        # + jit caches make the measured run compile-free. ---
+        _phase(f"device warmup ({n_rows:,} rows)")
+        warm = device_pipeline(ctx, n_rows, n_keys)
+        assert warm == n_keys
+        _phase("device measured run")
+        t0 = time.time()
+        dev_count = device_pipeline(ctx, n_rows, n_keys)
+        dev_s = time.time() - t0
+        assert dev_count == n_keys
+        dev_rows_per_s = n_rows / dev_s
+        _phase(f"device done: {dev_s:.3f}s; host baseline next")
+
+        # Device number is banked: swap the stall rescue for a
+        # partial-result reporter covering the host-baseline phase.
+        watchdog.cancel()
+
+        def partial_line():
+            import jax
+
+            print(json.dumps({
+                "metric": "group_by+join rows/sec/chip (reduce_by_key(add)"
+                          f" + {n_keys:,}-key inner join; host baseline "
+                          "DID NOT FINISH in budget)",
+                "value": round(dev_rows_per_s),
+                "unit": "rows/sec",
+                "vs_baseline": 0.0,
+                "error": "host baseline did not finish within the budget; "
+                         "device measurement is real",
+                "detail": {"backend": jax.default_backend(),
+                           "rows": n_rows, "keys": n_keys,
+                           "device_seconds": round(dev_s, 3)},
+            }), flush=True)
+            return 4
+
+        watchdog = _arm_watchdog(
+            max(30.0, deadline - time.time() - 10), partial_line)
+
         # --- host (CPU local-mode) baseline at the SAME scale as the
         # device run: same rows, same keys, identical results — the
         # apples-to-apples ratio round 1 lacked (it compared tiers at
@@ -145,16 +266,7 @@ def main():
         host_s = time.time() - t0
         host_rows_per_s = n_rows / host_s
         assert host_count == n_keys
-
-        # --- device tier: warmup on IDENTICAL shapes (program + jit
-        # caches make the measured run compile-free), then measure ---
-        warm = device_pipeline(ctx, n_rows, n_keys)
-        assert warm == n_keys
-        t0 = time.time()
-        dev_count = device_pipeline(ctx, n_rows, n_keys)
-        dev_s = time.time() - t0
-        assert dev_count == n_keys
-        dev_rows_per_s = n_rows / dev_s
+        _phase(f"host done: {host_s:.3f}s")
 
         import jax
 
@@ -183,15 +295,23 @@ def main():
                       "1M-key inner join; host tier measured at identical "
                       "scale)",
             **({"note": "device backend unavailable; measured on CPU "
-                        "fallback at reduced scale"}
-               if os.environ.get("VEGA_BENCH_CPU_FALLBACK") == "1" else {}),
+                        "fallback at reduced scale"} if on_fallback else {}),
             "value": round(dev_rows_per_s),
             "unit": "rows/sec",
             "vs_baseline": round(dev_rows_per_s / host_rows_per_s, 2),
             "detail": detail,
         }
         watchdog.cancel()
-        print(json.dumps(result))
+        if _claim_output():
+            print(json.dumps(result))
+        else:
+            # A watchdog won the claim race and is mid-rescue: it owns
+            # both the output line and the process exit (os._exit). Block
+            # here so main's return can't kill the process line-less.
+            # Its fallback subprocess has a hard timeout, so this waits a
+            # bounded time. ctx cleanup is moot — the process is dying.
+            while True:
+                time.sleep(60)
     finally:
         ctx.stop()
 

@@ -1,0 +1,116 @@
+"""Staged real-TPU capture for the flaky axon tunnel.
+
+The tunnel historically answers in short windows (~6-13 min) between long
+wedges, so this script banks value incrementally: every phase prints a
+timestamped line the moment it completes, cheap phases run first, and a
+hard watchdog guarantees the process dies rather than holding the window
+hostage. Run by the background watcher (see docs/TPU_MEASUREMENTS_r02.log)
+whenever a probe succeeds; also fine to run by hand.
+
+Phases:
+  0. device init + tiny op (proves the tunnel is really alive)
+  1. smoke pipeline, 100k rows (cold compiles for the bench shapes)
+  2. bench device pipeline at 5M rows (warm + measured)
+  3. bench device pipeline at 20M rows (the BASELINE.md scale)
+  4. second-stage reduce elision A/B at 5M rows
+
+Host-tier baselines intentionally NOT run here: they never touch the
+tunnel and are measured separately (bench.py does both when the tunnel
+is stable enough for the full run).
+"""
+
+import os
+import sys
+import time
+
+T0 = time.time()
+
+
+def say(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')} +{time.time() - T0:6.1f}s] {msg}",
+          flush=True)
+
+
+def arm_watchdog(seconds: float) -> None:
+    import threading
+
+    def fire():
+        say(f"WATCHDOG: no completion within {seconds:.0f}s; exiting")
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
+def main() -> int:
+    budget = float(os.environ.get("VEGA_CAPTURE_TIMEOUT_S", "1500"))
+    arm_watchdog(budget)
+
+    say("phase 0: importing jax / device init")
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/vega_tpu_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    say(f"phase 0 OK: {devs[0].platform} / {devs[0].device_kind}; "
+        f"tiny op = {jnp.arange(8).sum().item()}")
+    if devs[0].platform != "tpu":
+        say("not a TPU backend; aborting capture")
+        return 1
+
+    # Repo root on sys.path first: vega_tpu and bench are imported from
+    # there regardless of the caller's cwd (the watcher runs this by
+    # absolute path).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import vega_tpu as v
+
+    # The ONE definition of the bench workload lives in bench.py — the
+    # captured numbers must stay comparable to the driver's bench metric.
+    from bench import device_pipeline as bench_device_pipeline
+
+    ctx = v.Context.active() or v.Context("local")
+
+    def device_pipeline(n_rows: int, n_keys: int) -> int:
+        return bench_device_pipeline(ctx, n_rows, n_keys)
+
+    say("phase 1: smoke pipeline 100k rows (cold compiles)")
+    n = device_pipeline(100_000, 5_000)
+    assert n == 5_000, n
+    say("phase 1 OK")
+
+    for phase, (rows, keys) in ((2, (5_000_000, 250_000)),
+                                (3, (20_000_000, 1_000_000))):
+        say(f"phase {phase}: {rows:,} rows / {keys:,} keys — warmup")
+        n = device_pipeline(rows, keys)
+        assert n == keys, (n, keys)
+        say(f"phase {phase}: warm; measuring")
+        t = time.time()
+        n = device_pipeline(rows, keys)
+        dt = time.time() - t
+        assert n == keys, (n, keys)
+        say(f"phase {phase} OK: {rows:,} rows in {dt:.3f}s = "
+            f"{rows / dt:,.0f} rows/s "
+            f"(hbm lower bound {rows * 8 * 6 / dt / 1e9:.1f} GB/s)")
+
+    say("phase 4: second-stage reduce elision A/B, 5M rows")
+    rows, keys = 5_000_000, 250_000
+    kv = ctx.dense_range(rows).map(lambda x: (x % keys, x * 0.5))
+    red = kv.reduce_by_key(op="add")
+    red.count()  # materialize + warm
+    t = time.time()
+    n2 = red.map_values(lambda x: x + 1.0).reduce_by_key(op="add").count()
+    dt = time.time() - t
+    assert n2 == keys
+    say(f"phase 4 OK: elided second-stage reduce of {keys:,} keys "
+        f"in {dt:.3f}s")
+
+    say("ALL PHASES DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
