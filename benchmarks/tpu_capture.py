@@ -7,16 +7,17 @@ hard watchdog guarantees the process dies rather than holding the window
 hostage. Run by the background watcher (see docs/TPU_MEASUREMENTS_r02.log)
 whenever a probe succeeds; also fine to run by hand.
 
-Phases:
+Phases (cheap and device-only first; host legs last):
   0. device init + tiny op (proves the tunnel is really alive)
   1. smoke pipeline, 100k rows (cold compiles for the bench shapes)
   2. bench device pipeline at 5M rows (warm + measured)
   3. bench device pipeline at 20M rows (the BASELINE.md scale)
   4. second-stage reduce elision A/B at 5M rows
-
-Host-tier baselines intentionally NOT run here: they never touch the
-tunnel and are measured separately (bench.py does both when the tunnel
-is stable enough for the full run).
+  5. BASELINE config matrix (benchmarks/suite.py) in-process at scale
+     1.0 — this one DOES run the five 1-core host-tier legs (the parity
+     oracle needs host results at identical scale); each config banks a
+     "device-only" line before its host leg so a closing window keeps
+     the device numbers.
 """
 
 import os
@@ -44,7 +45,7 @@ def arm_watchdog(seconds: float) -> None:
 
 
 def main() -> int:
-    budget = float(os.environ.get("VEGA_CAPTURE_TIMEOUT_S", "1500"))
+    budget = float(os.environ.get("VEGA_CAPTURE_TIMEOUT_S", "2100"))
     arm_watchdog(budget)
 
     say("phase 0: importing jax / device init")
@@ -107,6 +108,23 @@ def main() -> int:
     assert n2 == keys
     say(f"phase 4 OK: elided second-stage reduce of {keys:,} keys "
         f"in {dt:.3f}s")
+
+    say("phase 5: BASELINE config matrix (benchmarks/suite.py, "
+        "host vs device on-chip, scale 1.0)")
+    # In-process: the TPU is per-process exclusive, so a subprocess could
+    # not see the chip this capture holds. Each config's line is said the
+    # moment it completes — a mid-suite wedge (watchdog exit) still banks
+    # the configs that finished. Scale 1.0 keeps the 1-core host legs
+    # short; the core numbers are already banked by phases 2-3.
+    import suite as suite_mod
+
+    try:
+        suite_mod.run_configs(ctx, scale=1.0,
+                              emit=lambda line: say(f"suite: {line}"))
+        say("phase 5 OK")
+    except Exception as e:  # noqa: BLE001 — partial results already said
+        say(f"phase 5 FAILED partway: {e!r}")
+        return 1
 
     say("ALL PHASES DONE")
     return 0

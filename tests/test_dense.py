@@ -320,6 +320,33 @@ def test_dense_count_by_value(dctx):
     assert r.count_by_value() == {5: 2, 7: 1, 9: 3}
 
 
+def test_dense_count_by_key_variants(dctx):
+    # pair block: (k, count) pairs, host parity
+    ks = np.array([3, 1, 3, 2, 3, 1], dtype=np.int32)
+    vs = np.arange(6, dtype=np.float32)
+    pair = dctx.dense_from_numpy(ks, vs)
+    expected = {1: 2, 2: 1, 3: 3}
+    assert dict(pair.count_by_key_dense().collect()) == expected
+    host = dctx.parallelize(list(zip(ks.tolist(), vs.tolist())), 3)
+    assert dict(host.map(lambda kv: (kv[0], 1))
+                .reduce_by_key(lambda a, b: a + b, 3).collect()) == expected
+
+    # key-only block (no value column): counting a bare key column works
+    key_only = dctx.dense_from_columns({"word": ks}, key="word")
+    assert dict(key_only.count_by_key_dense().collect()) == expected
+
+    # multi-column block: value columns drop, counts stay per-key
+    multi = dctx.dense_from_columns(
+        {"k": ks, "a": vs, "b": vs * 2}, key="k")
+    assert dict(multi.count_by_key_dense().collect()) == expected
+
+    # int64 (hi, lo) keys: the synthesized ones column rides the wide key
+    big = (1 << 40) + np.array([3, 1, 3, 2, 3, 1], dtype=np.int64)
+    wide = dctx.dense_from_numpy(big, vs)
+    got = dict(wide.count_by_key_dense().collect())
+    assert got == {(1 << 40) + k: c for k, c in expected.items()}
+
+
 def test_dense_cogroup(dctx):
     a = dctx.dense_from_numpy(np.array([1, 1, 2, 3], dtype=np.int32),
                               np.array([10, 11, 20, 30], dtype=np.int32))

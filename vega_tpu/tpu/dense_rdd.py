@@ -426,8 +426,13 @@ class DenseRDD(RDD):
         return self.reduce_by_key(op="add")
 
     def count_by_key_dense(self):
-        ones = self.map_values(lambda _v: jnp.int32(1))
-        return ones.reduce_by_key(op="add")
+        """(key, occurrence count) pairs. Works on any keyed block — pair,
+        key-only (a bare key column is a valid thing to count), and
+        named/multi-column — by synthesizing a ones column and riding the
+        named-op exchange; no traced user closure involved."""
+        if not self.is_pair:
+            raise VegaError("count_by_key_dense on un-keyed DenseRDD")
+        return _OnesValueRDD(self).reduce_by_key(op="add")
 
     def combine_by_key(self, create_combiner: Callable,
                        merge_value: Callable, merge_combiners: Callable,
@@ -1402,6 +1407,33 @@ class _SelectRDD(_NarrowRDD):
     @property
     def key_sorted(self) -> bool:
         return KEY in self._names and self.parent.key_sorted
+
+
+class _OnesValueRDD(_NarrowRDD):
+    """Key columns + a synthesized int32 ones VALUE column —
+    count_by_key_dense's map side (counting needs no value bytes, so any
+    existing value columns are dropped before the exchange moves data;
+    the canonical VALUE name keeps the (k, count) host row form)."""
+
+    def __init__(self, parent: DenseRDD):
+        pschema = dict(parent._schema())
+        out = [(nm, pschema[nm]) for nm in (KEY, KEY_LO) if nm in pschema]
+        out.append((VALUE, jnp.int32))
+        super().__init__(parent, tuple(out))
+        self._user_fn = "ones_value"
+
+    def _shard_fn(self, cols, count):
+        out = {nm: cols[nm] for nm in cols if nm in (KEY, KEY_LO)}
+        out[VALUE] = jnp.ones_like(cols[KEY], dtype=jnp.int32)
+        return out, count
+
+    @property
+    def hash_placed(self) -> bool:
+        return self.parent.hash_placed
+
+    @property
+    def key_sorted(self) -> bool:
+        return self.parent.key_sorted
 
 
 class _WidenKeyRDD(_NarrowRDD):
