@@ -200,6 +200,29 @@ def main():
     #   - device path, after the device number is banked: a partial-result
     #     watchdog that reports the banked device throughput even if the
     #     (slow, pure-CPU) host baseline can't finish inside the budget.
+    banked = {}  # filled by main right after the device measurement
+
+    def banked_device_line():
+        """The ONE emitter for 'device measured, host baseline unfinished'
+        — shared by the stall-rescue and the host-phase watchdog so the
+        partial-result JSON cannot drift between the two."""
+        import jax
+
+        print(json.dumps({
+            "metric": "group_by+join rows/sec/chip (reduce_by_key(add)"
+                      f" + {n_keys:,}-key inner join; host baseline "
+                      "DID NOT FINISH in budget)",
+            "value": round(banked["rows_per_s"]),
+            "unit": "rows/sec",
+            "vs_baseline": 0.0,
+            "error": "host baseline did not finish within the budget; "
+                     "device measurement is real",
+            "detail": {"backend": jax.default_backend(),
+                       "rows": n_rows, "keys": n_keys,
+                       "device_seconds": banked["dev_s"]},
+        }), flush=True)
+        return 4
+
     if on_fallback or not os.environ.get("PALLAS_AXON_POOL_IPS"):
         watchdog = _arm_watchdog(
             max(60.0, deadline - time.time() - 10),
@@ -208,12 +231,19 @@ def main():
         )
     else:
         rescue = max(120.0, min(300.0, budget / 3))
-        watchdog = _arm_watchdog(
-            max(60.0, deadline - time.time() - rescue - 10),
-            lambda: _emit_cpu_fallback(
+
+        def stall_rescue():
+            if banked:
+                # The device number landed just before the timer fired
+                # (cancel() raced and lost): report the real measurement,
+                # not a reduced-scale CPU re-run.
+                return banked_device_line()
+            return _emit_cpu_fallback(
                 max(60.0, deadline - time.time() - 10),
-                "device run stalled (tunnel wedged?)"),
-        )
+                "device run stalled (tunnel wedged?)")
+
+        watchdog = _arm_watchdog(
+            max(60.0, deadline - time.time() - rescue - 10), stall_rescue)
 
     ctx = v.Context("local")
     try:
@@ -230,32 +260,14 @@ def main():
         dev_s = time.time() - t0
         assert dev_count == n_keys
         dev_rows_per_s = n_rows / dev_s
+        banked.update(rows_per_s=dev_rows_per_s, dev_s=round(dev_s, 3))
         _phase(f"device done: {dev_s:.3f}s; host baseline next")
 
         # Device number is banked: swap the stall rescue for a
         # partial-result reporter covering the host-baseline phase.
         watchdog.cancel()
-
-        def partial_line():
-            import jax
-
-            print(json.dumps({
-                "metric": "group_by+join rows/sec/chip (reduce_by_key(add)"
-                          f" + {n_keys:,}-key inner join; host baseline "
-                          "DID NOT FINISH in budget)",
-                "value": round(dev_rows_per_s),
-                "unit": "rows/sec",
-                "vs_baseline": 0.0,
-                "error": "host baseline did not finish within the budget; "
-                         "device measurement is real",
-                "detail": {"backend": jax.default_backend(),
-                           "rows": n_rows, "keys": n_keys,
-                           "device_seconds": round(dev_s, 3)},
-            }), flush=True)
-            return 4
-
         watchdog = _arm_watchdog(
-            max(30.0, deadline - time.time() - 10), partial_line)
+            max(30.0, deadline - time.time() - 10), banked_device_line)
 
         # --- host (CPU local-mode) baseline at the SAME scale as the
         # device run: same rows, same keys, identical results — the

@@ -178,13 +178,12 @@ def config4_cogroup_cartesian(ctx, scale, bank=None):
 
 
 def config5_sort_take(ctx, scale, bank=None):
-    """sort_by_key over i64 keys + take_ordered over the value column.
+    """sort_by_key + take_ordered over i64-keyed pairs.
 
-    Both tiers do identical logical work on their native paths: the pair
-    sort runs the distributed sort kernels; take_ordered runs on the
-    (non-pair) value column, where the device has a real per-shard
-    lax.top_k path (pair take_ordered with a key callable is host-routed
-    by design — closures don't trace)."""
+    Both tiers run identical logical ops end to end: the pair sort runs
+    the distributed sort kernels; take_ordered(10) on the pair RDD runs
+    the device per-shard masked row sort (host: BoundedPriorityQueue over
+    tuples) — same tuple ordering, asserted identical."""
     n = int(4_000_000 * scale)
     rng = np.random.default_rng(7)
     keys = rng.integers(-(1 << 45), 1 << 45, size=n, dtype=np.int64)
@@ -193,7 +192,7 @@ def config5_sort_take(ctx, scale, bank=None):
     def dev_run():
         r = ctx.dense_from_numpy(keys, vals)
         first = r.sort_by_key().take(10)
-        top = r.values_dense().take_ordered(10)
+        top = r.take_ordered(10)
         return first, top
 
     warm = dev_run()
@@ -204,13 +203,12 @@ def config5_sort_take(ctx, scale, bank=None):
     def host_run():
         r = ctx.parallelize(list(zip(keys.tolist(), vals.tolist())), 8)
         first = r.sort_by_key(True, 8).take(10)
-        top = r.map(lambda kv: kv[1]).take_ordered(10)
+        top = r.take_ordered(10)
         return first, top
 
     (host_first, host_top), host_s = _timed(host_run)
     assert [k for k, _ in host_first] == [k for k, _ in dev_first]
-    # Selection only, no arithmetic: the two tiers must pick bit-identical
-    # float32 elements in the same order.
+    # Selection only, no arithmetic: identical tuples bit for bit.
     assert host_top == dev_top
     return n, host_s, dev_s
 

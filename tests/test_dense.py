@@ -320,6 +320,44 @@ def test_dense_count_by_value(dctx):
     assert r.count_by_value() == {5: 2, 7: 1, 9: 3}
 
 
+def test_dense_pair_take_ordered_top(dctx):
+    rng = np.random.default_rng(11)
+    # duplicate keys force the value tiebreak at the cutoff — the case
+    # where key-only ordering would diverge from host tuple ordering
+    ks = rng.integers(0, 40, size=600).astype(np.int32)
+    vs = rng.integers(-1000, 1000, size=600).astype(np.int32)
+    pairs = list(zip(ks.tolist(), vs.tolist()))
+    host = dctx.parallelize(pairs, 4)
+    dev = dctx.dense_from_numpy(ks, vs)
+    assert dev.take_ordered(7) == host.take_ordered(7)
+    assert dev.top(7) == host.top(7)
+    assert dev.take_ordered(0) == []
+    assert dev.take_ordered(10_000) == host.take_ordered(10_000)
+
+    # float values
+    fvs = rng.standard_normal(600).astype(np.float32)
+    fdev = dctx.dense_from_numpy(ks, fvs)
+    fhost = dctx.parallelize(list(zip(ks.tolist(), fvs.tolist())), 4)
+    assert fdev.take_ordered(9) == fhost.take_ordered(9)
+    assert fdev.top(9) == fhost.top(9)
+
+    # int64 (hi, lo) keys order as true int64, not as encoded words
+    big = rng.integers(-(1 << 45), 1 << 45, size=300, dtype=np.int64)
+    wdev = dctx.dense_from_numpy(big, np.arange(300, dtype=np.int32))
+    whost = dctx.parallelize(
+        list(zip(big.tolist(), range(300))), 4)
+    assert wdev.take_ordered(5) == whost.take_ordered(5)
+    assert wdev.top(5) == whost.top(5)
+
+    # multi-column blocks: natural element order == schema-tuple order,
+    # so take_ordered(n) agrees with sorted(collect())[:n] (key column
+    # sits wherever the schema put it — here last)
+    m = dctx.dense_from_columns(
+        {"a": vs, "b": fvs, "k": ks}, key="k")
+    assert m.take_ordered(6) == sorted(m.collect())[:6]
+    assert m.top(6) == sorted(m.collect(), reverse=True)[:6]
+
+
 def test_dense_count_by_key_variants(dctx):
     # pair block: (k, count) pairs, host parity
     ks = np.array([3, 1, 3, 2, 3, 1], dtype=np.int32)

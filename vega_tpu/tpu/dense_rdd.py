@@ -752,16 +752,22 @@ class DenseRDD(RDD):
         return dict(_ReduceByKeyRDD(keyed, op="add", func=None).collect())
 
     def take_ordered(self, n: int, key=None) -> list:
-        """Smallest n via per-shard lax.top_k + driver merge (host analogue:
-        BoundedPriorityQueue, rdd.rs:1124-1153). Custom key functions fall
-        back to the host path."""
-        if key is not None or self.is_pair:
+        """Smallest n via per-shard lax.top_k (values) or masked row sort
+        (pairs, ordered like host tuples: key then values) + driver merge
+        (host analogue: BoundedPriorityQueue, rdd.rs:1124-1153). Custom key
+        functions fall back to the host path — closures don't trace into
+        an ordering."""
+        if key is not None:
             return RDD.take_ordered(self, n, key)
+        if self.is_pair:
+            return self._device_topk_rows(n, largest=False)
         return self._device_topk(n, largest=False)
 
     def top(self, n: int, key=None) -> list:
-        if key is not None or self.is_pair:
+        if key is not None:
             return RDD.top(self, n, key)
+        if self.is_pair:
+            return self._device_topk_rows(n, largest=True)
         return self._device_topk(n, largest=True)
 
     def _device_topk(self, n: int, largest: bool) -> list:
@@ -804,6 +810,86 @@ class DenseRDD(RDD):
         if largest:
             candidates = candidates[::-1]
         return candidates[:n].tolist()
+
+    def _device_topk_rows(self, n: int, largest: bool) -> list:
+        """First/last n ROWS in natural element order — the order of the
+        tuples collect() emits (schema order; for the canonical pair
+        block that is (key, value), matching the host tier's tuple
+        ordering). Guarantees sorted(collect())[:n] == take_ordered(n)
+        whatever the schema. Per shard: one stable lax.sort over
+        (validity, every column), slice n; driver merges the n_shards*n
+        survivors with the same lexicographic order. Total-order caveat:
+        XLA sorts NaN after +inf; Python's NaN comparisons are unordered,
+        so like the host sort the result is only well-defined for
+        NaN-free data."""
+        blk = self.block()
+        names = [nm for nm, _ in self._schema()]
+        # Sort operands in schema order: a two-column int64 key sits as
+        # adjacent (KEY=hi, KEY_LO=lo) columns, so lexicographic schema
+        # order IS int64 order in place.
+        k = min(max(n, 1), blk.capacity)
+
+        def shard_sorted(counts, *cols):
+            capacity = cols[0].shape[0]
+            invalid = ~kernels.valid_mask(capacity, counts[0])
+            operands = [invalid.astype(jnp.int32)]
+            for c in cols:
+                if largest:
+                    if jnp.issubdtype(c.dtype, jnp.floating):
+                        flipped = -c
+                    else:
+                        flipped = ~c  # overflow-free order reversal
+                    # invalid rows must still sink: flag is operand 0
+                    operands.append(flipped)
+                else:
+                    operands.append(c)
+            out = lax.sort(tuple(operands), num_keys=len(operands),
+                           is_stable=True)
+            n_valid = jnp.minimum(counts[0], k).reshape(1)
+            return (n_valid,) + tuple(o[:k] for o in out[1:])
+
+        prog = _cached_program(
+            ("topk_rows", self.mesh, tuple(names), k, largest,
+             tuple(str(dt) for _, dt in self._schema())),
+            lambda: _shard_program(
+                self.mesh, shard_sorted, 1 + len(names),
+                (_SPEC,) * (1 + len(names)),
+            ),
+        )
+        outs = prog(blk.counts, *[blk.cols[nm] for nm in names])
+        outs = jax.device_get(outs)  # one RTT
+        n_valid = np.asarray(outs[0]).reshape(-1)
+        per_col = [np.asarray(o).reshape(blk.n_shards, k)
+                   for o in outs[1:]]
+        keep = []
+        for s in range(blk.n_shards):
+            c = int(n_valid[s])
+            if c:
+                keep.append([col[s, :c] for col in per_col])
+        if not keep:
+            return []
+        merged = {nm: np.concatenate([rows[i] for rows in keep])
+                  for i, nm in enumerate(names)}
+        if largest:
+            # un-flip (the device returned flipped sort operands)
+            for nm in names:
+                col = merged[nm]
+                merged[nm] = -col if np.issubdtype(col.dtype, np.floating) \
+                    else ~col
+        merged = block_lib._decode_key_cols(merged)  # schema order kept
+        order_cols = list(merged.values())
+        # np.lexsort: last key is primary -> reverse; stable like the
+        # device sort.
+        order = np.lexsort([c if not largest else
+                            (-c if np.issubdtype(c.dtype, np.floating)
+                             else ~c)
+                            for c in reversed(order_cols)])
+        out_names = [nm for nm in names if nm != KEY_LO]
+        rows = [tuple(merged[nm][i] for nm in out_names)
+                for i in order[:n]]
+        if out_names == [KEY, VALUE]:
+            return [(k_.item(), v_.item()) for k_, v_ in rows]
+        return [tuple(x.item() for x in row) for row in rows]
 
     def stats(self) -> dict:
         """count/mean/stdev/min/max in one device pass (host analogue:
