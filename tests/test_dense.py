@@ -358,6 +358,108 @@ def test_dense_pair_take_ordered_top(dctx):
     assert m.top(6) == sorted(m.collect(), reverse=True)[:6]
 
 
+def test_dense_wide_int64_values(dctx):
+    """int64 VALUES on device via the wide (v, v.lo) encoding: named
+    reduces use carry/lex combines; shuffles/joins/groups/sorts carry the
+    pair opaquely; host-facing reads decode; traced closures fall back."""
+    BIG = 1 << 40
+    ks = np.array([3, 1, 3, 2, 1, 3], dtype=np.int32)
+    vs = BIG + np.array([10, 20, 30, 40, 50, 60], dtype=np.int64)
+    pairs = list(zip(ks.tolist(), vs.tolist()))
+    d = dctx.dense_from_numpy(ks, vs)
+    assert sorted(d.collect()) == sorted(pairs)
+
+    exp_add, exp_min, groups = {}, {}, {}
+    for k, x in pairs:
+        exp_add[k] = exp_add.get(k, 0) + x
+        exp_min[k] = min(exp_min.get(k, x), x)
+        groups.setdefault(k, []).append(x)
+    red = d.reduce_by_key(op="add")
+    assert dict(red.collect()) == exp_add
+    assert dict(d.reduce_by_key(op="min").collect()) == exp_min
+
+    # carry across the 32-bit boundary
+    cd = dctx.dense_from_numpy(
+        np.array([1, 1, 2, 2], dtype=np.int32),
+        np.array([0xFFFFFFFF, 1, 2**33, 2**33], dtype=np.int64))
+    assert dict(cd.reduce_by_key(op="add").collect()) == \
+        {1: 0x100000000, 2: 2**34}
+
+    # joins carry wide values on either side
+    table = dctx.dense_from_numpy(np.array([1, 2, 3], dtype=np.int32),
+                                  np.array([7, 8, 9], dtype=np.int32))
+    tv = {1: 7, 2: 8, 3: 9}
+    assert sorted(red.join(table).collect()) == \
+        sorted((k, (exp_add[k], tv[k])) for k in exp_add)
+    assert sorted(table.join(red).collect()) == \
+        sorted((k, (tv[k], exp_add[k])) for k in exp_add)
+    # outer join with a wide right side takes the host path (exact fill)
+    loj = dict(table.left_outer_join(red, fill_value=-1).collect())
+    assert loj[1] == (7, exp_add[1]) and len(loj) == 3
+
+    # traced closures see no row form -> silent host fallback, exact int64
+    assert dict(d.reduce_by_key(lambda a, b: a + b).collect()) == exp_add
+    assert sorted(d.map_values(lambda x: x - BIG).collect()) == \
+        sorted((k, x - BIG) for k, x in pairs)
+
+    # group/sort/take_ordered/count
+    g = d.group_by_key()
+    assert {k: sorted(v) for k, v in dict(g.collect()).items()} == \
+        {k: sorted(v) for k, v in groups.items()}
+    _gk, _offs, gv = g.collect_grouped()
+    assert gv.dtype == np.int64
+    assert d.sort_by_key().take(3) == sorted(pairs)[:3]
+    assert d.take_ordered(3) == sorted(pairs)[:3]
+    wide_both = dctx.dense_from_numpy(vs, vs)  # wide key AND wide value
+    assert wide_both.top(2) == sorted(zip(vs.tolist(), vs.tolist()),
+                                      reverse=True)[:2]
+    assert dict(d.count_by_key_dense().collect()) == {1: 2, 2: 1, 3: 3}
+
+    # multi-column: wide + narrow columns reduce in one program
+    m = dctx.dense_from_columns(
+        {"k2": ks, "w": vs, "x": ks.astype(np.float32)}, key="k2")
+    arrs = m.reduce_by_key(op="add").collect_arrays()
+    keyname = "k" if "k" in arrs else "k2"
+    assert dict(zip(arrs[keyname].tolist(), arrs["w"].tolist())) == exp_add
+    # select keeps the wide partner
+    assert sorted(m.select("w").collect_arrays()["w"].tolist()) == \
+        sorted(vs.tolist())
+    # prod over wide values: crisp error (no device path, overflow-bound)
+    with pytest.raises(v.errors.VegaError):
+        d.reduce_by_key(op="prod")
+
+    # streamed chunks keep one schema even when a chunk's range fits int32
+    from vega_tpu.tpu.stream import streamed_npz
+    sr = streamed_npz(dctx, {"k": ks, "v": vs}, chunk_rows=2)
+    assert dict(sr.reduce_by_key(op="add").collect()) == exp_add
+
+    # the ".lo" suffix is reserved
+    with pytest.raises(v.errors.VegaError):
+        dctx.dense_from_columns({"a.lo": ks, "k3": ks}, key="k3")
+    # selecting an orphaned low word would silently vanish data: crisp
+    with pytest.raises(v.errors.VegaError):
+        m.select("w.lo")
+
+    # combine_by_key over wide values: exact host fallback (a traced
+    # create_combiner would see only the hi word)
+    import operator
+
+    got = dict(d.combine_by_key(
+        lambda x: x, operator.add, operator.add).collect())
+    assert got == exp_add
+    # a multiplication CLOSURE (inferred op='prod') falls back silently
+    # (products kept within int64: past it the host tier's native codec
+    # re-encodes bignums as doubles — a host-tier property, not wide's)
+    pd = dctx.dense_from_numpy(np.array([1, 1, 2], dtype=np.int32),
+                               np.array([2**33, 4, 9], dtype=np.int64))
+    assert dict(pd.reduce_by_key(lambda a, b: a * b).collect()) == \
+        {1: 2**35, 2: 9}
+    # dense left_outer_join against a HOST-tier other still works
+    h = dctx.parallelize([(1, 7)], 2)
+    loj = d.left_outer_join(h, fill_value=-1).collect()
+    assert len(loj) == len(pairs) and (1, (BIG + 20, 7)) in loj
+
+
 def test_dense_count_by_key_variants(dctx):
     # pair block: (k, count) pairs, host parity
     ks = np.array([3, 1, 3, 2, 3, 1], dtype=np.int32)
@@ -527,10 +629,11 @@ def test_dense_left_outer_join(dctx):
 
 
 def test_dense_int64_values_fall_back_keys_stay_dense(dctx):
-    """int64 VALUES beyond int32 range degrade to the host tier (device
-    arithmetic would wrap); int64 KEYS beyond int32 range stay dense via
-    the (k, k.lo) two-column encoding — keys are only hashed/compared,
-    never summed. In-range int64 narrows and stays dense."""
+    """int64 beyond int32 range stays DENSE on both sides of a pair: keys
+    AND values ride the wide (name, name.lo) two-column encoding (named
+    reduces use device carry arithmetic; traced binops fall back but the
+    source stays dense). The one remaining degrade is a keyless bare
+    int64 single column — whole-column folds there are host work."""
     from vega_tpu.tpu.block import KEY_LO
     from vega_tpu.tpu.dense_rdd import DenseRDD
 
@@ -538,9 +641,15 @@ def test_dense_int64_values_fall_back_keys_stay_dense(dctx):
         np.array([1, 2, 1], dtype=np.int64),
         np.array([2**40, 2, 3], dtype=np.int64),
     )
-    assert not isinstance(big_vals, DenseRDD)
+    assert isinstance(big_vals, DenseRDD)
+    assert "v.lo" in big_vals.columns
     got = dict(big_vals.reduce_by_key(lambda a, b: a + b, 2).collect())
-    assert got == {1: 2**40 + 3, 2: 2}  # exact int64 sums
+    assert got == {1: 2**40 + 3, 2: 2}  # exact int64 sums (host fallback)
+    got = dict(big_vals.reduce_by_key(op="add").collect())
+    assert got == {1: 2**40 + 3, 2: 2}  # device carry arithmetic
+    bare = dctx.dense_from_numpy(np.array([2**40, 2, 3], dtype=np.int64))
+    assert not isinstance(bare, DenseRDD)
+    assert bare.reduce(lambda a, b: a + b) == 2**40 + 5
     # int64 keys beyond int32 range: composite encoding, still a DenseRDD
     big_keys = dctx.dense_from_numpy(
         np.array([2**40, 1, 2**40], dtype=np.int64),
@@ -1185,10 +1294,15 @@ def test_dense_from_columns_int64_keys_stay_dense(dctx):
     by_key = dict(zip(arrays["k"].tolist(),
                       zip(arrays["x"].tolist(), arrays["y"].tolist())))
     assert by_key == {2**40: (1, 2), 1: (2, 4)}
-    with pytest.raises(v.VegaError):
-        # int64 VALUE column on a named block: crisp error, never silent
-        dctx.dense_from_columns({"k": [1], "x": [2**40], "y": [2]},
-                                key="k")
+    # int64 VALUE columns on named blocks ride the wide encoding and
+    # reduce on device with carry arithmetic (previously a crisp error)
+    wv = dctx.dense_from_columns({"k": [1, 1, 2], "x": [2**40, 5, 7],
+                                  "y": [2, 3, 4]}, key="k")
+    assert isinstance(wv, DenseRDD)
+    arrays = wv.reduce_by_key(op="add").collect_arrays()
+    by_key = dict(zip(arrays["k"].tolist(),
+                      zip(arrays["x"].tolist(), arrays["y"].tolist())))
+    assert by_key == {1: (2**40 + 5, 5), 2: (7, 4)}
 
 
 def test_dense_intersection_subtract(dctx):

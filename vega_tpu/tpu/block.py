@@ -23,14 +23,32 @@ from vega_tpu.tpu import mesh as mesh_lib
 
 KEY = "k"  # canonical key column
 VALUE = "v"  # canonical value column
-# Low word of a two-column int64 key. TPUs have no native int64 and jax
-# x64 is off, so an int64 key column beyond int32 range splits into
-# KEY = high 32 bits (signed: preserves order) and KEY_LO = low 32 bits
-# stored sign-bit-flipped (signed compare of the stored word == unsigned
-# compare of the true low word), making lexicographic (KEY, KEY_LO) order
-# equal int64 order. Host-facing reads reassemble the int64 transparently.
-KEY_LO = "k.lo"
+# Wide (two-column int64) encoding. TPUs have no native int64 and jax x64
+# is off, so an int64 column beyond int32 range splits into
+# <name> = high 32 bits (signed: preserves order) and <name>.lo = low 32
+# bits stored sign-bit-flipped (signed compare of the stored word ==
+# unsigned compare of the true low word), making lexicographic
+# (<name>, <name>.lo) order equal int64 order. Host-facing reads
+# reassemble the int64 transparently. Keys AND value columns use the same
+# encoding; the ".lo" suffix is reserved in user column names.
+LO_SUFFIX = ".lo"
+KEY_LO = KEY + LO_SUFFIX
 _LO_BIAS = np.uint32(0x80000000)
+
+
+def lo_of(name: str) -> str:
+    return name + LO_SUFFIX
+
+
+def is_lo(name: str) -> bool:
+    return name.endswith(LO_SUFFIX)
+
+
+def wide_value_pairs(names) -> dict:
+    """{base: base+'.lo'} for every NON-KEY wide column pair present."""
+    s = set(names)
+    return {nm: lo_of(nm) for nm in s
+            if not is_lo(nm) and nm != KEY and lo_of(nm) in s}
 
 
 def encode_i64(src: np.ndarray):
@@ -49,16 +67,17 @@ def decode_i64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
 
 
 def _decode_key_cols(cols: dict) -> dict:
-    """Reassemble a (KEY, KEY_LO) pair into one int64 KEY column for
-    host-facing reads; other columns pass through (order preserved)."""
-    if KEY_LO not in cols:
+    """Reassemble every (name, name.lo) wide pair — key or value — into
+    one int64 column for host-facing reads; other columns pass through
+    (order preserved)."""
+    if not any(is_lo(n) for n in cols):
         return cols
     out = {}
     for name, col in cols.items():
-        if name == KEY:
-            out[KEY] = decode_i64(col, cols[KEY_LO])
-        elif name != KEY_LO:
-            out[name] = col
+        if is_lo(name):
+            continue
+        lo = cols.get(lo_of(name))
+        out[name] = col if lo is None else decode_i64(col, lo)
     return out
 
 
@@ -224,14 +243,59 @@ def encode_key_columns(columns: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return out
 
 
+def encode_value_columns(columns: Dict[str, np.ndarray]
+                         ) -> Dict[str, np.ndarray]:
+    """Split int64 NON-key columns beyond int32 range into the wide
+    (name, name.lo) encoding; in-range integers keep the narrow path.
+    Idempotent like encode_key_columns (pre-encoded ".lo" columns pass
+    through — the streamed source encodes ONCE on the full column so
+    every chunk gets the same schema, then slices). The sole block layout
+    with no wide-value form is a bare VALUE column on a keyless block
+    (single_column gates it): every reduction there is a plain int64 fold
+    the host tier does exactly."""
+    out: Dict[str, np.ndarray] = {}
+    for name, col in columns.items():
+        if is_lo(name):
+            out[name] = col  # pre-encoded (streamed chunks)
+            continue
+        src = np.asarray(col)
+        if name == KEY or src.dtype not in (np.int64, np.uint64):
+            out[name] = col
+            continue
+        if src.dtype == np.uint64 and len(src) and \
+                src.max() > np.uint64(2**63 - 1):
+            from vega_tpu.errors import VegaError
+
+            raise VegaError(
+                f"uint64 column {name!r} beyond int64 range is not "
+                "representable on device — use the host tier"
+            )
+        info = np.iinfo(np.int32)
+        in_range = (len(src) == 0
+                    or (info.min <= src.min() and src.max() <= info.max))
+        if in_range:
+            out[name] = col  # fits int32; _check_dtype narrows it
+            continue
+        hi, lo = encode_i64(src)
+        out[name] = hi
+        out[lo_of(name)] = lo
+    return out
+
+
 def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
-               capacity: Optional[int] = None) -> Block:
+               capacity: Optional[int] = None,
+               wide_values: bool = True) -> Block:
     """Build a row-sharded Block from host columns (equal lengths). int64
-    KEY columns beyond int32 range are transparently stored as the
-    (KEY, KEY_LO) two-column encoding (see KEY_LO above)."""
+    columns beyond int32 range are transparently stored as two-column
+    (name, name.lo) encodings (see LO_SUFFIX above) — the KEY via
+    encode_key_columns, value columns via encode_value_columns (unless
+    wide_values=False, for layouts with no wide form: the caller then
+    degrades to the host tier on the VegaError _check_dtype raises)."""
     mesh = mesh or mesh_lib.default_mesh()
     n_shards = mesh.size
     columns = encode_key_columns(dict(columns))
+    if wide_values:
+        columns = encode_value_columns(columns)
     names = list(columns)
     n = len(columns[names[0]]) if names else 0
     per = -(-n // n_shards) if n else 0
@@ -291,7 +355,10 @@ def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
 
 
 def single_column(values, mesh=None) -> Block:
-    return from_numpy({VALUE: np.asarray(values)}, mesh)
+    # Keyless single-column blocks have no wide form (every op on them is
+    # a whole-column fold/scan the host tier does exactly on int64) —
+    # out-of-range int64 raises in _check_dtype and the source degrades.
+    return from_numpy({VALUE: np.asarray(values)}, mesh, wide_values=False)
 
 
 def pair_block(keys, values, mesh=None) -> Block:

@@ -262,21 +262,29 @@ class DenseRDD(RDD):
         return [n for n, _ in self._schema()]
 
     def select(self, *names: str) -> "DenseRDD":
-        """Project a subset of columns (narrow, fused). Selecting the key
-        of an int64-keyed block implicitly keeps its low-word column
-        (KEY_LO) — the two columns are one logical key."""
+        """Project a subset of columns (narrow, fused). Selecting a wide
+        (two-column int64) column — key or value — implicitly keeps its
+        low-word partner: the two columns are one logical column."""
         schema = dict(self._schema())
         for n in names:
             if n not in schema:
                 raise VegaError(f"no such column: {n!r}")
-        if KEY in names and KEY_LO in schema and KEY_LO not in names:
-            expanded = []
-            for n in names:
-                expanded.append(n)
-                if n == KEY:
-                    expanded.append(KEY_LO)
-            names = tuple(expanded)
-        return _SelectRDD(self, names)
+            if block_lib.is_lo(n) and n[:-len(block_lib.LO_SUFFIX)] \
+                    not in names:
+                # An orphaned low word decodes to nothing on host reads —
+                # data would silently vanish.
+                raise VegaError(
+                    f"{n!r} is the low word of a wide int64 column; "
+                    f"select {n[:-len(block_lib.LO_SUFFIX)]!r} instead "
+                    "(the pair travels together)"
+                )
+        expanded = []
+        for n in names:
+            expanded.append(n)
+            lo = block_lib.lo_of(n)
+            if lo in schema and lo not in names:
+                expanded.append(lo)
+        return _SelectRDD(self, tuple(expanded))
 
     def to_rdd(self) -> RDD:
         """Explicit hand-off to the host tier (identity view)."""
@@ -372,7 +380,16 @@ class DenseRDD(RDD):
             raise VegaError("map_values on non-pair DenseRDD")
         value_names = [nm for nm, _ in self._schema()
                        if nm not in (KEY, KEY_LO)]
+        if set(value_names) == {VALUE, block_lib.lo_of(VALUE)}:
+            # Wide int64 VALUE: no traced row form, but the canonical
+            # pair layout decodes to (k, v) rows — silent host fallback,
+            # the two-tier contract.
+            log.info("dense map_values fell back to host tier: wide "
+                     "int64 value column")
+            return super().map_values(f)
         if len(value_names) != 1:
+            # Named/multi-column blocks (wide or not) have no host (k, v)
+            # row form — the documented crisp-error exception.
             raise VegaError(
                 "map_values needs exactly one value column (have "
                 f"{value_names}); use select(...) or a tuple-valued "
@@ -401,6 +418,13 @@ class DenseRDD(RDD):
             inferred = _infer_named_op(func)
             if inferred is not None:
                 op = inferred
+            if op == "prod" and block_lib.wide_value_pairs(
+                    nm for nm, _ in self._schema()):
+                # A multiplication CLOSURE over wide int64 values: the
+                # named path would reject it crisply, but the user gave a
+                # closure, so the fallback contract applies — let the
+                # func path raise _NotTraceable and fold on the host.
+                op = None
         if op is not None:
             return _with_exchange(_ReduceByKeyRDD(self, op=op, func=None),
                                   exchange)
@@ -408,12 +432,15 @@ class DenseRDD(RDD):
             return _with_exchange(_ReduceByKeyRDD(self, op=None, func=func),
                                   exchange)
         except _NotTraceable as e:
-            if {nm for nm, _ in self._schema()} != {KEY, VALUE}:
+            plain = {nm for nm, _ in self._schema()
+                     if not block_lib.is_lo(nm)}
+            if plain != {KEY, VALUE}:
                 # Named/multi-column blocks have no host-tier row form a
                 # binary func could fold (compute() yields schema-order
                 # tuples, not (k, v) pairs) — the silent fallback would
                 # produce WRONG results, so this is the documented
-                # exception to the fallback-never-error contract.
+                # exception to the fallback-never-error contract. (Wide
+                # keys/values are fine: they decode to (k, v) rows.)
                 raise VegaError(
                     "reduce_by_key over a named/multi-column block needs a "
                     f"traceable binop (not traceable: {e}); use "
@@ -449,6 +476,18 @@ class DenseRDD(RDD):
         fallback must not re-dispatch through this override)."""
         if not self.is_pair:
             raise VegaError("combine_by_key on non-pair DenseRDD")
+        if block_lib.wide_value_pairs(nm for nm, _ in self._schema()):
+            # Wide int64 values: _MapValuesRDD would trace create_combiner
+            # over the hi word alone and silently drop the low word. No
+            # row form -> host tier (exact int64 combiners).
+            log.info("dense combine_by_key fell back to host tier: wide "
+                     "int64 value column")
+            from vega_tpu.rdd.pair import PairOpsMixin
+
+            return PairOpsMixin.combine_by_key(
+                self, create_combiner, merge_value, merge_combiners,
+                partitioner_or_num,
+            )
         try:
             mapped = _MapValuesRDD(self, create_combiner)
             op = _infer_named_op(merge_combiners)
@@ -509,8 +548,14 @@ class DenseRDD(RDD):
         in a dense column — host semantics with None come via
         .to_rdd().left_outer_join(...)). The host fallback also honors
         fill_value so results don't depend on which path ran."""
-        if fill_value is not None and \
+        wide_right = isinstance(other, DenseRDD) and other.is_pair and \
+            block_lib.wide_value_pairs(nm for nm, _ in other._schema())
+        if fill_value is not None and not wide_right and \
                 self._dense_joinable(other, partitioner_or_num):
+            # wide_right gate: the kernel fills unmatched right columns
+            # with one scalar per column, which would land RAW in the
+            # encoded (hi, lo) words and decode to garbage — the host
+            # path fills the real int64.
             pair = _align_keys(self, other)
             if pair is not None:
                 return _with_exchange(
@@ -635,6 +680,10 @@ class DenseRDD(RDD):
         return _ProjectRDD(self, KEY)
 
     def values_dense(self):
+        if block_lib.lo_of(VALUE) in dict(self._schema()):
+            # A keyless single-column block has no wide form (see
+            # block.single_column); decoded rows via the host tier.
+            return self.to_rdd().map(lambda kv: kv[1])
         return _ProjectRDD(self, VALUE)
 
     # --- actions ------------------------------------------------------------
@@ -884,7 +933,7 @@ class DenseRDD(RDD):
                             (-c if np.issubdtype(c.dtype, np.floating)
                              else ~c)
                             for c in reversed(order_cols)])
-        out_names = [nm for nm in names if nm != KEY_LO]
+        out_names = [nm for nm in names if not block_lib.is_lo(nm)]
         rows = [tuple(merged[nm][i] for nm in out_names)
                 for i in order[:n]]
         if out_names == [KEY, VALUE]:
@@ -1041,11 +1090,11 @@ class _NotTraceable(Exception):
 def _row_struct(schema):
     """Abstract per-row value for tracing: scalar v, or (k, v) pair."""
     cols = dict(schema)
-    if KEY_LO in cols:
-        # Two-column int64 keys have no device row form (the int64 scalar
-        # cannot be traced without x64); row-wise closures take the host
-        # tier, which sees the reassembled int64 keys.
-        raise _NotTraceable("int64 keys: no device row form")
+    if any(block_lib.is_lo(nm) for nm in cols):
+        # Wide (two-column int64) keys or values have no device row form
+        # (the int64 scalar cannot be traced without x64); row-wise
+        # closures take the host tier, which sees the reassembled int64s.
+        raise _NotTraceable("int64 keys/values: no device row form")
     if set(cols) == {KEY, VALUE}:
         return (jax.ShapeDtypeStruct((), cols[KEY]),
                 jax.ShapeDtypeStruct((), cols[VALUE]))
@@ -1716,13 +1765,15 @@ def dense_from_columns(ctx, columns: Optional[dict] = None,
         for name, col in source.items():
             if name in named:
                 raise VegaError(f"duplicate column {name!r}")
-            if name == KEY_LO:
-                # Reserved for the low word of two-column int64 keys: a
-                # user column with this name would be silently consumed
-                # as key bits (wrong int64 keys, vanished data).
+            if block_lib.is_lo(name):
+                # The ".lo" suffix is reserved for the low word of wide
+                # (two-column int64) encodings: a user column with such a
+                # name would be silently consumed as low-word bits (wrong
+                # int64 values, vanished data).
                 raise VegaError(
-                    f"column name {KEY_LO!r} is reserved for the low word "
-                    "of int64 keys — rename the column"
+                    f"column name {name!r} is reserved (the "
+                    f"{block_lib.LO_SUFFIX!r} suffix marks low words of "
+                    "two-column int64 encodings) — rename the column"
                 )
             named[name] = np.asarray(col)
     lengths = {name: len(col) for name, col in named.items()}
@@ -2099,6 +2150,35 @@ class _ExchangeRDD(DenseRDD):
                 ))
 
 
+def _named_wide_combine(op: str, value_names, wide: dict):
+    """Per-column combine for a named op over a mix of narrow columns and
+    wide (hi, lo) int64 pairs: narrow columns use the plain monoid, wide
+    pairs use carry addition / lexicographic select (kernels.wide_add /
+    wide_select)."""
+    narrow_ops = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum,
+                  "prod": jnp.multiply}
+    lo_names = set(wide.values())
+
+    def combine(a, b):
+        out = {}
+        for nm in value_names:
+            if nm in lo_names:
+                continue
+            if nm in wide:
+                lo = wide[nm]
+                if op == "add":
+                    out[nm], out[lo] = kernels.wide_add(
+                        a[nm], a[lo], b[nm], b[lo])
+                else:  # min/max (prod is rejected at build time)
+                    out[nm], out[lo] = kernels.wide_select(
+                        a[nm], a[lo], b[nm], b[lo], op == "min")
+            else:
+                out[nm] = narrow_ops[op](a[nm], b[nm])
+        return out
+
+    return combine
+
+
 class _ReduceByKeyRDD(_ExchangeRDD):
     hash_placed = True  # output rows live on shard hash(key) % n
     key_sorted = True   # segment ends come out in key order
@@ -2110,7 +2190,24 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         pschema = parent._schema()
         self._value_names = [nm for nm, _ in pschema
                              if nm not in (KEY, KEY_LO)]
+        if op == "prod" and \
+                block_lib.wide_value_pairs(nm for nm, _ in pschema):
+            # 64-bit product needs full 64x64 multiply emulation — not
+            # worth a device path; int64 products overflow almost
+            # immediately anyway. Keys decode on the host tier, so point
+            # there.
+            raise VegaError(
+                "reduce_by_key(op='prod') over int64 (wide) values has no "
+                "device path — use the host tier (.to_rdd()) for exact "
+                "products"
+            )
         if func is not None:
+            if block_lib.wide_value_pairs(nm for nm, _ in pschema):
+                # A traced binop would see encoded (hi, lo) words as two
+                # separate int32 scalars — silently wrong. No row form ->
+                # host tier (which folds real int64s).
+                raise _NotTraceable(
+                    "wide int64 value columns: no scalar row form")
             dtypes = dict(pschema)
             structs = [jax.ShapeDtypeStruct((), dtypes[nm])
                        for nm in self._value_names]
@@ -2158,6 +2255,18 @@ class _ReduceByKeyRDD(_ExchangeRDD):
     def _segment_reduce(self, cols, count, presorted):
         lo_name = _lo_of(cols)
         if self._op is not None:
+            wide = block_lib.wide_value_pairs(cols)
+            if wide:
+                # Wide int64 values can't ride the XLA segment ops (the
+                # carry couples the two words) — same segmented scan the
+                # traced combiners use, with the carry/lex combine.
+                combine = _named_wide_combine(
+                    self._op, [nm for nm in cols
+                               if nm not in (KEY, KEY_LO)], wide)
+                return kernels.segment_reduce_sorted(
+                    cols, count, KEY, combine, presorted=presorted,
+                    lo_name=lo_name,
+                )
             return kernels.segment_reduce_named(
                 cols, count, KEY, self._op, presorted=presorted,
                 lo_name=lo_name,
@@ -2411,13 +2520,25 @@ class _JoinRDD(_ExchangeRDD):
         # hint lookup miss and leak a store entry per run).
         return (self.outer, repr(self.fill_value), self.exchange_mode)
 
+    @staticmethod
+    def _side_value_names(schema):
+        """Value-column names of one side in schema order — VALUE plus its
+        wide low word when the side carries int64 values."""
+        return [nm for nm, _ in schema if nm not in (KEY, KEY_LO)]
+
     def _schema(self):
         ls = dict(self.left._schema())
-        rs = dict(self.right._schema())
         key_schema = ((KEY, ls[KEY]),)
         if KEY_LO in ls:
             key_schema += ((KEY_LO, ls[KEY_LO]),)
-        return key_schema + (("lv", ls[VALUE]), ("rv", rs[VALUE]))
+        out = key_schema
+        for prefix, side in (("lv", self.left), ("rv", self.right)):
+            for nm, dt in side._schema():
+                if nm in (KEY, KEY_LO):
+                    continue
+                # VALUE -> lv / rv; VALUE.lo -> lv.lo / rv.lo
+                out += ((nm.replace(VALUE, prefix, 1), dt),)
+        return out
 
     def _materialize(self) -> Block:
         n = self.mesh.size
@@ -2447,6 +2568,9 @@ class _JoinRDD(_ExchangeRDD):
         lschema = dict(self.left._schema())
         key_names = [KEY] + ([KEY_LO] if KEY_LO in lschema else [])
         lo_name = KEY_LO if KEY_LO in lschema else None
+        l_val_names = self._side_value_names(self.left._schema())
+        r_val_names = self._side_value_names(self.right._schema())
+        n_vals = len(l_val_names) + len(r_val_names)
         # Sortedness survives only the elided (stable passthrough) path.
         l_sorted = l_elide and self.left.key_sorted
         r_sorted = r_elide and self.right.key_sorted
@@ -2490,10 +2614,11 @@ class _JoinRDD(_ExchangeRDD):
                 )
                 return (
                     jcount.reshape(1), jtotal.reshape(1),
-                ) + tuple(joined[nm] for nm in key_names) + (
-                    joined[VALUE], joined[f"r_{VALUE}"],
-                    (lof | rof).reshape(1),
-                )
+                ) + tuple(joined[nm] for nm in key_names) + tuple(
+                    joined[nm] for nm in l_val_names
+                ) + tuple(
+                    joined[f"r_{nm}"] for nm in r_val_names
+                ) + ((lof | rof).reshape(1),)
 
             prog = _cached_program(
                 ("join", self.mesh, n, tuple(key_names), tuple(l_in),
@@ -2501,9 +2626,9 @@ class _JoinRDD(_ExchangeRDD):
                  slot_pair, out_cap,
                  join_cap, l_elide, r_elide, l_sorted, r_sorted,
                  self.exchange_mode, self.outer, repr(self.fill_value)),
-                lambda: _shard_program(self.mesh, prog_fn,
-                                       2 + len(l_in) + len(r_in),
-                                       (_SPEC,) * (5 + len(key_names))),
+                lambda: _shard_program(
+                    self.mesh, prog_fn, 2 + len(l_in) + len(r_in),
+                    (_SPEC,) * (3 + len(key_names) + n_vals)),
             )
             return prog, (
                 lblk.counts, *[lblk.cols[nm] for nm in l_in],
@@ -2558,32 +2683,36 @@ class _JoinRDD(_ExchangeRDD):
             while len(hint_store) > 4096:
                 hint_store.pop(next(iter(hint_store)))
         key_arrays = outs[2:2 + len(key_names)]
-        jlv, jrv = outs[2 + len(key_names):4 + len(key_names)]
+        val_arrays = outs[2 + len(key_names):2 + len(key_names) + n_vals]
+        out_names = ([nm.replace(VALUE, "lv", 1) for nm in l_val_names]
+                     + [nm.replace(VALUE, "rv", 1) for nm in r_val_names])
         cols = dict(zip(key_names, key_arrays))
-        cols.update({"lv": jlv, "rv": jrv})
+        cols.update(dict(zip(out_names, val_arrays)))
         return Block(
             cols=cols,
             counts=jcounts, capacity=join_cap_used[0], mesh=self.mesh,
             counts_host=self._last_counts_host,
         )
 
-    def collect(self) -> list:
-        cols = self.block().to_numpy()
-        return [
+    @staticmethod
+    def _rows(cols: dict):
+        # to_numpy/shard_rows decode wide (lv, lv.lo) pairs to int64
+        # before this zip, so lv/rv are single columns again.
+        return (
             (k, (lv, rv))
             for k, lv, rv in zip(
                 cols[KEY].tolist(), cols["lv"].tolist(), cols["rv"].tolist()
             )
-        ]
+        )
+
+    def collect(self) -> list:
+        return list(self._rows(self.block().to_numpy()))
 
     def count(self) -> int:
         return self.block().num_rows
 
     def compute(self, split: Split, task_context=None):
-        rows = self.block().shard_rows(split.index)
-        for k, lv, rv in zip(rows[KEY].tolist(), rows["lv"].tolist(),
-                             rows["rv"].tolist()):
-            yield (k, (lv, rv))
+        yield from self._rows(self.block().shard_rows(split.index))
 
 
 class _SortByKeyRDD(_ExchangeRDD):

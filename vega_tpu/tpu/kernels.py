@@ -322,6 +322,37 @@ def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
     return gather_rows(cols, order)
 
 
+_WIDE_BIAS = 0x80000000  # sign-flip bias on stored low words (block._LO_BIAS)
+
+
+def _wide_unbias(lo: jax.Array) -> jax.Array:
+    """Stored (biased int32) low word -> true unsigned low word."""
+    return lax.bitcast_convert_type(lo, jnp.uint32) ^ jnp.uint32(_WIDE_BIAS)
+
+
+def _wide_rebias(lo_u: jax.Array) -> jax.Array:
+    return lax.bitcast_convert_type(lo_u ^ jnp.uint32(_WIDE_BIAS), jnp.int32)
+
+
+def wide_add(a_hi, a_lo, b_hi, b_lo):
+    """int64 addition over the wide (hi int32, biased-lo int32) encoding:
+    unsigned low-word add with carry into the high word. Wraps mod 2^64
+    like numpy int64 (the host tier's python ints are exact bignums —
+    the documented device dtype contract)."""
+    au, bu = _wide_unbias(a_lo), _wide_unbias(b_lo)
+    s = au + bu  # uint32 wrap
+    carry = (s < au).astype(jnp.int32)
+    return a_hi + b_hi + carry, _wide_rebias(s)
+
+
+def wide_select(a_hi, a_lo, b_hi, b_lo, take_min: bool):
+    """Lexicographic (hi, biased-lo) min/max — signed compares equal
+    int64 order by construction of the encoding."""
+    a_less = (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo < b_lo))
+    pick_a = a_less if take_min else ~a_less
+    return (jnp.where(pick_a, a_hi, b_hi), jnp.where(pick_a, a_lo, b_lo))
+
+
 def _orderable(key: jax.Array) -> jax.Array:
     """Map a column to an order-preserving integer/float domain."""
     return key

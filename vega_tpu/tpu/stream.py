@@ -326,11 +326,12 @@ def streamed_npz(ctx, cols: dict, chunk_rows: int, mesh=None
     from vega_tpu.tpu.dense_rdd import dense_from_block
 
     mesh = mesh or mesh_lib.default_mesh()
-    # Encode int64 keys ONCE over the full column: per-chunk encoding
-    # would give chunks whose local keys fit int32 a different schema
-    # than chunks whose keys don't, and the accumulator union needs every
-    # chunk block to agree.
-    cols = block_lib.encode_key_columns(dict(cols))
+    # Encode int64 keys AND wide values ONCE over the full column:
+    # per-chunk encoding would give chunks whose local range fits int32 a
+    # different schema than chunks whose range doesn't, and the
+    # accumulator union needs every chunk block to agree.
+    cols = block_lib.encode_value_columns(
+        block_lib.encode_key_columns(dict(cols)))
     n = len(next(iter(cols.values()))) if cols else 0
     n_chunks = max(1, -(-n // chunk_rows))
 
