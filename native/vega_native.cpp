@@ -17,9 +17,12 @@
 //                         to vega_tpu.partitioner.splitmix64 (parity oracle)
 //
 // Integer values accumulate in int64 (exact); if accumulation overflows
-// int64 the bucket set demotes to double semantics (the same rounding the
-// float path has). Wire rows are 16 bytes: i64 key + 8 value bytes holding
-// either an f64 or an i64, selected by the bucket set's is_int flag.
+// int64 the whole call REJECTS (returns None) and the caller redoes the
+// work on the pure-Python path, whose bignums are exact — silently
+// demoting to double would round integer results, and the two host paths
+// must agree bit-for-bit whichever one ran. Wire rows are 16 bytes: i64
+// key + 8 value bytes holding either an f64 or an i64, selected by the
+// bucket set's is_int flag.
 //
 // Built as a CPython extension (no pybind11 dependency); loaded lazily by
 // vega_tpu/native.py; every caller has a pure-Python fallback (including a
@@ -194,8 +197,7 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
   PyObject* iter = PyObject_GetIter(iterable);
   if (iter == nullptr) return nullptr;
 
-  int kind = 0;       // value-kind homogeneity (track_kind)
-  bool int_ok = true;  // no int64 overflow during combines
+  int kind = 0;  // value-kind homogeneity (track_kind)
   PyObject* item;
   while ((item = PyIter_Next(iter)) != nullptr) {
     int64_t key;
@@ -217,14 +219,20 @@ static PyObject* bucket_reduce_pairs(PyObject*, PyObject* args) {
       bucket.emplace(key, Acc{dv, iv});
     } else {
       it->second.d = apply_op_d(op, it->second.d, dv);
-      if (int_ok && !apply_op_i(op, it->second.i, iv, &it->second.i)) {
-        int_ok = false;  // int64 overflow -> double semantics
+      if (!apply_op_i(op, it->second.i, iv, &it->second.i)) {
+        // Integer accumulation overflowed int64: double semantics would
+        // silently round, so reject NOW — every continuation from this
+        // state ends in None (all-int -> overflow rejection; a later
+        // float -> mixed-type rejection), and the Python redo starts
+        // from the source iterator anyway. (item was released above.)
+        Py_DECREF(iter);
+        Py_RETURN_NONE;
       }
     }
   }
   Py_DECREF(iter);
   if (PyErr_Occurred()) return nullptr;
-  const bool all_int = (kind != 2) && int_ok;
+  const bool all_int = (kind != 2);
 
   PyObject* result = PyList_New(n_buckets);
   if (result == nullptr) return nullptr;
@@ -296,9 +304,11 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
   return out;
 }
 
-// merge_encoded(list[(bytes, is_int)], op) -> list[(int, float|int)]
-// Reduce-side merge across buckets with per-blob value typing; the result is
-// int-typed iff every input blob was int-typed and no combine overflowed.
+// merge_encoded(list[(bytes, is_int)], op) -> list[(int, float|int)] | None
+// Reduce-side merge across buckets with per-blob value typing; the result
+// is int-typed iff every input blob was int-typed. If an int combine
+// overflows int64 the call returns None and the caller redoes the merge
+// with the exact pure-Python decoder (merge_encoded_py).
 static PyObject* merge_encoded(PyObject*, PyObject* args) {
   PyObject* blobs;
   int op;
@@ -307,7 +317,8 @@ static PyObject* merge_encoded(PyObject*, PyObject* args) {
   if (seq == nullptr) return nullptr;
 
   std::unordered_map<int64_t, Acc> combined;
-  bool all_int = true;
+  bool int_inputs = true;   // every blob int-typed so far
+  bool overflowed = false;  // an int64 combine overflowed
   Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
   for (Py_ssize_t idx = 0; idx < n; ++idx) {
     PyObject* entry = PySequence_Fast_GET_ITEM(seq, idx);
@@ -323,7 +334,7 @@ static PyObject* merge_encoded(PyObject*, PyObject* args) {
       Py_DECREF(seq);
       return nullptr;
     }
-    all_int = all_int && (blob_is_int != 0);
+    int_inputs = int_inputs && (blob_is_int != 0);
     size_t count = static_cast<size_t>(size) / sizeof(Row);
     const Row* rows = reinterpret_cast<const Row*>(data);
     for (size_t r = 0; r < count; ++r) {
@@ -335,14 +346,18 @@ static PyObject* merge_encoded(PyObject*, PyObject* args) {
         combined.emplace(rows[r].key, Acc{dv, iv});
       } else {
         it->second.d = apply_op_d(op, it->second.d, dv);
-        if (all_int && !apply_op_i(op, it->second.i, iv, &it->second.i)) {
-          all_int = false;
+        if (int_inputs && !overflowed &&
+            !apply_op_i(op, it->second.i, iv, &it->second.i)) {
+          overflowed = true;
         }
       }
     }
   }
   Py_DECREF(seq);
-  return pair_list_from_accs(combined, all_int);
+  if (int_inputs && overflowed) {
+    Py_RETURN_NONE;  // exact Python bignum merge instead of rounding
+  }
+  return pair_list_from_accs(combined, int_inputs && !overflowed);
 }
 
 // decode_pairs(bytes, is_int) -> list[(int, float|int)]

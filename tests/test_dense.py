@@ -447,13 +447,13 @@ def test_dense_wide_int64_values(dctx):
     got = dict(d.combine_by_key(
         lambda x: x, operator.add, operator.add).collect())
     assert got == exp_add
-    # a multiplication CLOSURE (inferred op='prod') falls back silently
-    # (products kept within int64: past it the host tier's native codec
-    # re-encodes bignums as doubles — a host-tier property, not wide's)
-    pd = dctx.dense_from_numpy(np.array([1, 1, 2], dtype=np.int32),
-                               np.array([2**33, 4, 9], dtype=np.int64))
-    assert dict(pd.reduce_by_key(lambda a, b: a * b).collect()) == \
-        {1: 2**35, 2: 9}
+    # a multiplication CLOSURE (inferred op='prod') falls back silently,
+    # exact even past int64 (the native codec rejects overflow and the
+    # Python path folds bignums)
+    exp_prod = {}
+    for k, x in pairs:
+        exp_prod[k] = exp_prod.get(k, 1) * x
+    assert dict(d.reduce_by_key(lambda a, b: a * b).collect()) == exp_prod
     # dense left_outer_join against a HOST-tier other still works
     h = dctx.parallelize([(1, 7)], 2)
     loj = d.left_outer_join(h, fill_value=-1).collect()

@@ -52,14 +52,24 @@ def test_large_int_values_stay_exact():
     assert merged == [(1, 2**60 + 3)]
 
 
-def test_int_overflow_demotes_to_float():
+def test_int_overflow_rejects_not_demotes():
     big = 2**62
-    blobs, all_int = nat.bucket_reduce_pairs(
-        [(1, big), (1, big), (1, big)], 1, native.OP_ADD
-    )
-    assert all_int == 0  # int64 overflow -> double semantics, flagged
-    merged = dict(nat.merge_encoded([(b, 0) for b in blobs], native.OP_ADD))
-    assert merged[1] == pytest.approx(3.0 * big, rel=1e-12)
+    # map-side: integer accumulation overflowing int64 rejects the whole
+    # call (None) — the caller redoes it on the exact Python path; double
+    # demotion would silently round integer results
+    assert nat.bucket_reduce_pairs(
+        [(1, big), (1, big), (1, big)], 1, native.OP_ADD) is None
+    # reduce-side: partials fit int64, the merge overflows -> None too
+    blobs, all_int = nat.bucket_reduce_pairs([(1, big)], 1, native.OP_ADD)
+    assert all_int == 1
+    assert nat.merge_encoded(
+        [(blobs[0], 1), (blobs[0], 1)], native.OP_ADD) is None
+    # float inputs keep double semantics (no rejection)
+    fblobs, f_int = nat.bucket_reduce_pairs(
+        [(1, float(big)), (1, float(big))], 1, native.OP_ADD)
+    assert f_int == 0
+    merged = dict(nat.merge_encoded([(fblobs[0], 0)], native.OP_ADD))
+    assert merged[1] == pytest.approx(2.0 * big, rel=1e-12)
 
 
 def test_sound_monoid_inference():
@@ -182,3 +192,23 @@ def test_mixed_value_types_preserve_fidelity(ctx):
     r = dict(ctx.parallelize([(1, 2), (1, 3), (2, 2.5)], 1)
              .reduce_by_key(lambda a, b: a + b, 1).collect())
     assert r[1] == 5 and isinstance(r[1], int)
+
+
+def test_int64_overflow_rejects_to_exact_python(ctx):
+    """int64 overflow during a native combine must NOT demote to double
+    (silent rounding): both the map-side pre-combine and the reduce-side
+    merge reject and redo on the exact Python bignum path."""
+    big = 2**40
+    got = dict(ctx.parallelize([(1, big), (1, big), (1, 8), (2, 5)], 2)
+               .reduce_by_key(lambda a, b: a * b, 2).collect())
+    assert got == {1: big * big * 8, 2: 5}
+    assert all(isinstance(x, int) for x in got.values())
+    # sums past int64 (map-side pre-combine overflow on one partition)
+    gs = dict(ctx.parallelize([(1, 2**62)] * 3, 1)
+              .reduce_by_key(lambda a, b: a + b, 1).collect())
+    assert gs == {1: 3 * 2**62} and isinstance(gs[1], int)
+    # reduce-side merge overflow: per-partition partials fit int64, the
+    # cross-partition merge does not
+    gm = dict(ctx.parallelize([(1, 2**62), (1, 2**62)], 2)
+              .reduce_by_key(lambda a, b: a + b, 1).collect())
+    assert gm == {1: 2**63} and isinstance(gm[1], int)

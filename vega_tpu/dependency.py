@@ -146,9 +146,12 @@ class ShuffleDependency(Dependency):
             if nat is not None:
                 # Probe the first element in Python so a clearly non-numeric
                 # partition skips the native attempt without consuming the
-                # iterator; a partition that *starts* numeric but turns mixed
-                # mid-stream is recomputed below (rare; partition compute is
-                # deterministic by contract — same as lineage recompute).
+                # iterator. The native call returns None — and the
+                # partition is recomputed below on the exact Python path —
+                # when the stream turns mixed-type mid-way OR an int64
+                # combine overflows (demoting to double would silently
+                # round). Rare; partition compute is deterministic by
+                # contract, same as lineage recompute.
                 import itertools as _it
 
                 it = self.rdd.iterator(split, task_context)
@@ -175,7 +178,8 @@ class ShuffleDependency(Dependency):
                             )
                         return (env.shuffle_server.uri
                                 if env.shuffle_server else "local")
-                    source = self.rdd.iterator(split, task_context)  # mixed
+                    # mixed-type stream or int64 overflow: exact redo
+                    source = self.rdd.iterator(split, task_context)
                 else:
                     source = _it.chain([first], it)
 

@@ -68,13 +68,17 @@ class ShuffledRDD(RDD):
 
             nat = native.get()
             flagged = [(b[5:], 1 if b[4] == 1 else 0) for b in native_blobs]
+            merged = None
             if nat is not None:
                 op = native.OP_BY_NAME[self.aggregator.op_name]
-                combiners = dict(nat.merge_encoded(flagged, op))
-            else:
-                combiners = dict(native.merge_encoded_py(
+                # None = an int64 combine overflowed; redo below with
+                # Python bignums (exact) instead of rounded doubles.
+                merged = nat.merge_encoded(flagged, op)
+            if merged is None:
+                merged = native.merge_encoded_py(
                     flagged, self.aggregator.op_name
-                ))
+                )
+            combiners = dict(merged)
 
         for blob in blobs:
             if blob[:4] in (NATIVE_MAGIC, NATIVE_GROUP_MAGIC):
