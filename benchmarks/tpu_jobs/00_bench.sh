@@ -1,0 +1,7 @@
+#!/bin/bash
+# The official bench, exactly as the driver runs it, on the real chip.
+# A successful run banks docs/BENCH_TPU_BANKED.json so a wedge at
+# driver-capture time replays the real measurement instead of a CPU
+# fallback.
+cd /root/repo
+VEGA_BENCH_TIMEOUT_S=1500 exec python bench.py

@@ -1,0 +1,4 @@
+#!/bin/bash
+# Full staged bench capture (bench pipeline 5M/20M, elision, suite matrix).
+cd /root/repo
+VEGA_CAPTURE_TIMEOUT_S=2100 exec python benchmarks/tpu_capture.py

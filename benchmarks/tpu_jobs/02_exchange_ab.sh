@@ -1,0 +1,4 @@
+#!/bin/bash
+# 2-sort exchange A/B on the real chip (round-2 opt, CPU-only numbers so far).
+cd /root/repo
+exec timeout -k 10 900 python benchmarks/exchange_ab.py 5000000 250000

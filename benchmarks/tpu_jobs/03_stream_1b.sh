@@ -1,0 +1,4 @@
+#!/bin/bash
+# BASELINE config 5 / north star at 1B rows through StreamedDenseRDD.
+cd /root/repo
+exec timeout -k 10 2100 python benchmarks/stream_1b.py 1000000000

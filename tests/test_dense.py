@@ -1453,3 +1453,79 @@ def test_narrow_chain_fuses_into_join_and_sort(dctx):
     assert keys == sorted(k for k in (x * 7919 % 10_000
                                       for x in range(10_000)) if k % 2 == 0)
     assert sk._block is None  # fused through sampling + exchange
+
+
+def test_named_multicolumn_join_rejected_crisply(dctx):
+    """Named/multi-column pair blocks must not reach the lv/rv join (its
+    output contract is (k, (lv, rv)) rows) NOR the host cogroup fallback
+    (no host row form) — crisp VegaError on every join-family op."""
+    named = dctx.dense_from_columns(
+        {"k": np.arange(20, dtype=np.int32) % 5,
+         "avg": np.arange(20, dtype=np.float32),
+         "cnt": np.ones(20, dtype=np.int32)}, key="k")
+    canon = dctx.dense_from_numpy(np.arange(5, dtype=np.int32),
+                                  np.arange(5, dtype=np.int32) * 2)
+    for op in ("join", "left_outer_join", "cogroup"):
+        with pytest.raises(v.VegaError, match="named/multi-column"):
+            getattr(named, op)(canon)
+        with pytest.raises(v.VegaError, match="named/multi-column"):
+            getattr(canon, op)(named)
+
+
+def test_rename_bridges_named_to_canonical(dctx):
+    """rename({'w': 'v'}) re-opens the canonical-layout paths (join,
+    map_values host fallback) for blocks built with user column names."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD, _JoinRDD
+
+    ks = np.arange(20, dtype=np.int32) % 5
+    ws = np.arange(20, dtype=np.float32)
+    named = dctx.dense_from_columns({"k": ks, "w": ws}, key="k")
+    canon = named.rename({"w": "v"})
+    assert isinstance(canon, DenseRDD)
+    assert {nm for nm, _ in canon._schema()} == {"k", "v"}
+    table = dctx.dense_from_numpy(np.arange(5, dtype=np.int32),
+                                  np.arange(5, dtype=np.int32) * 10)
+    j = canon.join(table)
+    assert isinstance(j, _JoinRDD)
+    exp = sorted((int(k), (float(w), int(k) * 10)) for k, w in zip(ks, ws))
+    assert sorted(j.collect()) == exp
+
+    # wide int64 pair travels with the rename, then decodes on host reads
+    big = (np.arange(20).astype(np.int64) << 40) + 7
+    wide = dctx.dense_from_columns({"k": ks, "w": big}, key="k")
+    rn = wide.rename({"w": "v"})
+    assert {nm for nm, _ in rn._schema()} == {"k", "v", "v.lo"}
+    assert sorted(rn.collect()) == sorted(zip(ks.tolist(), big.tolist()))
+
+    # guard rails
+    with pytest.raises(v.VegaError, match="no such column"):
+        named.rename({"zz": "v"})
+    with pytest.raises(v.VegaError, match="key columns"):
+        named.rename({"k": "v"})
+    with pytest.raises(v.VegaError, match="key columns"):
+        named.rename({"w": "k"})  # fabricating a pair from values
+    with pytest.raises(v.VegaError, match="reserved"):
+        named.rename({"w": "x.lo"})
+    two = dctx.dense_from_columns({"k": ks, "a": ws, "b": ws}, key="k")
+    with pytest.raises(v.VegaError, match="collide"):
+        two.rename({"a": "b"})
+
+
+def test_map_values_wide_named_column_errors_logically(dctx):
+    """A single NAMED wide int64 column raises naming ONE logical column
+    (never leaking .lo as a phantom second column); multi-column messages
+    list logical names only."""
+    ks = np.arange(10, dtype=np.int32)
+    big = (np.arange(10).astype(np.int64) << 40)
+    one = dctx.dense_from_columns({"k": ks, "w": big}, key="k")
+    with pytest.raises(v.VegaError, match="wide int64 column 'w'"):
+        one.map_values(lambda x: x + 1)
+    # canonical wide layout still silently host-falls-back
+    canon = one.rename({"w": "v"})
+    got = dict(canon.map_values(lambda x: x + 1).collect())
+    assert got == {int(k): int(b) + 1 for k, b in zip(ks, big)}
+    multi = dctx.dense_from_columns(
+        {"k": ks, "w": big, "x": ks.astype(np.float32)}, key="k")
+    with pytest.raises(v.VegaError) as ei:
+        multi.map_values(lambda x: x)
+    assert ".lo" not in str(ei.value)

@@ -53,6 +53,26 @@ _SPEC = P(mesh_lib.SHARD_AXIS)
 _REPL = P()
 
 
+def _join_rename(nm: str, prefix: str) -> str:
+    """VALUE -> lv/rv and VALUE.lo -> lv.lo/rv.lo by EXACT match — a
+    substring replace would mangle any future name containing 'v'. Only
+    canonical layouts reach the join (see _dense_joinable), so anything
+    else passing through unchanged is a programming error upstream."""
+    if nm == VALUE:
+        return prefix
+    if nm == block_lib.lo_of(VALUE):
+        return block_lib.lo_of(prefix)
+    return nm
+
+
+def _canonical_value_layout(schema) -> bool:
+    """True when the non-key columns are exactly the canonical VALUE — or
+    the wide (VALUE, VALUE.lo) int64 pair — i.e. the block has a host-tier
+    (k, v) row form and the lv/rv join renames apply cleanly."""
+    names = [nm for nm, _ in schema if nm not in (KEY, KEY_LO)]
+    return names in ([VALUE], [VALUE, block_lib.lo_of(VALUE)])
+
+
 def _shard_program(mesh, fn, in_specs, out_specs):
     """jit(shard_map(fn))."""
     if isinstance(in_specs, int):
@@ -286,6 +306,33 @@ class DenseRDD(RDD):
                 expanded.append(lo)
         return _SelectRDD(self, tuple(expanded))
 
+    def rename(self, mapping: dict) -> "DenseRDD":
+        """Rename value columns (narrow, fused). A wide int64 column's low
+        word travels with it. rename({'w': VALUE}) is the named->canonical
+        bridge that re-opens host fallbacks and lv/rv joins for blocks
+        built with user column names."""
+        schema = dict(self._schema())
+        full = {}
+        for old, new in mapping.items():
+            if old not in schema:
+                raise VegaError(f"no such column: {old!r}")
+            if old in (KEY, KEY_LO) or new in (KEY, KEY_LO):
+                raise VegaError(
+                    "the key columns cannot be renamed (or renamed onto): "
+                    "a value column renamed to the key name would fabricate "
+                    "a pair RDD out of non-key data")
+            if block_lib.is_lo(old) or block_lib.is_lo(new):
+                raise VegaError(
+                    f"the {block_lib.LO_SUFFIX!r} suffix is reserved for "
+                    "wide int64 low words; rename the base column instead")
+            full[old] = new
+            if block_lib.lo_of(old) in schema:
+                full[block_lib.lo_of(old)] = block_lib.lo_of(new)
+        out_names = [full.get(nm, nm) for nm in schema]
+        if len(set(out_names)) != len(out_names):
+            raise VegaError(f"rename would collide columns: {out_names}")
+        return _RenameRDD(self, full)
+
     def to_rdd(self) -> RDD:
         """Explicit hand-off to the host tier (identity view)."""
         from vega_tpu.rdd.narrow import MapPartitionsRDD
@@ -378,9 +425,14 @@ class DenseRDD(RDD):
     def map_values(self, f: Callable):
         if not self.is_pair:
             raise VegaError("map_values on non-pair DenseRDD")
-        value_names = [nm for nm, _ in self._schema()
-                       if nm not in (KEY, KEY_LO)]
-        if set(value_names) == {VALUE, block_lib.lo_of(VALUE)}:
+        # Collapse wide (name, name.lo) int64 pairs to ONE logical column
+        # each, so user-facing counts and error messages never leak the
+        # internal .lo encoding as a phantom second column.
+        names = [nm for nm, _ in self._schema()]
+        wide_los = set(block_lib.wide_value_pairs(names).values())
+        value_names = [nm for nm in names
+                       if nm not in (KEY, KEY_LO) and nm not in wide_los]
+        if value_names == [VALUE] and block_lib.lo_of(VALUE) in wide_los:
             # Wide int64 VALUE: no traced row form, but the canonical
             # pair layout decodes to (k, v) rows — silent host fallback,
             # the two-tier contract.
@@ -394,6 +446,16 @@ class DenseRDD(RDD):
                 "map_values needs exactly one value column (have "
                 f"{value_names}); use select(...) or a tuple-valued "
                 "reduce_by_key on multi-column blocks"
+            )
+        if value_names[0] in block_lib.wide_value_pairs(names):
+            # ONE named wide column: a traced f would see only the hi
+            # word, and a named block has no host (k, v) row form to fall
+            # back on — crisp, naming the one logical column.
+            raise VegaError(
+                f"map_values over wide int64 column {value_names[0]!r} on "
+                "a named block has no device trace or host row form; "
+                f"rename({{{value_names[0]!r}: {VALUE!r}}}) to the "
+                "canonical layout for the host fallback"
             )
         try:
             return _MapValuesRDD(self, f)
@@ -409,7 +471,15 @@ class DenseRDD(RDD):
         `op` in {'add','min','max','prod'} takes the XLA segment fast path;
         a traceable binary `func` uses the segmented associative scan.
         partitioner_or_num is accepted for API parity; dense output is always
-        one partition per mesh shard."""
+        one partition per mesh shard.
+
+        Dtype contract: device sums wrap like numpy — int64 values use the
+        wide (hi, lo) encoding and op='add' wraps mod 2^64 (kernels.wide_add)
+        — while a closure that falls back to the host tier folds exact
+        Python bignums. Near-int64-range totals therefore differ between
+        op='add' and an untraceable lambda a, b: a + b; there is no device
+        overflow flag (pairwise detection under reassociation would
+        false-positive on totals that fit)."""
         if not self.is_pair:
             raise VegaError("reduce_by_key on non-pair DenseRDD")
         if op is None and func is None:
@@ -531,14 +601,36 @@ class DenseRDD(RDD):
             pair = _align_keys(self, other)
             if pair is not None:
                 return _with_exchange(_JoinRDD(*pair), exchange)
+        self._reject_named_join([other], "join")
         return super().join(other, partitioner_or_num)
 
     def _dense_joinable(self, other, partitioner_or_num) -> bool:
         """Same preconditions as the dense cogroup: both dense pairs, no
         explicit partitioner request, one mesh (mismatched meshes would pair
-        unrelated shards)."""
+        unrelated shards), and BOTH sides in the canonical value layout —
+        the join kernel names its outputs lv/rv, so a named/multi-column
+        side would come out mangled (see _reject_named_join)."""
         return (isinstance(other, DenseRDD) and self.is_pair and other.is_pair
-                and partitioner_or_num is None and other.mesh == self.mesh)
+                and partitioner_or_num is None and other.mesh == self.mesh
+                and _canonical_value_layout(self._schema())
+                and _canonical_value_layout(other._schema()))
+
+    def _reject_named_join(self, others, op: str) -> None:
+        """Named/multi-column pair blocks can reach neither the dense join
+        (its lv/rv output contract is (k, (lv, rv)) rows) nor the host
+        cogroup fallback (named blocks have no host-tier (k, v) row form)
+        — the documented crisp-error exception to the silent-fallback
+        contract, same as reduce_by_key's untraceable-binop case."""
+        for label, side in [("left", self)] + [("right", o) for o in others]:
+            if (isinstance(side, DenseRDD) and side.is_pair
+                    and not _canonical_value_layout(side._schema())):
+                raise VegaError(
+                    f"{op} over a named/multi-column DenseRDD ({label} side"
+                    f" columns {[nm for nm, _ in side._schema()]}) has no"
+                    " (k, v) row form on either tier; select(...) down to"
+                    f" one value column and rename(...) it to {VALUE!r}"
+                    " first"
+                )
 
     def left_outer_join(self, other, partitioner_or_num=None,
                         fill_value=0, exchange: Optional[str] = None):
@@ -562,6 +654,7 @@ class DenseRDD(RDD):
                     _JoinRDD(*pair, outer=True, fill_value=fill_value),
                     exchange,
                 )
+        self._reject_named_join([other], "left_outer_join")
         if fill_value is None:
             # Host None semantics (a dense column can't hold None).
             return super().left_outer_join(other, partitioner_or_num)
@@ -592,6 +685,7 @@ class DenseRDD(RDD):
             pair = _align_keys(self, others[0])
             if pair is not None:
                 return _DenseCoGroupRDD(*pair)
+        self._reject_named_join(others, "cogroup")
         return super().cogroup(*others, partitioner_or_num=partitioner_or_num)
 
     def cartesian(self, other):
@@ -1542,6 +1636,29 @@ class _SelectRDD(_NarrowRDD):
     @property
     def key_sorted(self) -> bool:
         return KEY in self._names and self.parent.key_sorted
+
+
+class _RenameRDD(_NarrowRDD):
+    """Value-column rename (keys untouched, so placement/order survive)."""
+
+    def __init__(self, parent: DenseRDD, mapping: dict):
+        pschema = parent._schema()
+        super().__init__(parent, tuple(
+            (mapping.get(nm, nm), dt) for nm, dt in pschema))
+        self._mapping = dict(mapping)
+        self._user_fn = tuple(sorted(mapping.items()))
+
+    def _shard_fn(self, cols, count):
+        return {self._mapping.get(nm, nm): col
+                for nm, col in cols.items()}, count
+
+    @property
+    def hash_placed(self) -> bool:
+        return self.parent.hash_placed
+
+    @property
+    def key_sorted(self) -> bool:
+        return self.parent.key_sorted
 
 
 class _OnesValueRDD(_NarrowRDD):
@@ -2536,8 +2653,7 @@ class _JoinRDD(_ExchangeRDD):
             for nm, dt in side._schema():
                 if nm in (KEY, KEY_LO):
                     continue
-                # VALUE -> lv / rv; VALUE.lo -> lv.lo / rv.lo
-                out += ((nm.replace(VALUE, prefix, 1), dt),)
+                out += ((_join_rename(nm, prefix), dt),)
         return out
 
     def _materialize(self) -> Block:
@@ -2684,8 +2800,8 @@ class _JoinRDD(_ExchangeRDD):
                 hint_store.pop(next(iter(hint_store)))
         key_arrays = outs[2:2 + len(key_names)]
         val_arrays = outs[2 + len(key_names):2 + len(key_names) + n_vals]
-        out_names = ([nm.replace(VALUE, "lv", 1) for nm in l_val_names]
-                     + [nm.replace(VALUE, "rv", 1) for nm in r_val_names])
+        out_names = ([_join_rename(nm, "lv") for nm in l_val_names]
+                     + [_join_rename(nm, "rv") for nm in r_val_names])
         cols = dict(zip(key_names, key_arrays))
         cols.update(dict(zip(out_names, val_arrays)))
         return Block(
