@@ -1393,16 +1393,19 @@ def test_capacity_hint_overflow_falls_back_to_histogram(dctx):
     n_keys = 2_000  # ~250 combiners per shard >> the poisoned capacity
     kv = dctx.dense_range(3_000).map(lambda x: (x % n_keys, x))
     node = kv.reduce_by_key(op="add")
-    # Poison the hint store for this exact lineage+counts with capacities
-    # too small for the real distribution, then materialize.
-    counts = kv.block().counts_np
-    key = node._hint_key(counts)
+    # Poison the hint store for this exact lineage+sizes with capacities
+    # too small for the real distribution, then materialize. The hinted
+    # launch runs SPECULATIVELY (no blocking overflow fetch); the first
+    # host read settles the flag and repairs through the histogram path.
+    key = node._hint_key()
     dctx.__dict__.setdefault("_dense_capacity_hints", {})[key] = (128, 128)
     got = dict(node.collect())
     assert got == {k: sum(x for x in range(3_000) if x % n_keys == k)
                    for k in range(n_keys)}
     # the bad hint was replaced by working capacities
     assert dctx._dense_capacity_hints[key] != (128, 128)
+    # and nothing is left pending after settlement
+    assert not dctx.__dict__.get("_dense_pending")
 
 
 def test_narrow_chain_fuses_into_exchange(dctx):
@@ -1529,3 +1532,56 @@ def test_map_values_wide_named_column_errors_logically(dctx):
     with pytest.raises(v.VegaError) as ei:
         multi.map_values(lambda x: x)
     assert ".lo" not in str(ei.value)
+
+
+def test_warm_rerun_defers_overflow_to_settlement(dctx):
+    """A warm rerun of the same pipeline shape launches speculatively: the
+    exchange skips its blocking overflow fetch, the block carries a settle
+    hook, and the first host read verifies + commits in one transfer."""
+    import numpy as np
+
+    def build():
+        kv = dctx.dense_range(20_000).map(lambda x: (x % 500, x * 1.0))
+        red = kv.reduce_by_key(op="add")
+        table = dctx.dense_from_numpy(np.arange(500, dtype=np.int32),
+                                      np.arange(500, dtype=np.float32))
+        return red, red.join(table)
+
+    red1, j1 = build()
+    assert j1.count() == 500  # cold: blocking, seeds hints
+    red2, j2 = build()
+    blk = j2.block_spec()  # warm: hinted -> speculative
+    assert blk.settle is not None, "warm join should defer its fetch"
+    assert blk.counts_host is None
+    assert red2._last_attempts == 1
+    pending = dctx.__dict__.get("_dense_pending")
+    assert pending, "reduce + join entries should be pending"
+    assert j2.count() == 500  # settles everything
+    assert blk.settle is None and blk.counts_host is not None
+    assert not dctx.__dict__.get("_dense_pending")
+    assert sorted(j2.collect()) == sorted(j1.collect())
+
+
+def test_failed_speculation_repairs_downstream_consumers(dctx):
+    """Poisoning the REDUCE hint makes the join consume capacity-truncated
+    data; settlement must detect the upstream overflow and rebuild both
+    stages (in registration order) before any host read sees results."""
+    import numpy as np
+
+    def build():
+        kv = dctx.dense_range(30_000).map(lambda x: (x % 3_000, x * 1.0))
+        red = kv.reduce_by_key(op="add")
+        table = dctx.dense_from_numpy(np.arange(3_000, dtype=np.int32),
+                                      np.arange(3_000, dtype=np.float32))
+        return red, red.join(table)
+
+    red1, j1 = build()
+    expected = sorted(j1.collect())  # cold run = oracle, seeds hints
+    red2, j2 = build()
+    # Poison the reduce's capacities so its speculative launch overflows.
+    dctx._dense_capacity_hints[red2._hint_key()] = (128, 128)
+    got = sorted(j2.collect())
+    assert got == expected
+    assert not dctx.__dict__.get("_dense_pending")
+    # the poisoned hint was replaced by working capacities
+    assert dctx._dense_capacity_hints[red2._hint_key()] != (128, 128)

@@ -94,6 +94,14 @@ class Block:
     # already fetched them with the overflow flag) pass them in; otherwise
     # the first counts_np fetches once.
     counts_host: Optional[np.ndarray] = None
+    # Speculative blocks (dense_rdd deferred-overflow exchanges) carry a
+    # settle callable: it batches every pending overflow-flag fetch into
+    # one transfer and, on a failed speculation, repairs this block IN
+    # PLACE (same object identity) from a clean re-materialization. Any
+    # host-facing read must settle first — reading counts or columns of
+    # an unsettled speculative block could observe capacity-truncated
+    # data.
+    settle: Optional[object] = None
 
     @property
     def n_shards(self) -> int:
@@ -101,6 +109,8 @@ class Block:
 
     @property
     def counts_np(self) -> np.ndarray:
+        if self.settle is not None:
+            self.settle()  # may replace cols/counts/capacity in place
         if self.counts_host is None:
             self.counts_host = np.asarray(jax.device_get(self.counts))
         return self.counts_host

@@ -229,10 +229,63 @@ class DenseRDD(RDD):
     def block(self) -> Block:
         """Materialize this node's Block (memoized — dense lineage is
         materialized-once, which is the finished version of the reference's
-        half-built .cache(), SURVEY.md §2.6)."""
+        half-built .cache(), SURVEY.md §2.6). SETTLED: any pending
+        speculative exchange is verified (and repaired on overflow) before
+        the block is handed out, so callers may trust its data. Launch
+        sites that can tolerate speculation (exchange materializers, whose
+        outputs register their own pending entry) use block_spec()."""
+        blk = self.block_spec()
+        if blk.settle is not None:
+            blk.settle()
+        return blk
+
+    def block_spec(self) -> Block:
+        """block() without settlement: the returned Block may still carry
+        an unverified overflow flag. Only for consumers that register
+        their own pending entry (so a failed speculation invalidates and
+        repairs them too) — everything else must use block()."""
         if self._block is None:
             self._block = self._materialize()
         return self._block
+
+    def _counts_fp(self):
+        """Fetch-free identity of this node's input sizes: materialized
+        counts where already host-known, else the tuple of parent
+        identities down to leaf sources (whose counts are always
+        host-known). Keys the exchange capacity hints WITHOUT forcing the
+        driver-blocking counts fetch that keyed them in round 2 — that
+        fetch was the RTT between pipelined launches. Same lineage + same
+        leaf counts but different data values can alias; the overflow
+        retry (settle-repair) is the safety net, as ever."""
+        memo = getattr(self, "_cfp_memo", None)
+        if memo is not None:
+            return memo
+        if self._dense_parents:
+            # Non-leaf nodes ALWAYS use the structural parents form —
+            # never their own materialized counts, which would make the
+            # fingerprint depend on whether the node happened to be
+            # settled when first fingerprinted (identical warm reruns
+            # would mint different hint keys and miss the cache).
+            # Iterative (chains can be thousands of nodes deep).
+            stack = [(self, False)]
+            while stack:
+                node, ready = stack.pop()
+                if getattr(node, "_cfp_memo", None) is not None:
+                    continue
+                if not node._dense_parents:
+                    node._cfp_memo = node.block_spec().counts_np.tobytes()
+                elif ready:
+                    node._cfp_memo = tuple(
+                        p._cfp_memo for p in node._dense_parents)
+                else:
+                    stack.append((node, True))
+                    stack.extend((p, False) for p in node._dense_parents)
+        else:
+            # Leaf source: counts are builder-known (block_range /
+            # from_numpy / dense_from_block set counts_host) — at worst
+            # a settle, never a separate fetch.
+            self._cfp_memo = self.block_spec().counts_np.tobytes()
+        return self._cfp_memo
 
     def _materialize(self) -> Block:
         raise NotImplementedError
@@ -2065,11 +2118,157 @@ def _bucket_cols(cols, n: int) -> jax.Array:
     return pallas_kernels.hash_bucket(cols[KEY], n)
 
 
+def _elide_out_cap(blk: Block) -> int:
+    """Output capacity for an elided (passthrough) exchange: rows stay
+    put, so the parent's max shard count bounds it exactly when already
+    host-known; otherwise the parent's static capacity (a safe superset,
+    usually the same rounding bucket) — never worth a counts fetch."""
+    if blk.counts_host is not None and blk.counts_host.size:
+        return block_lib._round_capacity(max(int(blk.counts_host.max()), 1))
+    return blk.capacity
+
+
+def _settle_pending(ctx) -> None:
+    """Verify every deferred (speculative) exchange in ONE device
+    transfer; repair failures in place.
+
+    A hinted/fixed-capacity exchange launches without its blocking
+    (counts, overflow) fetch — on the wedge-prone tunnel each such fetch
+    is a full network RTT between otherwise-pipelined launches — and
+    registers here instead. The next genuine host read settles the whole
+    backlog: one device_get over all pending flags, then per entry either
+    commit (write counts_host, refresh the capacity hint) or, from the
+    first failure onward, invalidate and re-materialize with deferral
+    disabled (the normal histogram-sized blocking path) and copy the
+    clean result INTO the old Block object so every captured reference
+    observes the repair. Entries registered after a failure are rebuilt
+    too: they were launched against the failed block's truncated data."""
+    pend = ctx.__dict__.get("_dense_pending")
+    if not pend:
+        return
+    entries = list(pend)
+    pend.clear()  # repairs below re-enter _run_exchange -> _settle_pending
+    hint_store = ctx.__dict__.setdefault("_dense_capacity_hints", {})
+
+    def commit(e, head):
+        blk = e["block"]
+        blk.counts_host = head[0].reshape(-1)
+        blk.settle = None
+        if e["hint_key"] is not None:
+            # pop-then-insert refreshes recency (front of the dict is
+            # the eviction end, _run_exchange's bookkeeping).
+            hint_store.pop(e["hint_key"], None)
+            hint_store[e["hint_key"]] = e["caps"]
+            while len(hint_store) > 4096:
+                hint_store.pop(next(iter(hint_store)))
+        if e["on_success"] is not None:
+            e["on_success"](head)
+
+    def depends_on(rdd, failed_rdds) -> bool:
+        """True if rdd's dense lineage reaches any failed node (possibly
+        through non-pending intermediates)."""
+        seen = set()
+        stack = [rdd]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if id(node) in failed_rdds:
+                return True
+            stack.extend(node._dense_parents)
+        return False
+
+    failed = []          # entries to invalidate + rebuild, in order
+    failed_rdds = set()
+    i = 0
+    try:
+        fetched = jax.device_get(
+            [(e["outs_head"], e["overflow"]) for e in entries])
+        for i, (e, (head, ovf)) in enumerate(zip(entries, fetched)):
+            head = [np.asarray(h) for h in head]
+            bad = failed_rdds and depends_on(e["rdd"], failed_rdds)
+            if not bad:
+                ok = not bool(np.any(np.asarray(ovf)))
+                validator_said_no = False
+                if ok and e["validate"] is not None:
+                    # Join product checks; a hard limit raises VegaError.
+                    ok = e["validate"](head)
+                    validator_said_no = not ok
+                if ok:
+                    # Clean flags AND no failed ancestor: commit even
+                    # after an unrelated pipeline's failure — only
+                    # lineage descendants consumed truncated data.
+                    commit(e, head)
+                    continue
+                # An exchange overflow means the hinted capacities were
+                # wrong — drop the hint so the repair sizes from
+                # histograms. A validator failure (join product exceeded
+                # its cap) keeps the exchange hint: the validator already
+                # stashed its corrected cap.
+                if e["hint_key"] is not None and not validator_said_no:
+                    hint_store.pop(e["hint_key"], None)
+            failed.append(e)
+            failed_rdds.add(id(e["rdd"]))
+    except Exception:
+        # Settlement died mid-way (validator hard error, transport
+        # failure): every entry not yet committed goes BACK on the
+        # backlog, in order — a stranded entry whose settle became a
+        # no-op would silently serve capacity-truncated data later.
+        # (A deterministic validator error thus re-raises on every
+        # subsequent read of the affected pipeline: loud, never wrong.)
+        pend[:0] = entries[i:]
+        raise
+    if not failed:
+        return
+    log.info("speculative exchange failed (%d of %d entries); repairing",
+             len(failed), len(entries))
+    for e in failed:
+        e["rdd"]._block = None
+        e["rdd"].__dict__.pop("_pickle_state_memo", None)
+        # Until repaired, reads through captured references must fail
+        # loudly, not fetch the truncated speculative buffers.
+        e["block"].settle = _unrepaired_raise
+    ctx.__dict__["_dense_no_defer"] = True
+    try:
+        for e in failed:
+            rdd = e["rdd"]
+            fresh = rdd.block()  # blocking path: sized, fetched, verified
+            old = e["block"]
+            old.cols = fresh.cols
+            old.counts = fresh.counts
+            old.capacity = fresh.capacity
+            old.counts_host = fresh.counts_np
+            old.settle = None
+            rdd._block = old  # keep the object identity callers captured
+    finally:
+        ctx.__dict__["_dense_no_defer"] = False
+
+
+def _unrepaired_raise():
+    raise VegaError(
+        "speculative block was invalidated by an exchange overflow and "
+        "its repair did not complete; re-run the pipeline"
+    )
+
+
 class _ExchangeRDD(DenseRDD):
     """Common driver loop: run the fused exchange program, check overflow
     flags, retry with grown capacities (capacity-factor pattern). The
     collective implementation (all_to_all vs ring ppermute) comes from
     Configuration.dense_exchange or the node's exchange_mode attribute."""
+
+    def _attach_pending(self, blk: Block) -> Block:
+        """Register the deferred entry _run_exchange left behind (if any)
+        against the just-built Block; returns blk either way."""
+        entry = self.__dict__.pop("_deferred_entry", None)
+        if entry is None:
+            return blk
+        entry["block"] = blk
+        ctx = self.context
+        ctx.__dict__.setdefault("_dense_pending", []).append(entry)
+        blk.settle = lambda: _settle_pending(ctx)
+        return blk
 
     @property
     def exchange_mode(self) -> str:
@@ -2165,26 +2364,40 @@ class _ExchangeRDD(DenseRDD):
         out = prog(*args)
         return np.asarray(jax.device_get(out)).reshape(n, n)
 
-    def _hint_key(self, counts: np.ndarray, *extra):
-        """Capacity-hint identity: structural lineage + input shard counts.
-        Same pipeline shape over same-count inputs (the steady-state rerun
-        and the streamed per-chunk case) reuses last run's capacities and
-        skips the sizing histogram's device round trip; a changed key
-        distribution under equal counts surfaces as an overflow retry,
-        which falls back to the exact histogram."""
-        return (self._lineage_fp(), counts.tobytes(), extra)
+    def _hint_key(self, *extra):
+        """Capacity-hint identity: structural lineage + fetch-free input
+        size identity (_counts_fp — leaf counts, or materialized counts
+        where already host-known). Same pipeline shape over same-size
+        inputs (the steady-state rerun and the streamed per-chunk case)
+        reuses last run's capacities and skips both the sizing histogram
+        AND the post-launch overflow fetch (deferred to _settle_pending);
+        a changed key distribution under equal counts surfaces at
+        settlement, which repairs through the exact histogram."""
+        return (self._lineage_fp(), self._counts_fp(), extra)
 
-    def _run_exchange(self, build_program, counts: np.ndarray,
+    def _run_exchange(self, build_program, counts,
                       hists: Optional[List[np.ndarray]] = None,
                       slot_hists: Optional[List[np.ndarray]] = None,
-                      make_hists=None, hint_key=None):
+                      make_hists=None, hint_key=None, fixed_caps=None,
+                      validate=None, on_success=None):
         """Run the fused exchange program with capacity sizing.
 
-        Sizing order: (1) a memoized capacity hint for this lineage+counts
-        (no device work), (2) exact histograms — passed eagerly via
-        `hists`/`slot_hists` or computed lazily by `make_hists()` (a device
-        pass, skipped entirely on a hint hit), (3) the heuristic growth
-        schedule. Overflow at any stage falls through to the next."""
+        Sizing order: (1) `fixed_caps` — capacities known a priori
+        (elided passthroughs, which cannot overflow), (2) a memoized
+        capacity hint for this lineage+sizes (no device work), (3) exact
+        histograms — passed eagerly via `hists`/`slot_hists` or computed
+        lazily by `make_hists()` (a device pass, skipped entirely on a
+        hint hit), (4) the heuristic growth schedule; `counts` may be a
+        callable so cold-path-only sizing inputs are never fetched on the
+        warm path. Overflow at any stage falls through to the next.
+
+        Deferred mode (fixed/hinted, unless a settle-repair is running):
+        the program launches WITHOUT the blocking (counts, overflow)
+        fetch — each such fetch is a full network RTT through the axon
+        tunnel between otherwise async-pipelined launches — and leaves a
+        pending entry for _attach_pending/_settle_pending to verify at
+        the next genuine host read. `validate`/`on_success` ride the
+        entry (join product checks / node bookkeeping)."""
         import time as _time
 
         from vega_tpu.scheduler import events as ev
@@ -2192,11 +2405,48 @@ class _ExchangeRDD(DenseRDD):
         n = self.mesh.size
         hist_pair = (None if make_hists is not None
                      else (hists, slot_hists))
-        hint_store = self.context.__dict__.setdefault(
-            "_dense_capacity_hints", {})
+        ctx = self.context
+        hint_store = ctx.__dict__.setdefault("_dense_capacity_hints", {})
         hinted = hint_key is not None and hint_key in hint_store
-        bus = getattr(self.context, "bus", None)
+        bus = getattr(ctx, "bus", None)
         t_start = _time.time()
+        if ((fixed_caps is not None or hinted)
+                and not ctx.__dict__.get("_dense_no_defer")):
+            slot, out_cap = (fixed_caps if fixed_caps is not None
+                             else hint_store[hint_key])
+            if bus is not None:
+                bus.post(ev.StageSubmitted(
+                    stage_id=-self.rdd_id, num_tasks=n, is_shuffle_map=True,
+                ))
+            try:
+                prog, args = build_program(slot, out_cap)
+                *outs, overflow = prog(*args)
+            finally:
+                if bus is not None:
+                    bus.post(ev.StageCompleted(
+                        stage_id=-self.rdd_id,
+                        duration_s=_time.time() - t_start,
+                    ))
+            self._last_attempts = 1
+            extra = getattr(self, "_fetch_extra_outs", 0)
+            self._deferred_entry = {
+                "rdd": self,
+                "outs_head": tuple(outs[:1 + extra]),
+                "overflow": overflow,
+                "hint_key": None if fixed_caps is not None else hint_key,
+                "caps": (slot, out_cap),
+                "validate": validate,
+                "on_success": on_success,
+            }
+            self._last_counts_host = None
+            self._last_extra_host = None
+            return outs, out_cap
+        # Blocking path: before sizing from (or launching over) parent
+        # data, settle the speculation backlog — histogram passes and the
+        # heuristic's counts would otherwise trust possibly-truncated
+        # blocks. Repairs rewrite failed blocks in place, so references
+        # captured above this frame stay valid.
+        _settle_pending(ctx)
         if bus is not None:
             # Dense stages bypass the task scheduler (one SPMD launch);
             # surface them on the same event bus for observability. One
@@ -2207,7 +2457,9 @@ class _ExchangeRDD(DenseRDD):
         try:
             attempt = 0  # histogram/heuristic growth step
             for round_i in range(6):
-                if hinted and round_i == 0:
+                if fixed_caps is not None and round_i == 0:
+                    slot, out_cap = fixed_caps
+                elif hinted and round_i == 0:
                     slot, out_cap = hint_store[hint_key]
                 else:
                     if hist_pair is None:
@@ -2220,6 +2472,8 @@ class _ExchangeRDD(DenseRDD):
                         slot, out_cap = _histogram_capacities(hs, attempt,
                                                               sh)
                     else:
+                        if callable(counts):
+                            counts = counts()
                         slot, out_cap = _exchange_capacities(counts, n,
                                                              attempt)
                     attempt += 1
@@ -2426,10 +2680,9 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # oversized — those materialize the parent as before.
         chain, root = (_narrow_chain(self.parent) if n > 1 and not elide
                        else ([], self.parent))
-        blk = root.block()
+        blk = root.block_spec()  # we register our own pending entry
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
-        counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
@@ -2488,27 +2741,28 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in in_names])
 
-        # Elided: rows stay put, so the exact "histogram" is the diagonal
-        # (shard s keeps counts[s] rows) — one attempt, exact out capacity;
-        # slot is unused by the passthrough, so size it from nothing.
+        # Elided: rows stay put, so capacities are known a priori (no
+        # sizing pass, no overflow possible): tight when the parent's
+        # counts are already host-known, else the parent's capacity —
+        # never a fetch. Slot is unused by the passthrough.
         self._elided = elide
         if elide:
-            # Exact "histogram" is the diagonal (rows stay put) — free.
             outs, out_cap = self._run_exchange(
-                build, counts_host, hists=[np.diag(counts_host)],
-                slot_hists=[],
+                build, lambda: blk.counts_np,
+                fixed_caps=(0, _elide_out_cap(blk)),
             )
         else:
             outs, out_cap = self._run_exchange(
-                build, counts_host,
+                build, lambda: blk.counts_np,
                 make_hists=lambda: ([self._hash_histogram(blk, chain)],
                                     None),
-                hint_key=self._hint_key(counts_host),
+                hint_key=self._hint_key(),
             )
         counts, col_arrays = outs[0], outs[1:]
-        return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh,
-                     counts_host=self._last_counts_host)
+        return self._attach_pending(Block(
+            cols=dict(zip(names, col_arrays)), counts=counts,
+            capacity=out_cap, mesh=self.mesh,
+            counts_host=self._last_counts_host))
 
 
 class _GroupByKeyRDD(_ExchangeRDD):
@@ -2535,10 +2789,9 @@ class _GroupByKeyRDD(_ExchangeRDD):
         # sizing uses raw counts, which a fused filter would inflate).
         chain, root = (_narrow_chain(self.parent) if n > 1 and not elide
                        else ([], self.parent))
-        blk = root.block()
+        blk = root.block_spec()  # we register our own pending entry
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
-        counts_host = blk.counts_np
         exchange = _get_exchange(self.exchange_mode)
 
         def build(slot, out_cap):
@@ -2576,22 +2829,22 @@ class _GroupByKeyRDD(_ExchangeRDD):
 
         self._elided = elide
         if elide:
-            # Exact "histogram" is the diagonal (rows stay put) — free.
             outs, out_cap = self._run_exchange(
-                build, counts_host, hists=[np.diag(counts_host)],
-                slot_hists=[],
+                build, lambda: blk.counts_np,
+                fixed_caps=(0, _elide_out_cap(blk)),
             )
         else:
             outs, out_cap = self._run_exchange(
-                build, counts_host,
+                build, lambda: blk.counts_np,
                 make_hists=lambda: ([self._hash_histogram(blk, chain)],
                                     None),
-                hint_key=self._hint_key(counts_host),
+                hint_key=self._hint_key(),
             )
         counts, col_arrays = outs[0], outs[1:]
-        return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh,
-                     counts_host=self._last_counts_host)
+        return self._attach_pending(Block(
+            cols=dict(zip(names, col_arrays)), counts=counts,
+            capacity=out_cap, mesh=self.mesh,
+            counts_host=self._last_counts_host))
 
     def collect_grouped(self):
         """Columnar grouped collect: (keys, offsets, values) numpy arrays,
@@ -2672,10 +2925,8 @@ class _JoinRDD(_ExchangeRDD):
                            if n > 1 and not l_elide else ([], self.left))
         r_chain, r_root = (_narrow_chain(self.right)
                            if n > 1 and not r_elide else ([], self.right))
-        lblk = l_root.block()
-        rblk = r_root.block()
-        l_counts = lblk.counts_np
-        r_counts = rblk.counts_np
+        lblk = l_root.block_spec()  # we register our own pending entry
+        rblk = r_root.block_spec()
         l_in = list(lblk.cols)
         r_in = list(rblk.cols)
         exchange = _get_exchange(self.exchange_mode)
@@ -2751,64 +3002,87 @@ class _JoinRDD(_ExchangeRDD):
                 rblk.counts, *[rblk.cols[nm] for nm in r_in],
             )
 
-        counts = np.concatenate([l_counts, r_counts])
+        counts_fn = lambda: np.concatenate([lblk.counts_np, rblk.counts_np])
         self._elided = (l_elide, r_elide)
         self._fetch_extra_outs = 1  # jtotals rides the counts transfer
 
         def make_hists():
+            # Blocking path only (post-settle), so counts_np is safe/free.
             hs = [
-                np.diag(l_counts) if l_elide
+                np.diag(lblk.counts_np) if l_elide
                 else self._hash_histogram(lblk, l_chain),
-                np.diag(r_counts) if r_elide
+                np.diag(rblk.counts_np) if r_elide
                 else self._hash_histogram(rblk, r_chain),
             ]
             # Elided (diag) sides never send: keep them out of slot sizing.
             return hs, [h for h, el in zip(hs, (l_elide, r_elide))
                         if not el]
 
-        hint = (None if (l_elide and r_elide)
-                else self._hint_key(counts))
+        hint = self._hint_key()
         # The dup x dup product size is also hint-memoized: without it, a
         # join whose product exceeds the exchange-sized cap would repeat
         # its full-launch resize on every warm rerun.
         hint_store = self.context.__dict__.setdefault(
             "_dense_capacity_hints", {})
-        jc_key = None if hint is None else (hint, "join_cap")
-        if jc_key is not None and jc_key in hint_store:
+        jc_key = (hint, "join_cap")
+        if jc_key in hint_store:
             join_cap_override[0] = hint_store[jc_key]
-        outs, _ = self._run_exchange(build, counts, make_hists=make_hists,
-                                     hint_key=hint)
-        jcounts, jtotals = outs[0], self._last_extra_host[0]
-        if int(jtotals.max(initial=0)) >= 2**31 - 1:
-            raise VegaError(
-                "dense join product exceeds 2^31 rows on one shard — "
-                "cannot materialize; filter or pre-aggregate the heavy keys"
-            )
-        if int(jtotals.max(initial=0)) > join_cap_used[0]:
-            # dup x dup expansion exceeded the exchange-sized output; the
-            # kernel reported the exact product size, so ONE resized rerun
-            # is guaranteed to fit (no geometric-growth walk).
-            join_cap_override[0] = _cap_round(int(jtotals.max()))
-            outs, _ = self._run_exchange(build, counts,
-                                         make_hists=make_hists,
-                                         hint_key=hint)
-            jcounts = outs[0]
-        if jc_key is not None and join_cap_override[0]:
-            hint_store.pop(jc_key, None)  # move-to-end (see _run_exchange)
-            hint_store[jc_key] = join_cap_override[0]
-            while len(hint_store) > 4096:
-                hint_store.pop(next(iter(hint_store)))
+
+        def validate(head):
+            """Deferred-mode product checks (the blocking path's inline
+            logic below, recast for _settle_pending)."""
+            jtot = int(head[1].max(initial=0))
+            if jtot >= 2**31 - 1:
+                raise VegaError(
+                    "dense join product exceeds 2^31 rows on one shard — "
+                    "cannot materialize; filter or pre-aggregate the "
+                    "heavy keys"
+                )
+            if jtot > join_cap_used[0]:
+                # Stash the exact product cap for the settle-repair rerun.
+                hint_store[jc_key] = _cap_round(jtot)
+                return False
+            return True
+
+        def on_success(_head):
+            if join_cap_override[0]:
+                hint_store.pop(jc_key, None)  # move-to-end (recency)
+                hint_store[jc_key] = join_cap_override[0]
+                while len(hint_store) > 4096:
+                    hint_store.pop(next(iter(hint_store)))
+
+        outs, _ = self._run_exchange(build, counts_fn,
+                                     make_hists=make_hists,
+                                     hint_key=hint, validate=validate,
+                                     on_success=on_success)
+        if "_deferred_entry" not in self.__dict__:
+            # Blocking path: run the same product checks the deferred
+            # entry runs at settlement (ONE policy, validate above). On a
+            # cap miss, validate stashed the exact product cap under
+            # jc_key; ONE resized rerun is guaranteed to fit (the kernel
+            # reported the exact size — no geometric-growth walk).
+            if not validate([None, self._last_extra_host[0]]):
+                join_cap_override[0] = hint_store[jc_key]
+                outs, _ = self._run_exchange(build, counts_fn,
+                                             make_hists=make_hists,
+                                             hint_key=hint,
+                                             validate=validate,
+                                             on_success=on_success)
+            if "_deferred_entry" not in self.__dict__ \
+                    and join_cap_override[0]:
+                on_success(None)
+        jcounts = outs[0]
         key_arrays = outs[2:2 + len(key_names)]
         val_arrays = outs[2 + len(key_names):2 + len(key_names) + n_vals]
         out_names = ([_join_rename(nm, "lv") for nm in l_val_names]
                      + [_join_rename(nm, "rv") for nm in r_val_names])
         cols = dict(zip(key_names, key_arrays))
         cols.update(dict(zip(out_names, val_arrays)))
-        return Block(
+        return self._attach_pending(Block(
             cols=cols,
             counts=jcounts, capacity=join_cap_used[0], mesh=self.mesh,
             counts_host=self._last_counts_host,
-        )
+        ))
 
     @staticmethod
     def _rows(cols: dict):
@@ -2981,13 +3255,16 @@ class _SortByKeyRDD(_ExchangeRDD):
                 chain=chain)], None),
             # Bounds are data-derived: same data -> same bounds, and a
             # changed distribution changes the bounds, so they belong in
-            # the hint identity.
-            hint_key=self._hint_key(counts_host, bounds.tobytes()),
+            # the hint identity (with the post-chain counts the sampling
+            # already fetched).
+            hint_key=self._hint_key(counts_host.tobytes(),
+                                    bounds.tobytes()),
         )
         counts, col_arrays = outs[0], outs[1:]
-        return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh,
-                     counts_host=self._last_counts_host)
+        return self._attach_pending(Block(
+            cols=dict(zip(names, col_arrays)), counts=counts,
+            capacity=out_cap, mesh=self.mesh,
+            counts_host=self._last_counts_host))
 
 
 class _CartesianDenseRDD(DenseRDD):
