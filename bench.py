@@ -63,14 +63,35 @@ def _claim_output() -> bool:
 _BANK_PATH = None  # resolved lazily relative to this file
 
 
+def _here() -> str:
+    import os
+
+    return os.path.dirname(os.path.abspath(
+        globals().get("__file__") or sys.argv[0]))
+
+
+def _git_head() -> str:
+    """Short HEAD of the repo this bench file lives in, with a '-dirty'
+    suffix when the working tree has uncommitted changes ('' on any
+    error). Banked payloads carry it so a replayed measurement can be
+    traced to the code it actually measured (round-3 advisor finding);
+    a dirty capture must be visibly untrustworthy."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", _here(), "describe", "--always", "--dirty",
+             "--abbrev=7"],
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except (OSError, subprocess.SubprocessError):
+        return ""
+
+
 def _bank_path():
     global _BANK_PATH
     if _BANK_PATH is None:
         import os
 
-        here = os.path.dirname(os.path.abspath(
-            globals().get("__file__") or sys.argv[0]))
-        _BANK_PATH = os.path.join(here, "docs", "BENCH_TPU_BANKED.json")
+        _BANK_PATH = os.path.join(_here(), "docs", "BENCH_TPU_BANKED.json")
     return _BANK_PATH
 
 
@@ -83,7 +104,8 @@ def _bank_tpu_result(result: dict) -> None:
     import os
 
     try:
-        banked = dict(result, banked_at=time.strftime("%Y-%m-%d %H:%M:%S"))
+        banked = dict(result, banked_at=time.strftime("%Y-%m-%d %H:%M:%S"),
+                      banked_commit=_git_head())
         tmp = _bank_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump(banked, f, indent=1)
@@ -143,8 +165,20 @@ def _emit_banked_tpu(reason: str) -> bool:
         return False
     if banked.get("detail", {}).get("backend") != "tpu":
         return False
+    commit, head = banked.get("banked_commit") or "unknown", _git_head()
+    # A dirty capture is untrustworthy even at the same HEAD: the dirt
+    # that was measured may not be the dirt in the tree now.
+    if commit.endswith("-dirty"):
+        stale = (" — STALE: captured from an uncommitted tree, this "
+                 "number may not match any committed code")
+    elif head and commit not in ("unknown", head):
+        stale = (" — STALE: HEAD is now %s, this number measured older "
+                 "code" % head)
+    else:
+        stale = ""
     banked["note"] = (
         f"replayed banked real-TPU measurement from {banked.get('banked_at')}"
+        f" at commit {commit}{stale}"
         f" ({reason} at capture time; see docs/TPU_MEASUREMENTS log)")
     print(json.dumps(banked), flush=True)
     return True

@@ -1,19 +1,20 @@
 #!/bin/bash
-# Background watcher for the flaky axon TPU tunnel (round 3).
+# Background watcher for the flaky axon TPU tunnel (rounds 3+).
 #
 # Loop: probe device init in a short-timeout subprocess; on a healthy
 # probe, drain the job queue (benchmarks/tpu_jobs/NN_*.sh, lexical
 # order). Each job runs under a hard timeout; success renames it to
 # *.done, failure to *.fail<N> after $MAX_TRIES attempts. Everything is
-# appended to docs/TPU_MEASUREMENTS_r03.log so a later wedge cannot
-# erase banked numbers.
+# appended to the round measurement log ($VEGA_TPU_LOG, default
+# docs/TPU_MEASUREMENTS_r04.log) so a later wedge cannot erase banked
+# numbers.
 #
 # The TPU is per-process exclusive: only this watcher should touch the
 # real chip. All interactive dev work stays on the CPU mesh.
 
 set -u
 REPO=/root/repo
-LOG="$REPO/docs/TPU_MEASUREMENTS_r03.log"
+LOG="${VEGA_TPU_LOG:-$REPO/docs/TPU_MEASUREMENTS_r04.log}"
 QUEUE="$REPO/benchmarks/tpu_jobs"
 PROBE_TIMEOUT="${VEGA_PROBE_TIMEOUT_S:-90}"
 JOB_TIMEOUT="${VEGA_JOB_TIMEOUT_S:-2400}"

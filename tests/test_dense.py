@@ -1585,3 +1585,50 @@ def test_failed_speculation_repairs_downstream_consumers(dctx):
     assert not dctx.__dict__.get("_dense_pending")
     # the poisoned hint was replaced by working capacities
     assert dctx._dense_capacity_hints[red2._hint_key()] != (128, 128)
+
+
+def test_settlement_midway_error_requeues_failed_entries(dctx):
+    """A later entry's validator raising mid-settlement must put entries
+    ALREADY triaged as failed (an earlier overflowed speculation) back on
+    the backlog too — the next read repairs them rather than silently
+    serving capacity-truncated data (round-3 advisor finding)."""
+    import numpy as np
+
+    def build_a():
+        kv = dctx.dense_range(20_000).map(lambda x: (x % 2_000, x * 1.0))
+        return kv.reduce_by_key(op="add")
+
+    def build_b():
+        kv = dctx.dense_range(24_000).map(lambda x: (x % 500, x * 1.0))
+        return kv.reduce_by_key(op="add")
+
+    exp_a = dict(build_a().collect())  # cold oracles, seed hints
+    exp_b = dict(build_b().collect())
+    a2, b2 = build_a(), build_b()
+    assert a2._hint_key() != b2._hint_key()
+    # Poison A so its warm (speculative) launch overflows.
+    dctx._dense_capacity_hints[a2._hint_key()] = (64, 64)
+    a2.block_spec()
+    b2.block_spec()
+    pending = dctx.__dict__.get("_dense_pending")
+    assert pending and [e["rdd"] for e in pending] == [a2, b2]
+    # Give B a validator that dies mid-settlement (after A was triaged
+    # into the failed list but before its repair ran).
+    for e in pending:
+        if e["rdd"] is b2:
+            e["validate"] = lambda head: (_ for _ in ()).throw(
+                RuntimeError("transient settlement failure"))
+    with pytest.raises(RuntimeError, match="transient settlement"):
+        a2.count()
+    # Every uncommitted entry is back on the backlog — including A,
+    # which had already been moved to the failed list.
+    pend = dctx.__dict__.get("_dense_pending")
+    assert any(e["rdd"] is a2 for e in pend)
+    assert any(e["rdd"] is b2 for e in pend)
+    # Clear the injected fault; the next read settles and repairs A.
+    for e in pend:
+        if e["rdd"] is b2:
+            e["validate"] = None
+    assert dict(a2.collect()) == exp_a
+    assert dict(b2.collect()) == exp_b
+    assert not dctx.__dict__.get("_dense_pending")

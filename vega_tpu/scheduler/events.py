@@ -48,6 +48,10 @@ class StageSubmitted(Event):
 class StageCompleted(Event):
     stage_id: int = -1
     duration_s: float = 0.0
+    # Dense deferred (speculative) launches return before the device
+    # executes — their duration_s measures dispatch latency only and must
+    # not be compared against executed-stage timings.
+    speculative: bool = False
 
 
 @dataclasses.dataclass
@@ -161,7 +165,10 @@ class MetricsListener(Listener):
                     "start": event.time,
                 }
             elif isinstance(event, StageCompleted):
-                self.stages.setdefault(event.stage_id, {})["duration_s"] = event.duration_s
+                info = self.stages.setdefault(event.stage_id, {})
+                info["duration_s"] = event.duration_s
+                if event.speculative:
+                    info["speculative"] = True
             elif isinstance(event, TaskEnd):
                 self.task_count += 1
                 self.total_task_time_s += event.duration_s

@@ -2215,9 +2215,12 @@ def _settle_pending(ctx) -> None:
         # failure): every entry not yet committed goes BACK on the
         # backlog, in order — a stranded entry whose settle became a
         # no-op would silently serve capacity-truncated data later.
+        # That includes entries already triaged into `failed` but not
+        # yet repaired: re-processing them is idempotent (their
+        # overflow flags re-fail and route back through repair).
         # (A deterministic validator error thus re-raises on every
         # subsequent read of the affected pipeline: loud, never wrong.)
-        pend[:0] = entries[i:]
+        pend[:0] = failed + entries[i:]
         raise
     if not failed:
         return
@@ -2423,9 +2426,12 @@ class _ExchangeRDD(DenseRDD):
                 *outs, overflow = prog(*args)
             finally:
                 if bus is not None:
+                    # JAX dispatch is async: prog() returned but the device
+                    # may still be executing — this timing is dispatch-only.
                     bus.post(ev.StageCompleted(
                         stage_id=-self.rdd_id,
                         duration_s=_time.time() - t_start,
+                        speculative=True,
                     ))
             self._last_attempts = 1
             extra = getattr(self, "_fetch_extra_outs", 0)
