@@ -84,7 +84,7 @@ def test_ssh_launch_missing_binary_fails_loudly(tmp_path, monkeypatch):
 _MULTIHOST_SCRIPT = textwrap.dedent("""
     import sys
 
-    sys.path.insert(0, {repo!r})
+    sys.path.insert(0, "__REPO__")
     from _cpu_mesh import force_cpu_mesh
 
     # assert_count=False: the asserts would initialize the XLA backend,
@@ -121,12 +121,89 @@ _MULTIHOST_SCRIPT = textwrap.dedent("""
 """)
 
 
-def test_jax_distributed_two_process_smoke(tmp_path):
-    """tpu/mesh.init_multihost glues two processes into one global device
-    set and a cross-process collective produces the right answer."""
+_MULTIHOST_DENSE_SCRIPT = textwrap.dedent("""
+    import sys
+
+    sys.path.insert(0, "__REPO__")
+    from _cpu_mesh import force_cpu_mesh
+
+    force_cpu_mesh(2, assert_count=False)
+
+    import jax
+    import numpy as np
+
+    import vega_tpu as v
+    from vega_tpu.tpu import block as block_lib
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    ctx = v.Context("local", multihost=dict(
+        coordinator=coordinator, num_processes=2, process_id=pid))
+    try:
+        assert jax.process_count() == 2, jax.process_count()
+        n_global = jax.device_count()
+        assert n_global == 2 * jax.local_device_count()
+
+        # Instrument: the dense path must not gather to host numpy.
+        gathers = {"n": 0}
+        orig_to_numpy = block_lib.Block.to_numpy
+
+        def counting(self):
+            gathers["n"] += 1
+            return orig_to_numpy(self)
+
+        block_lib.Block.to_numpy = counting
+
+        kv = ctx.dense_range(40_000).map(lambda x: (x % 97, x * 1.0))
+        red = kv.reduce_by_key(op="add")
+        table = ctx.dense_from_numpy(
+            np.arange(97, dtype=np.int32),
+            np.arange(97, dtype=np.float32) * 2.0)
+        j = red.join(table)
+        blk = j.block()  # materialize reduce + join, SPMD over the mesh
+        assert gathers["n"] == 0, (
+            "dense pipeline gathered to host numpy %d times" % gathers["n"])
+        # The results live sharded over the GLOBAL mesh: every column
+        # spans both processes' devices (a host round-trip would have
+        # produced fully-addressable arrays).
+        for name, col in blk.cols.items():
+            assert not col.is_fully_addressable, name
+            assert col.sharding.mesh.size == n_global, name
+        rblk = red._block
+        assert rblk is not None
+        assert not rblk.cols[block_lib.KEY].is_fully_addressable
+
+        block_lib.Block.to_numpy = orig_to_numpy
+        got = dict(j.collect())  # the host read itself may gather
+        exp = {k: (sum(x * 1.0 for x in range(40_000) if x % 97 == k),
+                   k * 2.0) for k in range(97)}
+        assert got == exp, "join result mismatch"
+
+        # Replicated/sharded host-input programs must also work over the
+        # global mesh: histogram (replicated edges), zip_with_index
+        # (per-shard offsets), sort_by_key (replicated range bounds).
+        vals = ctx.dense_range(10_000)
+        edges, counts = vals.histogram(4)
+        assert sum(counts) == 10_000, (edges, counts)
+        zipped = ctx.dense_range(1_000).zip_with_index().collect()
+        assert zipped == [(i, i) for i in range(1_000)]
+        sk = (ctx.dense_range(5_000).map(lambda x: (x * 2654435761 %
+                                                    5_000, x))
+              .sort_by_key())
+        keys = [k for k, _ in sk.collect()]
+        assert keys == sorted(x * 2654435761 % 5_000 for x in range(5_000))
+        print("MULTIHOST_DENSE_OK", pid, flush=True)
+    finally:
+        ctx.stop()
+""")
+
+
+def _run_two_process(tmp_path, script_body, timeout_s=420):
+    """Spawn the same worker script as processes 0 and 1 joined through one
+    jax.distributed coordinator; return [(rc, out, err), ...] or skip if
+    the CPU rendezvous/collectives are unsupported here."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
-    script.write_text(_MULTIHOST_SCRIPT.format(repo=repo))
+    script.write_text(script_body.replace("__REPO__", repo))
     coordinator = f"127.0.0.1:{_free_port()}"
 
     env = dict(os.environ)
@@ -143,7 +220,7 @@ def test_jax_distributed_two_process_smoke(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=timeout_s)
             outs.append((p.returncode, out, err))
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -156,5 +233,27 @@ def test_jax_distributed_two_process_smoke(tmp_path):
                         or "unavailable" in err.lower()):
             pytest.skip(f"multi-process CPU collectives unsupported: "
                         f"{err.splitlines()[-1] if err else rc}")
+    return outs
+
+
+def test_multihost_dense_reduce_join_spmd(tmp_path):
+    """Framework-level multi-host dense execution (round-3 verdict item
+    2): a Context on each of two processes joins one jax.distributed
+    global mesh and a dense reduce_by_key + join runs SPMD across BOTH
+    processes through the framework — results stay sharded over the
+    global mesh end to end, with zero host-numpy gathers on the dense
+    path (the reference runs this across executor processes via its
+    shuffle planes, distributed_scheduler.rs:382-445)."""
+    outs = _run_two_process(tmp_path, _MULTIHOST_DENSE_SCRIPT)
+    for rc, out, err in outs:
+        assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
+        assert "MULTIHOST_DENSE_OK" in out
+
+
+def test_jax_distributed_two_process_smoke(tmp_path):
+    """tpu/mesh.init_multihost glues two processes into one global device
+    set and a cross-process collective produces the right answer."""
+    outs = _run_two_process(tmp_path, _MULTIHOST_SCRIPT, timeout_s=240)
+    for rc, out, err in outs:
         assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
         assert "MULTIHOST_OK" in out

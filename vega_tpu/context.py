@@ -49,7 +49,8 @@ _active_context: Optional["Context"] = None
 
 class Context:
     def __init__(self, mode: str | DeploymentMode = "local",
-                 conf: Optional[Configuration] = None, **conf_overrides):
+                 conf: Optional[Configuration] = None,
+                 multihost: Optional[dict] = None, **conf_overrides):
         global _active_context
         self._stopped = False
         # Claim the active slot atomically with the liveness check (a
@@ -77,6 +78,17 @@ class Context:
                     raise TypeError(f"unknown configuration field: {key}")
                 setattr(conf, key, value)
             self.conf = conf
+            if multihost is not None:
+                # Join the jax.distributed global mesh BEFORE any backend
+                # touch: every process runs this same driver program and
+                # the dense tier then executes SPMD over all processes'
+                # devices (the DCN analogue of the reference's multi-host
+                # executor fleet, context.rs:209-303). Keys: coordinator,
+                # num_processes, process_id (each defaultable from the
+                # JAX_* env vars — see tpu/mesh.init_multihost).
+                from vega_tpu.tpu import mesh as _mesh_lib
+
+                _mesh_lib.ensure_multihost(**multihost)
             env = Env.reset(conf, is_driver=True)
             env.map_output_tracker = MapOutputTracker()
             env.cache_tracker = CacheTracker()

@@ -13,6 +13,7 @@ Layout: each column is [n_shards * capacity, ...] sharded on axis 0; rows
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional
 
 import jax
@@ -20,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from vega_tpu.tpu import mesh as mesh_lib
+
+_host_cache_lock = threading.Lock()  # serializes Block.host_cols fills
 
 KEY = "k"  # canonical key column
 VALUE = "v"  # canonical value column
@@ -102,6 +105,27 @@ class Block:
     # an unsettled speculative block could observe capacity-truncated
     # data.
     settle: Optional[object] = None
+    # Multi-process only: replicated host copy of all columns, filled by
+    # the first shard_rows (each host read there costs a full-block
+    # all-gather; per-split consumption reads every shard).
+    _host_cols_cache: Optional[Dict[str, np.ndarray]] = None
+
+    def host_cols(self) -> Dict[str, np.ndarray]:
+        """Replicated host copy of all columns, gathered once.
+
+        The fill is serialized (double-checked lock): two scheduler task
+        threads must not both dispatch the replicate-gather collective —
+        in a multi-process mesh every process has to dispatch the same
+        collectives in the same order, and a duplicated gather on one
+        process deadlocks the others. DenseRDD.splits() pre-fills this on
+        the driver thread before task fan-out for the same reason."""
+        if self._host_cols_cache is None:
+            with _host_cache_lock:
+                if self._host_cols_cache is None:
+                    self._host_cols_cache = {
+                        name: np.asarray(c) for name, c in
+                        mesh_lib.host_get(dict(self.cols)).items()}
+        return self._host_cols_cache
 
     @property
     def n_shards(self) -> int:
@@ -112,7 +136,7 @@ class Block:
         if self.settle is not None:
             self.settle()  # may replace cols/counts/capacity in place
         if self.counts_host is None:
-            self.counts_host = np.asarray(jax.device_get(self.counts))
+            self.counts_host = np.asarray(mesh_lib.host_get(self.counts))
         return self.counts_host
 
     @property
@@ -138,9 +162,15 @@ class Block:
         consumers never see the encoding."""
         counts = self.counts_np
         # One transfer for every column (a separate device_get per column
-        # is a round trip each through the axon tunnel).
-        host_cols = {name: np.asarray(c) for name, c in
-                     jax.device_get(dict(self.cols)).items()}
+        # is a round trip each through the axon tunnel). Multi-process:
+        # share shard_rows' replicated cache — each miss is a full-block
+        # all-gather.
+        first = next(iter(self.cols.values()), None)
+        if isinstance(first, jax.Array) and not first.is_fully_addressable:
+            host_cols = self.host_cols()
+        else:
+            host_cols = {name: np.asarray(c) for name, c in
+                         mesh_lib.host_get(dict(self.cols)).items()}
         out: Dict[str, List[np.ndarray]] = {n: [] for n in self.cols}
         for s in range(self.n_shards):
             lo = s * self.capacity
@@ -155,9 +185,21 @@ class Block:
         counts = self.counts_np
         lo = shard * self.capacity
         c = int(counts[shard])
-        sliced = jax.device_get(
-            {name: col[lo:lo + c] for name, col in self.cols.items()}
-        )  # one transfer for all columns
+        first = next(iter(self.cols.values()), None)
+        if isinstance(first, jax.Array) and not first.is_fully_addressable:
+            # Eager slicing of a non-fully-addressable column is not
+            # defined; fetch whole columns once (replicated all-gather),
+            # cache them on the block — per-split host consumption calls
+            # shard_rows n_shards times — and slice on host. The numpy
+            # (_HostMeshStub) and single-process cases below never touch
+            # jax.process_count(): backend init can hang on a wedged
+            # tunnel and host numpy must stay readable regardless.
+            sliced = {name: np.asarray(col)[lo:lo + c]
+                      for name, col in self.host_cols().items()}
+        else:
+            sliced = jax.device_get(
+                {name: col[lo:lo + c] for name, col in self.cols.items()}
+            )  # one transfer for all columns
         return _decode_key_cols(
             {name: np.asarray(col) for name, col in sliced.items()}
         )
@@ -321,8 +363,8 @@ def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
             counts[s] = c
             if c:
                 dst[s * cap:s * cap + c] = src[lo:hi]
-        cols[name] = jax.device_put(dst, mesh_lib.shard_spec(mesh))
-    counts_arr = jax.device_put(counts, mesh_lib.shard_spec(mesh))
+        cols[name] = mesh_lib.host_put(dst, mesh_lib.shard_spec(mesh))
+    counts_arr = mesh_lib.host_put(counts, mesh_lib.shard_spec(mesh))
     return Block(cols=cols, counts=counts_arr, capacity=cap, mesh=mesh,
                  counts_host=counts)
 
@@ -343,23 +385,21 @@ def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
         dtype=np.int32,
     )
 
-    def build(shard_id):
-        # shard_id: int32[1] per shard under shard_map
-        base = start + shard_id[0] * per
-        vals = base + jax.lax.iota(dtype, cap)
-        return vals
+    def build():
+        # axis_index instead of a device_put'd shard-id input: keeps the
+        # source fully device-built and multiprocess-safe (no host array
+        # to place on non-addressable devices).
+        base = start + jax.lax.axis_index(mesh_lib.SHARD_AXIS) * per
+        return base + jax.lax.iota(dtype, cap)
 
-    shard_ids = jax.device_put(
-        np.arange(n_shards, dtype=np.int32), mesh_lib.shard_spec(mesh)
-    )
     build_sharded = jax.jit(
         jax.shard_map(
-            build, mesh=mesh, in_specs=P(mesh_lib.SHARD_AXIS),
+            build, mesh=mesh, in_specs=(),
             out_specs=P(mesh_lib.SHARD_AXIS),
         )
     )
-    vals = build_sharded(shard_ids)
-    counts = jax.device_put(counts_host, mesh_lib.shard_spec(mesh))
+    vals = build_sharded()
+    counts = mesh_lib.host_put(counts_host, mesh_lib.shard_spec(mesh))
     return Block(cols={VALUE: vals}, counts=counts, capacity=cap, mesh=mesh,
                  counts_host=counts_host)
 

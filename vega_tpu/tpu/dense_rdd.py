@@ -115,7 +115,7 @@ def _cached_program(key, build):
 
 class _HostMeshStub:
     """Stands in for a jax Mesh on the far side of a pickle: Block only
-    reads .size, and jax.device_get passes numpy through, so a Block whose
+    reads .size, and mesh_lib.host_get passes numpy through, so a Block whose
     columns are host numpy works unchanged for reading."""
 
     def __init__(self, size: int):
@@ -202,7 +202,7 @@ class DenseRDD(RDD):
                 "should_cache": self.should_cache,
                 "_pinned": self._pinned,
                 "cols": {n: np.asarray(c) for n, c in
-                         jax.device_get(dict(blk.cols)).items()},
+                         mesh_lib.host_get(dict(blk.cols)).items()},
                 "counts": blk.counts_np,
                 "capacity": blk.capacity,
             }
@@ -325,6 +325,22 @@ class DenseRDD(RDD):
         return self.mesh.size
 
     def splits(self) -> List[Split]:
+        # Host-tier interop only (dense actions bypass the scheduler).
+        # On a multi-process mesh, pre-gather an already-materialized
+        # block's columns HERE: splits() runs on the driver thread at
+        # stage submission (dag.py submit_missing_tasks /
+        # _get_preferred_locs), while compute() fans out to scheduler
+        # task threads whose interleaving differs across processes —
+        # and jax.distributed collectives must be dispatched in the
+        # same order on every process. (An unmaterialized block still
+        # dispatches its exchanges from whichever thread first calls
+        # block(); keep multihost dense pipelines on the dense tier.)
+        blk = self._block
+        if blk is not None and blk.cols:
+            first = next(iter(blk.cols.values()))
+            if isinstance(first, jax.Array) and \
+                    not first.is_fully_addressable:
+                blk.host_cols()
         return [Split(i) for i in range(self.num_partitions)]
 
     def compute(self, split: Split, task_context=None):
@@ -892,7 +908,7 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_reduce, 2, (_SPEC, _SPEC)),
         )
         partials, flags = prog(blk.cols[VALUE], blk.counts)
-        partials, flags = jax.device_get((partials, flags))  # one RTT
+        partials, flags = mesh_lib.host_get((partials, flags))  # one RTT
         partials, flags = np.asarray(partials), np.asarray(flags)
         vals = [partials[i] for i in range(len(flags)) if flags[i]]
         if not vals:
@@ -915,7 +931,7 @@ class DenseRDD(RDD):
             ("named_reduce", self.mesh, op),
             lambda: _shard_program(self.mesh, shard_fn, 2, _SPEC),
         )
-        partials = np.asarray(jax.device_get(prog(blk.cols[VALUE], blk.counts)))
+        partials = np.asarray(mesh_lib.host_get(prog(blk.cols[VALUE], blk.counts)))
         if op == "add":
             return partials.sum(axis=0).item()
         if op == "min":
@@ -996,7 +1012,7 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_topk, 2, (_SPEC, _SPEC)),
         )
         best, n_valid = prog(blk.cols[VALUE], blk.counts)
-        best, n_valid = jax.device_get((best, n_valid))  # one RTT
+        best, n_valid = mesh_lib.host_get((best, n_valid))  # one RTT
         best = np.asarray(best).reshape(blk.n_shards, k)
         n_valid = np.asarray(n_valid)
         candidates = np.concatenate(
@@ -1053,7 +1069,7 @@ class DenseRDD(RDD):
             ),
         )
         outs = prog(blk.counts, *[blk.cols[nm] for nm in names])
-        outs = jax.device_get(outs)  # one RTT
+        outs = mesh_lib.host_get(outs)  # one RTT
         n_valid = np.asarray(outs[0]).reshape(-1)
         per_col = [np.asarray(o).reshape(blk.n_shards, k)
                    for o in outs[1:]]
@@ -1112,7 +1128,7 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_stats, 2, (_SPEC, _SPEC)),
         )
         int_counts, parts = prog(blk.cols[VALUE], blk.counts)
-        int_counts, parts = jax.device_get((int_counts, parts))  # one RTT
+        int_counts, parts = mesh_lib.host_get((int_counts, parts))  # one RTT
         int_counts = np.asarray(int_counts).reshape(-1)
         parts = np.asarray(parts)
         n = int(int_counts.sum())
@@ -1142,7 +1158,7 @@ class DenseRDD(RDD):
             lambda: _shard_program(self.mesh, shard_mm, 2, (_SPEC, _SPEC)),
         )
         parts, int_counts = prog(blk.cols[VALUE], blk.counts)
-        parts, int_counts = jax.device_get((parts, int_counts))  # one RTT
+        parts, int_counts = mesh_lib.host_get((parts, int_counts))  # one RTT
         parts = np.asarray(parts)
         valid = np.asarray(int_counts).reshape(-1) > 0
         if not valid.any():
@@ -1163,7 +1179,9 @@ class DenseRDD(RDD):
             edges = list(buckets)
         n_bins = len(edges) - 1
         blk = self.block()
-        edges_dev = jnp.asarray(edges, dtype=jnp.float32)
+        edges_dev = mesh_lib.host_put(
+            np.asarray(edges, dtype=np.float32),
+            mesh_lib.replicated_spec(self.mesh))
 
         def shard_hist(bnds, vals, counts):
             v = vals.astype(jnp.float32)
@@ -1180,7 +1198,7 @@ class DenseRDD(RDD):
                 self.mesh, shard_hist, (_REPL, _SPEC, _SPEC), _SPEC
             ),
         )
-        parts = np.asarray(jax.device_get(
+        parts = np.asarray(mesh_lib.host_get(
             prog(edges_dev, blk.cols[VALUE], blk.counts)
         ))
         return edges, parts.sum(axis=0).tolist()
@@ -1613,7 +1631,8 @@ class _ZipWithIndexRDD(DenseRDD):
         offsets = np.concatenate(
             [[0], np.cumsum(counts_host)[:-1]]
         ).astype(np.int32)
-        offsets_dev = jnp.asarray(offsets)
+        offsets_dev = mesh_lib.host_put(offsets,
+                                        mesh_lib.shard_spec(self.mesh))
 
         def prog_fn(offsets, counts, vals):
             shard_off = offsets[0]
@@ -2183,7 +2202,7 @@ def _settle_pending(ctx) -> None:
     failed_rdds = set()
     i = 0
     try:
-        fetched = jax.device_get(
+        fetched = mesh_lib.host_get(
             [(e["outs_head"], e["overflow"]) for e in entries])
         for i, (e, (head, ovf)) in enumerate(zip(entries, fetched)):
             head = [np.asarray(h) for h in head]
@@ -2243,6 +2262,7 @@ def _settle_pending(ctx) -> None:
             old.capacity = fresh.capacity
             old.counts_host = fresh.counts_np
             old.settle = None
+            old._host_cols_cache = None  # repaired cols: drop stale copy
             rdd._block = old  # keep the object identity callers captured
     finally:
         ctx.__dict__["_dense_no_defer"] = False
@@ -2320,7 +2340,7 @@ class _ExchangeRDD(DenseRDD):
                                    _SPEC),
         )
         out = prog(blk.counts, *[blk.cols[nm] for nm in in_names])
-        return np.asarray(jax.device_get(out)).reshape(n, n)
+        return np.asarray(mesh_lib.host_get(out)).reshape(n, n)
 
     def _range_histogram(self, blk: Block, bounds_dev,
                          ascending: bool, bounds_lo_dev=None,
@@ -2365,7 +2385,7 @@ class _ExchangeRDD(DenseRDD):
                 + (blk.counts,)
                 + tuple(blk.cols[nm] for nm in in_names))
         out = prog(*args)
-        return np.asarray(jax.device_get(out)).reshape(n, n)
+        return np.asarray(mesh_lib.host_get(out)).reshape(n, n)
 
     def _hint_key(self, *extra):
         """Capacity-hint identity: structural lineage + fetch-free input
@@ -2492,7 +2512,7 @@ class _ExchangeRDD(DenseRDD):
                 # more outputs on the host (join's exact product sizes) set
                 # _fetch_extra_outs to ride the same transfer.
                 extra = getattr(self, "_fetch_extra_outs", 0)
-                fetched, overflow_host = jax.device_get(
+                fetched, overflow_host = mesh_lib.host_get(
                     (tuple(outs[:1 + extra]), overflow)
                 )
                 if not bool(np.any(np.asarray(overflow_host))):
@@ -3170,7 +3190,7 @@ class _SortByKeyRDD(_ExchangeRDD):
                 (_SPEC,) * (2 + composite),
             ),
         )
-        samp_out = jax.device_get(
+        samp_out = mesh_lib.host_get(
             samp_prog(blk.counts, *[blk.cols[nm] for nm in samp_in])
         )
         counts_host = np.asarray(samp_out[0]).reshape(-1)
@@ -3199,12 +3219,13 @@ class _SortByKeyRDD(_ExchangeRDD):
         else:
             bounds = np.zeros((n - 1,),
                               np.dtype(dict(self.parent._schema())[KEY]))
+        repl = mesh_lib.replicated_spec(self.mesh)
         if composite:
             bounds_hi, bounds_lo = block_lib.encode_i64(bounds)
-            bounds_dev = jnp.asarray(bounds_hi)
-            bounds_lo_dev = jnp.asarray(bounds_lo)
+            bounds_dev = mesh_lib.host_put(bounds_hi, repl)
+            bounds_lo_dev = mesh_lib.host_put(bounds_lo, repl)
         else:
-            bounds_dev = jnp.asarray(bounds)
+            bounds_dev = mesh_lib.host_put(bounds, repl)
             bounds_lo_dev = None
         ascending = self.ascending
         exchange = _get_exchange(self.exchange_mode)
@@ -3324,7 +3345,7 @@ class _CartesianDenseRDD(DenseRDD):
                 self.mesh,
             )
         rvals_host = rblk.to_numpy()[VALUE]
-        rvals = jax.device_put(rvals_host,
+        rvals = mesh_lib.host_put(rvals_host,
                                mesh_lib.replicated_spec(self.mesh))
 
         def prog_fn(rv, counts, lvals):

@@ -39,25 +39,61 @@ def init_multihost(coordinator: Optional[str] = None,
     Args default from the standard env vars (JAX_COORDINATOR_ADDRESS /
     JAX_NUM_PROCESSES / JAX_PROCESS_ID) or the TPU metadata service.
     """
-    import os
-
+    coordinator, num_processes, process_id = _normalize_multihost(
+        coordinator, num_processes, process_id)
     kwargs = {}
-    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator:
         kwargs["coordinator_address"] = coordinator
-    if num_processes is not None or os.environ.get("JAX_NUM_PROCESSES"):
-        kwargs["num_processes"] = int(
-            num_processes
-            if num_processes is not None
-            else os.environ["JAX_NUM_PROCESSES"]
-        )
-    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
-        kwargs["process_id"] = int(
-            process_id if process_id is not None
-            else os.environ["JAX_PROCESS_ID"]
-        )
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
     set_default_mesh(None)  # rebuild over the now-global device set
+    global _multihost_settings
+    _multihost_settings = (coordinator, num_processes, process_id)
+
+
+_multihost_settings: Optional[tuple] = None  # set once per process
+
+
+def _normalize_multihost(coordinator, num_processes, process_id) -> tuple:
+    """Apply the env-var defaults so equivalent settings compare equal
+    regardless of whether they came explicit or from the environment."""
+    import os
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = os.environ["JAX_NUM_PROCESSES"]
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = os.environ["JAX_PROCESS_ID"]
+    return (coordinator,
+            None if num_processes is None else int(num_processes),
+            None if process_id is None else int(process_id))
+
+
+def ensure_multihost(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Idempotent init_multihost: jax.distributed.initialize raises on a
+    second call, but a process may legitimately build several successive
+    Contexts (stop() then a new one) against the SAME global mesh. Asking
+    for a different rendezvous than the one this process already joined
+    cannot be honored and must fail loudly, not be masked."""
+    if _multihost_settings is not None:
+        requested = _normalize_multihost(coordinator, num_processes,
+                                         process_id)
+        if requested != _multihost_settings:
+            from vega_tpu.errors import VegaError
+
+            raise VegaError(
+                "this process already joined a jax.distributed mesh with "
+                f"settings {_multihost_settings}; a Context requesting "
+                f"{requested} cannot re-rendezvous (jax.distributed "
+                "initializes once per process)"
+            )
+        return
+    init_multihost(coordinator, num_processes, process_id)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -93,3 +129,59 @@ def shard_spec(mesh: Mesh) -> NamedSharding:
 
 def replicated_spec(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def _identity_outputs(*xs):
+    return xs
+
+
+# One replicate-gather program per mesh: jit wrappers own their dispatch
+# caches, so minting a fresh wrapper per host_get would re-trace every
+# fetch. Keyed by Mesh (hashable); bounded — a process holds O(1) meshes.
+_gather_jit_cache: dict = {}
+
+
+def host_get(tree):
+    """Multiprocess-safe jax.device_get over a pytree — ONE transfer.
+
+    Pure-numpy trees (host-tier _HostMeshStub blocks on worker processes)
+    pass straight through WITHOUT touching the jax backend: device init
+    can hang on a wedged TPU tunnel, and host numpy must stay readable
+    regardless (CLAUDE.md environment quirks). Single-process trees are
+    exactly jax.device_get. Multi-process (jax.distributed global mesh):
+    non-fully-addressable leaves cannot be fetched directly; all of them
+    are replicated in ONE jitted identity program (an XLA all-gather —
+    every process dispatches the same program, SPMD-safe) and then read
+    locally. Drivers on every process therefore observe identical
+    counts/flags and keep making identical dispatch decisions, which is
+    what keeps the multi-controller model coherent."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not any(isinstance(x, jax.Array) for x in leaves):
+        return jax.device_get(tree)  # numpy passthrough, backend-free
+    if jax.process_count() > 1:
+        by_mesh: dict = {}
+        for i, x in enumerate(leaves):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                by_mesh.setdefault(x.sharding.mesh, []).append(i)
+        for m, idx in by_mesh.items():
+            prog = _gather_jit_cache.get(m)
+            if prog is None:
+                prog = jax.jit(_identity_outputs,
+                               out_shardings=NamedSharding(m, P()))
+                _gather_jit_cache[m] = prog
+            gathered = prog(*[leaves[i] for i in idx])
+            for i, g in zip(idx, gathered):
+                leaves[i] = g  # fully replicated: locally readable
+    return jax.tree_util.tree_unflatten(treedef, jax.device_get(leaves))
+
+
+def host_put(value, spec: NamedSharding) -> jax.Array:
+    """Multiprocess-safe jax.device_put of a host value every process
+    holds identically (the SPMD driver model guarantees it): each process
+    materializes only its addressable shards via make_array_from_callback;
+    single-process falls through to plain device_put."""
+    if jax.process_count() == 1:
+        return jax.device_put(value, spec)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(arr.shape, spec,
+                                        lambda idx: arr[idx])
