@@ -1632,3 +1632,73 @@ def test_settlement_midway_error_requeues_failed_entries(dctx):
     assert dict(a2.collect()) == exp_a
     assert dict(b2.collect()) == exp_b
     assert not dctx.__dict__.get("_dense_pending")
+
+
+def test_wide_sum_overflow_detected_and_raises(dctx):
+    """reduce_by_key(op='add') over wide int64 values whose exact total
+    exceeds int64 must raise crisply (device flags the wrap, the
+    host-exact fold confirms non-representability) — never silently
+    wrap like numpy."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    keys = np.array([1, 1, 1, 2], dtype=np.int64)
+    vals = np.array([2**62, 2**62, 2**62, 5], dtype=np.int64)
+    r = dctx.dense_from_numpy(keys, vals)
+    assert isinstance(r, DenseRDD)
+    with pytest.raises(v.VegaError, match="int64 range"):
+        r.reduce_by_key(op="add").collect()
+    # the host tier keeps exact bignums for the same data
+    host = dctx.parallelize(list(zip(keys.tolist(), vals.tolist())))
+    exact = dict(host.reduce_by_key(lambda a, b: a + b).collect())
+    assert exact == {1: 3 * 2**62, 2: 5}
+
+
+def test_wide_sum_in_range_unflagged_and_exact(dctx):
+    """Wide sums whose totals fit int64 stay dense and exact (clean
+    flags prove mod-2^64 == exact), including near-boundary totals."""
+    keys = np.array([7, 7, 8, 8], dtype=np.int64)
+    vals = np.array([2**62, 2**62 - 1, -2**62, -2**62 + 1], dtype=np.int64)
+    r = dctx.dense_from_numpy(keys, vals).reduce_by_key(op="add")
+    assert dict(r.collect()) == {7: 2**63 - 1, 8: -2**63 + 1}
+    assert r.hash_placed  # no fold happened
+
+
+def test_host_exact_fold_rebuilds_schema_and_resets_placement(dctx):
+    """_host_exact_fold: exact totals, schema-faithful wide re-encoding,
+    narrow int columns wrap like the device, placement/order flags reset
+    so downstream exchanges skip elision."""
+    from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu.dense_rdd import _ReduceByKeyRDD
+
+    k = np.array([2**40, 2**40, 3], dtype=np.int64)
+    wide_v = np.array([2**62, -2**61, 2**35], dtype=np.int64)
+    narrow_v = np.array([2**30, 2**30, 7], dtype=np.int64)  # sum wraps i32
+    src = dctx.dense_from_columns(
+        {"k": k, "w": wide_v, "m": narrow_v}, key="k")
+    node = _ReduceByKeyRDD(src, op="add", func=None)
+    blk = node._host_exact_fold()
+    assert node._host_folded
+    assert not node.hash_placed and not node.key_sorted
+    got = blk.to_numpy()
+    by_key = {kk: (w, m) for kk, w, m in
+              zip(got["k"].tolist(), got["w"].tolist(), got["m"].tolist())}
+    # wide column: exact int64 totals
+    assert by_key[2**40][0] == 2**62 - 2**61
+    assert by_key[3][0] == 2**35
+    # narrow column wraps to int32 exactly like the device would:
+    # 2^30 + 2^30 = 2^31 -> two's-complement -2^31
+    assert by_key[2**40][1] == -2**31
+    assert by_key[3][1] == 7
+    # schema kept the wide pair encoding
+    assert block_lib.lo_of("w") in blk.cols
+    # downstream keyed exchange over the folded node: placement reset
+    # means a REAL exchange (no elision over stale placement) and the
+    # re-reduce of the already-reduced rows reproduces the same totals
+    node._block = blk  # what the settle-repair path installs
+    again = node.reduce_by_key(op="add")
+    got2 = again.block().to_numpy()
+    by_key2 = {kk: (w, m) for kk, w, m in
+               zip(got2["k"].tolist(), got2["w"].tolist(),
+                   got2["m"].tolist())}
+    assert by_key2 == by_key
+    assert not getattr(again, "_elided", True)

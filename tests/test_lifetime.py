@@ -1,0 +1,174 @@
+"""Dense block lifetime: HBM accounting, LRU eviction of intermediates,
+unpersist. The device-tier counterpart of the host tier's BoundedMemoryCache
+LRU tests (cache.py); the reference leaves cache eviction unimplemented
+(cache.rs:68-76 todo!())."""
+
+import gc
+import weakref
+
+import numpy as np
+import pytest
+
+import vega_tpu as v
+from vega_tpu.env import Env
+
+
+@pytest.fixture()
+def dctx():
+    context = v.Context("local", num_workers=2)
+    yield context
+    context.stop()
+
+
+# Sized so dense_range(20_000) stays a materialized (non-streamed) source:
+# the stream planner only kicks in when rows * itemsize * 6 > budget, i.e.
+# above 25_000 int32 rows at this budget. Each 20_000-row block lands in an
+# 8-shard x 4096-capacity x 4-byte layout = 131_072 tracked bytes, so four
+# blocks fit (524_288 <= 600_000) and a fifth forces one LRU eviction.
+_BUDGET = 600_000
+_N = 20_000
+_BLOCK_BYTES = 131_072
+
+
+@pytest.fixture()
+def small_budget():
+    old = Env.get().conf.dense_hbm_budget
+    Env.get().conf.dense_hbm_budget = _BUDGET
+    yield _BUDGET
+    Env.get().conf.dense_hbm_budget = old
+
+
+def test_unpersist_releases_and_recomputes(dctx):
+    r = dctx.dense_range(10_000).map(lambda x: x * 2)
+    total = r.sum()
+    assert r._block is not None
+    blk_ref = weakref.ref(r._block)
+    assert dctx.dense_hbm_in_use() > 0
+
+    r.unpersist()
+    assert r._block is None
+    gc.collect()
+    assert blk_ref() is None, "unpersisted Block must actually be freed"
+
+    # next access re-materializes from lineage with identical results
+    assert r.sum() == total
+    assert dctx.dense_hbm_in_use() > 0
+
+
+def test_source_unpersist_is_noop(dctx):
+    src = dctx.dense_from_numpy(np.arange(1000), np.arange(1000))
+    src.count()
+    src.unpersist()
+    assert src._block is not None  # a source's block IS the data
+    assert src.count() == 1000
+
+
+def test_chain_of_pipelines_stays_under_budget(dctx, small_budget):
+    """A session of successive dense pipelines must not accumulate dead
+    intermediates: tracked bytes stay bounded by dense_hbm_budget."""
+    results = []
+    for i in range(8):
+        r = (dctx.dense_range(_N)
+             .map(lambda x: (x % 100, x))
+             .reduce_by_key(op="add"))
+        results.append(dict(r.collect()))
+        assert dctx.dense_hbm_in_use() <= small_budget
+    # every pipeline computed the same correct result
+    exp = results[0]
+    assert all(got == exp for got in results[1:])
+    assert exp[0] == sum(x for x in range(_N) if x % 100 == 0)
+
+
+def test_evicted_intermediate_is_freed_and_recomputable(dctx, small_budget):
+    early = dctx.dense_range(_N).map(lambda x: x + 1)
+    blk = early.block()  # materialize + register
+    assert blk.nbytes == _BLOCK_BYTES
+    blk_ref = weakref.ref(blk)
+    del blk
+
+    # four later intermediates (held live) push tracked bytes past the
+    # budget exactly once; the sweep evicts the oldest (early)
+    later = [dctx.dense_range(_N).map(lambda x, i=i: x * (2 + i))
+             for i in range(4)]
+    for r in later:
+        r.block()
+    assert early._block is None, "LRU should have evicted the oldest block"
+    gc.collect()
+    assert blk_ref() is None, "evicted Block must actually be freed"
+    assert dctx.dense_hbm_in_use() <= small_budget
+
+    # recompute-from-lineage transparency
+    assert early.sum() == _N * (_N - 1) // 2 + _N
+
+
+def test_mru_retained_lru_evicted(dctx, small_budget):
+    a = dctx.dense_range(_N).map(lambda x: x + 1)
+    b = dctx.dense_range(_N).map(lambda x: x + 2)
+    c = dctx.dense_range(_N).map(lambda x: x + 3)
+    d = dctx.dense_range(_N).map(lambda x: x + 4)
+    e = dctx.dense_range(_N).map(lambda x: x + 5)
+    a.block()
+    b.block()
+    a.block()  # touch a: now b is LRU
+    c.block()
+    d.block()
+    e.block()  # 5th live block: exactly one eviction — the LRU (b)
+    assert b._block is None, "LRU entry should have been evicted"
+    assert a._block is not None, "touched (MRU) entry should survive"
+    assert all(r._block is not None for r in (c, d, e))
+
+
+def test_pending_speculative_block_not_evicted(dctx, small_budget):
+    """An unsettled speculative exchange output must never be evicted —
+    its pending entry settles/repairs through the same Block object."""
+    from vega_tpu.tpu import dense_rdd as dr
+
+    # warm run mints the capacity hint so the second launch defers
+    warm = (dctx.dense_range(30_000).map(lambda x: (x % 64, x))
+            .reduce_by_key(op="add"))
+    warm.collect()
+
+    spec = (dctx.dense_range(30_000).map(lambda x: (x % 64, x))
+            .reduce_by_key(op="add"))
+    blk = spec.block_spec()
+    if blk.settle is not None:  # deferred launch actually happened
+        # sweep at a zero budget: the pending block must survive
+        old = Env.get().conf.dense_hbm_budget
+        Env.get().conf.dense_hbm_budget = 0
+        try:
+            dr._lifetime_evict(dctx)
+        finally:
+            Env.get().conf.dense_hbm_budget = old
+        assert spec._block is blk
+    # settlement still verifies and the data is right
+    got = dict(spec.collect())
+    exp = {}
+    for x in range(30_000):
+        exp[x % 64] = exp.get(x % 64, 0) + x
+    assert got == exp
+
+
+def test_accounting_prunes_dead_pipelines(dctx):
+    """Dropping the last user reference to a pipeline frees its tracked
+    blocks: cached fused programs keep only detached transform state
+    (_detach), never the nodes, so node death is refcount-prompt."""
+    r = dctx.dense_range(20_000).map(lambda x: x + 1)
+    blk_ref = weakref.ref(r.block())
+    assert dctx.dense_hbm_in_use() > 0
+    del r
+    gc.collect()
+    assert dctx.dense_hbm_in_use() == 0
+    assert blk_ref() is None, "dead pipeline's block must be freed"
+
+
+def test_dead_exchange_pipeline_is_freed(dctx):
+    """Exchange programs (reduce) must not pin their nodes either — the
+    rbk closure captures detached _segment_reduce state, not self."""
+    r = (dctx.dense_range(20_000).map(lambda x: (x % 50, x))
+         .reduce_by_key(op="add"))
+    r.collect()
+    node_ref = weakref.ref(r)
+    del r
+    gc.collect()
+    assert node_ref() is None, "dead reduce node must not be pinned"
+    assert dctx.dense_hbm_in_use() == 0

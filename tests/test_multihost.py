@@ -197,6 +197,63 @@ _MULTIHOST_DENSE_SCRIPT = textwrap.dedent("""
 """)
 
 
+_MULTIHOST_LIFETIME_SCRIPT = textwrap.dedent("""
+    import signal
+    import sys
+
+    sys.path.insert(0, "__REPO__")
+    from _cpu_mesh import force_cpu_mesh
+
+    force_cpu_mesh(2, assert_count=False)
+
+    # A divergent eviction decision across processes would deadlock a
+    # collective; die loudly instead of hanging into the outer timeout.
+    signal.alarm(240)
+
+    import jax
+
+    import vega_tpu as v
+    from vega_tpu.env import Env
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    ctx = v.Context("local", multihost=dict(
+        coordinator=coordinator, num_processes=2, process_id=pid))
+    try:
+        assert jax.process_count() == 2
+        BUDGET = 600_000
+        Env.get().conf.dense_hbm_budget = BUDGET
+
+        # Evictions under pressure: every process must make the same
+        # decisions (same driver program -> same registration order and
+        # byte totals), or a re-materialization's collectives would be
+        # dispatched on one process only.
+        nodes = [ctx.dense_range(20_000).map(lambda x, i=i: x + i)
+                 for i in range(6)]
+        exp = [20_000 * (20_000 - 1) // 2 + 20_000 * i for i in range(6)]
+        for nd in nodes:
+            nd.block()
+        assert ctx.dense_hbm_in_use() <= BUDGET
+        evicted = [nd for nd in nodes if nd._block is None]
+        assert evicted, "pressure should have evicted at least one block"
+        # Re-materialize an evicted node: recompute-from-lineage must
+        # re-dispatch its program on BOTH processes identically.
+        for i, nd in enumerate(nodes):
+            assert nd.sum() == exp[i]
+        # End-to-end pipelines keep working (and stay under budget)
+        # while eviction churns.
+        for i in range(3):
+            r = (ctx.dense_range(20_000)
+                 .map(lambda x: (x % 53, x))
+                 .reduce_by_key(op="add"))
+            got = dict(r.collect())
+            assert got[0] == sum(x for x in range(20_000) if x % 53 == 0)
+            assert ctx.dense_hbm_in_use() <= BUDGET
+        print("MULTIHOST_LIFETIME_OK", pid, flush=True)
+    finally:
+        ctx.stop()
+""")
+
+
 def _run_two_process(tmp_path, script_body, timeout_s=420):
     """Spawn the same worker script as processes 0 and 1 joined through one
     jax.distributed coordinator; return [(rc, out, err), ...] or skip if
@@ -248,6 +305,18 @@ def test_multihost_dense_reduce_join_spmd(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
         assert "MULTIHOST_DENSE_OK" in out
+
+
+def test_multihost_dense_lifetime_eviction(tmp_path):
+    """Dense block lifetime across processes: LRU eviction decisions are
+    replicated (same driver program -> same order and byte totals), so
+    recompute-from-lineage after eviction re-dispatches collectives on
+    every process without divergence — the SPMD-determinism property the
+    lifetime module's design note relies on."""
+    outs = _run_two_process(tmp_path, _MULTIHOST_LIFETIME_SCRIPT)
+    for rc, out, err in outs:
+        assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
+        assert "MULTIHOST_LIFETIME_OK" in out
 
 
 def test_jax_distributed_two_process_smoke(tmp_path):

@@ -189,3 +189,31 @@ def test_hash32_pair_distributes_over_low_word():
     buckets = (kernels.hash32_pair(hi, lo) % jnp.uint32(8)).astype(np.int32)
     counts = np.bincount(np.asarray(buckets), minlength=8)
     assert counts.min() > 4096 // 8 // 4  # roughly uniform
+
+
+def test_wide_add_checked_overflow_predicate():
+    """Signed-overflow detection over the wide encoding: equal-sign
+    operands whose int64 sum wraps must flag; everything else must not."""
+    from vega_tpu.tpu import block as block_lib
+
+    cases = np.array([
+        (2**62, 2**62),            # positive wrap
+        (-2**62, -2**62 - 1),      # negative wrap
+        (2**62, -2**62),           # mixed signs: never wraps
+        (2**62, 2**62 - 1),        # max boundary: 2^63-1, fits
+        (-2**63 + 1, -1),          # min boundary: -2^63, fits
+        (-2**63, -1),              # below min: wraps
+        (123, 456),                # small
+        (0x7FFFFFFF, 1),           # low-word carry only, no int64 wrap
+    ], dtype=np.int64)
+    a, b = cases[:, 0], cases[:, 1]
+    ah, al = block_lib.encode_i64(a)
+    bh, bl = block_lib.encode_i64(b)
+    rh, rl, ovf = kernels.wide_add_checked(
+        jnp.asarray(ah), jnp.asarray(al), jnp.asarray(bh), jnp.asarray(bl))
+    got = block_lib.decode_i64(np.asarray(rh), np.asarray(rl))
+    exp_wrap = (a + b)  # numpy int64 wraps mod 2^64
+    np.testing.assert_array_equal(got, exp_wrap)
+    exact = a.astype(object) + b.astype(object)
+    exp_ovf = np.array([v < -2**63 or v > 2**63 - 1 for v in exact])
+    np.testing.assert_array_equal(np.asarray(ovf), exp_ovf)

@@ -226,6 +226,16 @@ class Context:
 
         return dense_load_npz(self, path, chunk_rows=chunk_rows)
 
+    def dense_hbm_in_use(self) -> int:
+        """Tracked device-resident bytes of materialized dense
+        intermediates. Intermediates above Configuration.dense_hbm_budget
+        are LRU-evicted (lineage recomputes them on next access); sources
+        are gated at creation by the streaming planner instead. See the
+        lifetime note in tpu/dense_rdd.py."""
+        from vega_tpu.tpu.dense_rdd import dense_hbm_in_use
+
+        return dense_hbm_in_use(self)
+
     def profiler(self, log_dir: str):
         """JAX profiler trace over a block of work (the tracing subsystem
         the reference never built — SURVEY.md §5 'Tracing: none'). View with

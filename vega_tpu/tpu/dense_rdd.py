@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import math
+import weakref
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -120,6 +121,142 @@ class _HostMeshStub:
 
     def __init__(self, size: int):
         self.size = size
+
+
+# ---------------------------------------------------------------------------
+# dense block lifetime (HBM accounting + LRU eviction)
+# ---------------------------------------------------------------------------
+#
+# Every materialized intermediate registers in a per-Context LRU keyed by
+# node identity. When the tracked resident bytes exceed
+# Configuration.dense_hbm_budget, least-recently-used blocks are RELEASED:
+# the node's memoized Block reference is dropped, so HBM frees once no
+# computation holds the buffers, and the next access re-materializes from
+# lineage — recompute-over-spill, the device analogue of the host tier's
+# BoundedMemoryCache LRU (cache.py; the reference leaves eviction as
+# todo!(), cache.rs:68-76). Sources are exempt (their Block IS the data —
+# nothing to rebuild from; their footprint is gated at creation by the
+# streaming planner) and so are unsettled speculative blocks (their pending
+# entry must settle/repair through the SAME object).
+#
+# Multi-process note: in SPMD multihost runs the driver program is
+# replicated, so registration order, byte totals, and therefore eviction
+# decisions are identical on every process — a divergent decision would
+# make one process re-dispatch exchange collectives the others skip.
+# Decisions depend only on refcount-deterministic state (no wall clock).
+
+
+def _lifetime_lru(ctx) -> dict:
+    return ctx.__dict__.setdefault("_dense_block_lru", {})
+
+
+def _lifetime_touch(rdd) -> None:
+    lru = rdd.context.__dict__.get("_dense_block_lru")
+    if lru is not None:
+        ref = lru.pop(id(rdd), None)
+        if ref is not None:
+            lru[id(rdd)] = ref  # re-insert at MRU end
+
+
+def _lifetime_register(rdd) -> None:
+    lru = _lifetime_lru(rdd.context)
+    lru.pop(id(rdd), None)
+    lru[id(rdd)] = weakref.ref(rdd)
+    _lifetime_evict(rdd.context, keep=id(rdd))
+
+
+def _lifetime_forget(rdd) -> None:
+    lru = rdd.context.__dict__.get("_dense_block_lru")
+    if lru is not None:
+        lru.pop(id(rdd), None)
+
+
+def _lifetime_sweep(lru: dict) -> Tuple[int, list]:
+    """Prune dead/evicted entries; return (total tracked bytes, live keys
+    in LRU->MRU order)."""
+    live = []
+    total = 0
+    for key in list(lru):
+        rdd = lru[key]()
+        if rdd is None or rdd._block is None:
+            del lru[key]
+            continue
+        total += rdd._block.nbytes
+        live.append(key)
+    return total, live
+
+
+def dense_hbm_in_use(ctx) -> int:
+    """Tracked device-resident bytes of materialized dense intermediates
+    (sources excluded — see the lifetime note above). Prunes dead refs."""
+    lru = ctx.__dict__.get("_dense_block_lru")
+    if not lru:
+        return 0
+    return _lifetime_sweep(lru)[0]
+
+
+def _lifetime_evict(ctx, keep: Optional[int] = None) -> None:
+    from vega_tpu.env import Env
+
+    budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
+    lru = ctx.__dict__.get("_dense_block_lru")
+    if not lru:
+        return
+    total, live = _lifetime_sweep(lru)
+    if total <= budget:
+        return
+    for key in live:  # LRU -> MRU (dict insertion order)
+        if total <= budget:
+            break
+        if key == keep:
+            continue
+        rdd = lru[key]()
+        if rdd is None:
+            lru.pop(key, None)
+            continue
+        blk = rdd._block
+        if blk is None:
+            lru.pop(key, None)
+            continue
+        if blk.settle is not None:
+            continue  # pending speculation: evictable only once settled
+        total -= blk.nbytes
+        rdd._block = None
+        rdd.__dict__.pop("_pickle_state_memo", None)
+        del lru[key]
+        log.debug("dense lifetime: evicted block of rdd %s (%d bytes)",
+                  rdd.rdd_id, blk.nbytes)
+
+
+# Attributes a detached clone must NOT carry: lineage links, the Context,
+# materialized blocks, and speculation state. Everything else (user fns,
+# schemas, op names, scalars) is the per-shard transform state cached
+# programs legitimately need for retraces.
+_HEAVY_ATTRS = frozenset({
+    "context", "_deps", "_dense_parents", "parent", "left", "right",
+    "first", "second", "_block", "_pickle_state_memo", "_fp_memo",
+    "_cfp_memo", "_checkpointed_rdd", "_deferred_entry",
+})
+
+
+def _detach(node):
+    """Light clone of a node for program-cache closures.
+
+    Programs in the structural cache live for the process (they retrace on
+    new capacities), so a closure that captures the node itself pins its
+    whole lineage — parents, Context, and every block those ever
+    materialize, including un-evictable source data — long after the
+    pipeline dies. The clone shares the node's class (so _shard_fn /
+    _segment_reduce and friends work unchanged) but carries only the
+    light transform state, never lineage or blocks."""
+    clone = object.__new__(type(node))
+    clone.__dict__.update(
+        (k, v) for k, v in node.__dict__.items() if k not in _HEAVY_ATTRS)
+    return clone
+
+
+def _detached_chain(chain):
+    return [_detach(nd) for nd in chain]
 
 
 def _yield_rows(rows: dict):
@@ -244,9 +381,33 @@ class DenseRDD(RDD):
         an unverified overflow flag. Only for consumers that register
         their own pending entry (so a failed speculation invalidates and
         repairs them too) — everything else must use block()."""
-        if self._block is None:
-            self._block = self._materialize()
-        return self._block
+        blk = self._block
+        if blk is None:
+            blk = self._materialize()
+            self._block = blk
+            # Only lineage-recomputable nodes enter the eviction LRU:
+            # sources set _block in __init__ and never take this path.
+            # Return the captured local: a concurrent eviction (host-tier
+            # task threads share dense nodes) may null _block again.
+            _lifetime_register(self)
+        else:
+            _lifetime_touch(self)
+        return blk
+
+    def unpersist(self) -> "DenseRDD":
+        """Release this node's materialized device block (the analogue of
+        the host tier's uncache; reference eviction is todo!(),
+        cache.rs:68-76). Pending speculation settles first so a captured
+        Block reference can't observe truncated data. The next access
+        re-materializes from lineage. Returns self for chaining."""
+        blk = self._block
+        if blk is not None:
+            if blk.settle is not None:
+                blk.settle()
+            self._block = None
+            self.__dict__.pop("_pickle_state_memo", None)
+            _lifetime_forget(self)
+        return self
 
     def _counts_fp(self):
         """Fetch-free identity of this node's input sizes: materialized
@@ -542,13 +703,15 @@ class DenseRDD(RDD):
         partitioner_or_num is accepted for API parity; dense output is always
         one partition per mesh shard.
 
-        Dtype contract: device sums wrap like numpy — int64 values use the
-        wide (hi, lo) encoding and op='add' wraps mod 2^64 (kernels.wide_add)
-        — while a closure that falls back to the host tier folds exact
-        Python bignums. Near-int64-range totals therefore differ between
-        op='add' and an untraceable lambda a, b: a + b; there is no device
-        overflow flag (pairwise detection under reassociation would
-        false-positive on totals that fit)."""
+        Dtype contract: int64 values use the wide (hi, lo) encoding and
+        op='add' tracks signed overflow on device (kernels.wide_add_checked
+        flags ride the exchange like capacity flags). A set flag routes to
+        a host-exact fold: totals that fit int64 are rebuilt densely
+        (transient wraps under reassociation are harmless — mod-2^64
+        results equal exact totals whenever they fit), totals beyond int64
+        raise a crisp VegaError pointing at the host tier, which keeps
+        exact Python bignums. op='add' and an untraceable lambda a, b:
+        a + b therefore agree wherever both are representable."""
         if not self.is_pair:
             raise VegaError("reduce_by_key on non-pair DenseRDD")
         if op is None and func is None:
@@ -1351,6 +1514,7 @@ class _NarrowRDD(DenseRDD):
         # root via the shared walk (exchange fusion uses the same one, so
         # the two sites cannot disagree about what a chain is).
         chain, root = _narrow_chain(self)
+        chain = _detached_chain(chain)  # cached program must not pin nodes
         root_block = root.block()
         names = list(root_block.cols)
         out_names = [n for n, _ in self._out_schema]
@@ -1874,6 +2038,13 @@ class _SourceRDD(DenseRDD):
 
     def _materialize(self) -> Block:
         return self._block
+
+    def unpersist(self) -> "DenseRDD":
+        """No-op: a source's Block IS its data — there is no lineage to
+        rebuild it from, so releasing it would lose the dataset. Source
+        footprint is gated at creation (the streaming planner caps
+        whole-block sources at dense_hbm_budget)."""
+        return self
 
     def _schema(self):
         return tuple((n, c.dtype) for n, c in self._block.cols.items())
@@ -2547,38 +2718,74 @@ class _ExchangeRDD(DenseRDD):
                 ))
 
 
-def _named_wide_combine(op: str, value_names, wide: dict):
+# Synthetic flag column tracking signed overflow of wide int64 adds through
+# an exchange: injected before the map-side combine, OR-merged per key by
+# _named_wide_combine, and collapsed to one per-shard flag output (the
+# capacity-flag pattern applied to arithmetic).
+_SOVF = "__sovf"
+
+
+def _named_wide_combine(op: str, value_names, wide: dict,
+                        ovf_name: Optional[str] = None):
     """Per-column combine for a named op over a mix of narrow columns and
     wide (hi, lo) int64 pairs: narrow columns use the plain monoid, wide
     pairs use carry addition / lexicographic select (kernels.wide_add /
-    wide_select)."""
+    wide_select). With ovf_name (add only), the named column carries a
+    sticky int32 flag OR-ing every pair-add's signed-overflow predicate —
+    clean flags PROVE the mod-2^64 results equal the exact totals."""
     narrow_ops = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum,
                   "prod": jnp.multiply}
     lo_names = set(wide.values())
 
     def combine(a, b):
         out = {}
+        flag = None
         for nm in value_names:
-            if nm in lo_names:
+            if nm in lo_names or nm == ovf_name:
                 continue
             if nm in wide:
                 lo = wide[nm]
                 if op == "add":
-                    out[nm], out[lo] = kernels.wide_add(
-                        a[nm], a[lo], b[nm], b[lo])
+                    if ovf_name is not None:
+                        out[nm], out[lo], o = kernels.wide_add_checked(
+                            a[nm], a[lo], b[nm], b[lo])
+                        flag = o if flag is None else (flag | o)
+                    else:
+                        out[nm], out[lo] = kernels.wide_add(
+                            a[nm], a[lo], b[nm], b[lo])
                 else:  # min/max (prod is rejected at build time)
                     out[nm], out[lo] = kernels.wide_select(
                         a[nm], a[lo], b[nm], b[lo], op == "min")
             else:
                 out[nm] = narrow_ops[op](a[nm], b[nm])
+        if ovf_name is not None:
+            f = a[ovf_name] | b[ovf_name]
+            if flag is not None:
+                f = f | flag.astype(f.dtype)
+            out[ovf_name] = f
         return out
 
     return combine
 
 
 class _ReduceByKeyRDD(_ExchangeRDD):
-    hash_placed = True  # output rows live on shard hash(key) % n
-    key_sorted = True   # segment ends come out in key order
+    @property
+    def hash_placed(self) -> bool:
+        """Output rows live on shard hash(key) % n — EXCEPT after a
+        host-exact fold (wide-sum overflow takeover), which rebuilds with
+        no device placement. Read from the materialized truth:
+        block_spec() doesn't settle, and a later failed speculation
+        invalidates dependents through _settle_pending's lineage walk, so
+        an early read stays sound."""
+        self.block_spec()
+        return not getattr(self, "_host_folded", False)
+
+    @property
+    def key_sorted(self) -> bool:
+        """Segment ends come out in key order — except after a host-exact
+        fold (same materialized-truth read as hash_placed)."""
+        self.block_spec()
+        return not getattr(self, "_host_folded", False)
 
     def __init__(self, parent: DenseRDD, op: Optional[str], func):
         super().__init__(parent.context, parent.mesh, [parent])
@@ -2656,10 +2863,13 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             if wide:
                 # Wide int64 values can't ride the XLA segment ops (the
                 # carry couples the two words) — same segmented scan the
-                # traced combiners use, with the carry/lex combine.
+                # traced combiners use, with the carry/lex combine. An
+                # injected _SOVF column (add only) accumulates the
+                # overflow flags through the scan.
                 combine = _named_wide_combine(
                     self._op, [nm for nm in cols
-                               if nm not in (KEY, KEY_LO)], wide)
+                               if nm not in (KEY, KEY_LO)], wide,
+                    ovf_name=_SOVF if _SOVF in cols else None)
                 return kernels.segment_reduce_sorted(
                     cols, count, KEY, combine, presorted=presorted,
                     lo_name=lo_name,
@@ -2685,6 +2895,69 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             cols, count, KEY, combine, presorted=presorted, lo_name=lo_name
         )
 
+    def _host_exact_fold(self) -> Block:
+        """Host-tier takeover after the device flagged a possible wide
+        int64 sum overflow: fold exact Python bignums over the parent's
+        decoded rows, then rebuild a block in THIS node's schema (wide
+        pairs re-encoded). A clean rebuild means the flagged wrap was
+        transient (reassociation) and the exact totals fit; totals beyond
+        int64 are not representable on device and raise crisply — the
+        host tier (.to_rdd()) keeps exact bignums. The rebuilt block has
+        no device placement/order guarantees: hash_placed/key_sorted
+        report the materialized truth, so downstream exchanges skip
+        elision instead of trusting stale placement."""
+        log.info("wide int64 device sum flagged overflow; "
+                 "host-exact fold takes over")
+        parent_cols = self.parent.block().to_numpy()  # wide pairs decoded
+        schema = dict(self._schema())
+        keys = np.asarray(parent_cols[KEY])
+        keys_list = keys.tolist()
+        vnames = [nm for nm in parent_cols if nm != KEY]
+        slot_of: dict = {}
+        for k in keys_list:
+            if k not in slot_of:
+                slot_of[k] = len(slot_of)
+        i64 = np.iinfo(np.int64)
+        out_cols: dict = {}
+        if block_lib.KEY_LO in schema:
+            hi, lo = block_lib.encode_i64(
+                np.asarray(list(slot_of), dtype=np.int64))
+            out_cols[KEY], out_cols[block_lib.KEY_LO] = hi, lo
+        else:
+            out_cols[KEY] = np.asarray(list(slot_of), dtype=keys.dtype)
+        for nm in vnames:
+            col = np.asarray(parent_cols[nm])
+            if np.issubdtype(col.dtype, np.integer):
+                acc = [0] * len(slot_of)
+                for k, v in zip(keys_list, col.tolist()):
+                    acc[slot_of[k]] += v  # exact python ints
+            else:
+                acc = [0.0] * len(slot_of)
+                for k, v in zip(keys_list, col.tolist()):
+                    acc[slot_of[k]] += v
+            if block_lib.lo_of(nm) in schema:  # wide in this schema
+                if any(v < i64.min or v > i64.max for v in acc):
+                    raise VegaError(
+                        f"reduce_by_key(op='add'): exact total of column "
+                        f"{nm!r} exceeds the int64 range and cannot be "
+                        "represented on device — use the host tier "
+                        "(.to_rdd()) for exact bignum sums"
+                    )
+                hi, lo = block_lib.encode_i64(
+                    np.asarray(acc, dtype=np.int64))
+                out_cols[nm], out_cols[block_lib.lo_of(nm)] = hi, lo
+            elif np.issubdtype(col.dtype, np.integer):
+                # narrow int columns wrap to their dtype, matching the
+                # device's modular arithmetic
+                info = np.iinfo(np.dtype(schema[nm]))
+                span = 1 << info.bits
+                acc = [((v - info.min) % span) + info.min for v in acc]
+                out_cols[nm] = np.asarray(acc, dtype=np.dtype(schema[nm]))
+            else:
+                out_cols[nm] = np.asarray(acc, dtype=np.dtype(schema[nm]))
+        self._host_folded = True
+        return block_lib.from_numpy(out_cols, self.mesh)
+
     def _materialize(self) -> Block:
         n = self.mesh.size
         # Partitioner-equality elision, device edition: a hash-placed
@@ -2706,15 +2979,26 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # oversized — those materialize the parent as before.
         chain, root = (_narrow_chain(self.parent) if n > 1 and not elide
                        else ([], self.parent))
+        chain = _detached_chain(chain)  # cached program must not pin nodes
         blk = root.block_spec()  # we register our own pending entry
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
         exchange = _get_exchange(self.exchange_mode)
+        this = _detach(self)  # _segment_reduce state without the node
+        # Wide int64 adds track signed overflow through the whole exchange
+        # (the capacity-flag pattern applied to arithmetic): an injected
+        # _SOVF column rides pre-combine -> exchange -> merge, collapses
+        # to one per-shard flag fetched with the counts, and a set flag
+        # routes to the host-exact fold (see _host_exact_fold).
+        track_sovf = self._op == "add" and bool(
+            block_lib.wide_value_pairs(names))
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(in_names, col_arrays))
                 cols, count = _apply_chain(chain, cols, counts[0])
+                if track_sovf:
+                    cols[_SOVF] = jnp.zeros(cols[KEY].shape[0], jnp.int32)
                 if n > 1 and not elide:
                     # 2-sort exchange: ONE multi-key sort (bucket major,
                     # key minor) feeds both the presorted map-side combine
@@ -2728,7 +3012,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     cols, bucket = kernels.bucket_key_sort(
                         cols, count, bucket, KEY, lo_name=_lo_of(cols)
                     )
-                    cols, count = self._segment_reduce(cols, count,
+                    cols, count = this._segment_reduce(cols, count,
                                                        presorted=True)
                     # compact kept (bucket, key) order; re-derive the
                     # combiner rows' buckets from their keys (hash is cheap
@@ -2749,20 +3033,26 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                         cols, count, capacity, out_cap
                     )
                 # reduce-side merge (reference: shuffled_rdd.rs:149-170)
-                cols, count = self._segment_reduce(cols, count,
+                cols, count = this._segment_reduce(cols, count,
                                                    presorted=elide_sorted)
-                return (count.reshape(1),) + tuple(
+                res = (count.reshape(1),)
+                if track_sovf:
+                    m = kernels.valid_mask(cols[_SOVF].shape[0], count)
+                    sovf = jnp.any(jnp.where(m, cols[_SOVF], 0) != 0)
+                    res += (sovf.reshape(1).astype(jnp.int32),)
+                return res + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
             key = ("rbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide, elide_sorted,
-                   self.exchange_mode, self._op or _fp(self._func))
+                   self.exchange_mode, self._op or _fp(self._func),
+                   track_sovf)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
                     self.mesh, prog_fn, 1 + len(in_names),
-                    (_SPEC,) * (2 + len(names)),
+                    (_SPEC,) * (2 + track_sovf + len(names)),
                 ),
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in in_names])
@@ -2772,10 +3062,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # counts are already host-known, else the parent's capacity —
         # never a fetch. Slot is unused by the passthrough.
         self._elided = elide
+        if track_sovf:
+            # sovf rides the (counts, overflow) transfer; deferred
+            # launches re-check it at settlement via validate.
+            self._fetch_extra_outs = 1
+        validate = ((lambda head: not bool(np.any(np.asarray(head[1]))))
+                    if track_sovf else None)
         if elide:
             outs, out_cap = self._run_exchange(
                 build, lambda: blk.counts_np,
                 fixed_caps=(0, _elide_out_cap(blk)),
+                validate=validate,
             )
         else:
             outs, out_cap = self._run_exchange(
@@ -2783,8 +3080,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 make_hists=lambda: ([self._hash_histogram(blk, chain)],
                                     None),
                 hint_key=self._hint_key(),
+                validate=validate,
             )
-        counts, col_arrays = outs[0], outs[1:]
+        if track_sovf:
+            counts, col_arrays = outs[0], outs[2:]
+            extra = self._last_extra_host
+            if extra and np.any(np.asarray(extra[0])):
+                # Blocking path saw the flag inline (the deferred path
+                # reaches here via _settle_pending's repair rerun).
+                return self._host_exact_fold()
+        else:
+            counts, col_arrays = outs[0], outs[1:]
         return self._attach_pending(Block(
             cols=dict(zip(names, col_arrays)), counts=counts,
             capacity=out_cap, mesh=self.mesh,
@@ -2815,6 +3121,7 @@ class _GroupByKeyRDD(_ExchangeRDD):
         # sizing uses raw counts, which a fused filter would inflate).
         chain, root = (_narrow_chain(self.parent) if n > 1 and not elide
                        else ([], self.parent))
+        chain = _detached_chain(chain)  # cached program must not pin nodes
         blk = root.block_spec()  # we register our own pending entry
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
@@ -2951,6 +3258,10 @@ class _JoinRDD(_ExchangeRDD):
                            if n > 1 and not l_elide else ([], self.left))
         r_chain, r_root = (_narrow_chain(self.right)
                            if n > 1 and not r_elide else ([], self.right))
+        # cached program must not pin nodes
+        l_chain = _detached_chain(l_chain)
+        r_chain = _detached_chain(r_chain)
+        outer, fill_value = self.outer, self.fill_value
         lblk = l_root.block_spec()  # we register our own pending entry
         rblk = r_root.block_spec()
         l_in = list(lblk.cols)
@@ -3001,7 +3312,7 @@ class _JoinRDD(_ExchangeRDD):
                 )
                 joined, jcount, jtotal = kernels.merge_join_expand(
                     lcols, lcount, rcols, rcount, KEY, join_cap,
-                    outer=self.outer, fill_value=self.fill_value,
+                    outer=outer, fill_value=fill_value,
                     left_sorted=l_sorted, right_sorted=r_sorted,
                     lo_name=lo_name,
                 )
@@ -3150,6 +3461,7 @@ class _SortByKeyRDD(_ExchangeRDD):
         # counts; see reduce). The range exchange itself never elides.
         chain, root = (_narrow_chain(self.parent) if n > 1
                        else ([], self.parent))
+        chain = _detached_chain(chain)  # cached program must not pin nodes
         blk = root.block()
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
@@ -3168,6 +3480,7 @@ class _SortByKeyRDD(_ExchangeRDD):
         # tunnel). Post-chain counts also size the exchange exactly when
         # the chain filters rows.
         m = max(1, self.sample_size // max(1, blk.n_shards))
+        samp_cap = blk.capacity  # plain int: samp_fn must not pin the Block
 
         def samp_fn(counts_arg, *col_arrays):
             cols, count = _apply_chain(
@@ -3177,7 +3490,7 @@ class _SortByKeyRDD(_ExchangeRDD):
                        else (cols[KEY],))
             stride = jnp.maximum(jnp.int32(1), count // jnp.int32(m))
             pos = jnp.clip(lax.iota(jnp.int32, 2 * m) * stride,
-                           0, max(blk.capacity - 1, 0))
+                           0, max(samp_cap - 1, 0))
             return (count.reshape(1),) + tuple(
                 jnp.take(kc, pos).reshape(1, -1) for kc in keycols
             )
@@ -3542,6 +3855,7 @@ class _DenseUnionRDD(DenseRDD):
         b = self.second.block()
         names = [n for n, _ in self._schema()]
         out_cap = block_lib._round_capacity(a.capacity + b.capacity)
+        cap_a = a.capacity  # plain int: the closure must not pin the Block
 
         def shard_concat(ac, bc, *cols):
             half = len(names)
@@ -3560,7 +3874,7 @@ class _DenseUnionRDD(DenseRDD):
             # mark validity: rows [0,a_count) and [cap_a, cap_a+b_count)
             idx = lax.iota(jnp.int32, out_cap)
             keep = (idx < a_count) | (
-                (idx >= a.capacity) & (idx < a.capacity + b_count)
+                (idx >= cap_a) & (idx < cap_a + b_count)
             )
             return kernels.compact(out, keep, out_cap) + tuple()
 

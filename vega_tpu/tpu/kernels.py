@@ -345,6 +345,22 @@ def wide_add(a_hi, a_lo, b_hi, b_lo):
     return a_hi + b_hi + carry, _wide_rebias(s)
 
 
+def wide_add_checked(a_hi, a_lo, b_hi, b_lo):
+    """wide_add plus a signed-overflow predicate: operands of equal sign
+    whose sum's sign differs wrapped past the int64 range. The final
+    mod-2^64 value is still exact whenever the TRUE total fits int64, so a
+    sticky OR of these per-pair flags through a reduction is a conservative
+    "total may be out of range" detector (false positives possible under
+    reassociation; never false negatives)."""
+    au, bu = _wide_unbias(a_lo), _wide_unbias(b_lo)
+    s = au + bu  # uint32 wrap
+    carry = (s < au).astype(jnp.int32)
+    r_hi = a_hi + b_hi + carry
+    same_sign = (a_hi < 0) == (b_hi < 0)
+    ovf = same_sign & ((r_hi < 0) != (a_hi < 0))
+    return r_hi, _wide_rebias(s), ovf
+
+
 def wide_select(a_hi, a_lo, b_hi, b_lo, take_min: bool):
     """Lexicographic (hi, biased-lo) min/max — signed compares equal
     int64 order by construction of the encoding."""
