@@ -1,0 +1,7 @@
+#!/bin/bash
+# Hardware-gated test tier (tests/test_tpu_hw.py): validates overflow
+# retry, speculation settlement, streaming, wide int64, and sort on the
+# real chip — the paths whose behavior differs most from the CPU mesh.
+# conftest skips these without VEGA_TPU_HW_TESTS=1.
+cd /root/repo
+VEGA_TPU_HW_TESTS=1 exec python -m pytest tests/test_tpu_hw.py -m tpu -v

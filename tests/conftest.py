@@ -16,9 +16,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from _cpu_mesh import force_cpu_mesh  # noqa: E402
 
 # Must precede backend initialization (first jax.devices()/jit call).
-force_cpu_mesh(8)
+# VEGA_TPU_HW_TESTS=1 is the hardware tier: the tpu_jobs queue sets it in
+# a healthy tunnel window so @pytest.mark.tpu tests run on the real chip;
+# everything else keeps the virtual CPU mesh.
+_HW = os.environ.get("VEGA_TPU_HW_TESTS") == "1"
+if not _HW:
+    force_cpu_mesh(8)
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs real TPU hardware (run via the tpu_jobs "
+        "queue with VEGA_TPU_HW_TESTS=1)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if _HW:
+        return  # hardware window: run everything selected (-m tpu)
+    skip_hw = pytest.mark.skip(reason="real-TPU test: needs "
+                               "VEGA_TPU_HW_TESTS=1 in a tunnel window")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_hw)
 
 
 @pytest.fixture()

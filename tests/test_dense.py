@@ -632,8 +632,8 @@ def test_dense_int64_values_fall_back_keys_stay_dense(dctx):
     """int64 beyond int32 range stays DENSE on both sides of a pair: keys
     AND values ride the wide (name, name.lo) two-column encoding (named
     reduces use device carry arithmetic; traced binops fall back but the
-    source stays dense). The one remaining degrade is a keyless bare
-    int64 single column — whole-column folds there are host work."""
+    source stays dense). Keyless bare int64 single columns stay dense
+    too (test_keyless_int64_stays_dense)."""
     from vega_tpu.tpu.block import KEY_LO
     from vega_tpu.tpu.dense_rdd import DenseRDD
 
@@ -648,8 +648,8 @@ def test_dense_int64_values_fall_back_keys_stay_dense(dctx):
     got = dict(big_vals.reduce_by_key(op="add").collect())
     assert got == {1: 2**40 + 3, 2: 2}  # device carry arithmetic
     bare = dctx.dense_from_numpy(np.array([2**40, 2, 3], dtype=np.int64))
-    assert not isinstance(bare, DenseRDD)
-    assert bare.reduce(lambda a, b: a + b) == 2**40 + 5
+    assert isinstance(bare, DenseRDD)  # keyless wide: stays dense now
+    assert bare.reduce(lambda a, b: a + b) == 2**40 + 5  # host fold, exact
     # int64 keys beyond int32 range: composite encoding, still a DenseRDD
     big_keys = dctx.dense_from_numpy(
         np.array([2**40, 1, 2**40], dtype=np.int64),
@@ -1702,3 +1702,65 @@ def test_host_exact_fold_rebuilds_schema_and_resets_placement(dctx):
                    got2["m"].tolist())}
     assert by_key2 == by_key
     assert not getattr(again, "_elided", True)
+
+
+def test_keyless_int64_stays_dense(dctx):
+    """VERDICT item 7: keyless bare int64 single columns get the wide
+    (VALUE, VALUE.lo) encoding instead of degrading to the host tier.
+    Named reductions fold on device; order ops sort the pair; closures
+    and structure-changing ops fall back with exact decoded rows."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    data = [2**40, -2**35, 7, 2**62, -2**40, 0, 2**40]
+    arr = np.array(data, dtype=np.int64)
+    r = dctx.dense_from_numpy(arr)
+    assert isinstance(r, DenseRDD)
+    assert "v.lo" in r.columns
+
+    # device folds, exact
+    assert r.count() == len(data)
+    assert r.sum() == sum(data)
+    assert r.min() == min(data)
+    assert r.max() == max(data)
+    assert r.mean() == sum(data) / len(data)
+    # collect/take decode transparently
+    assert r.collect() == data
+    assert sorted(r.take(3)) == sorted(data[:3])
+    # device order ops over the wide pair
+    assert r.take_ordered(3) == sorted(data)[:3]
+    assert r.top(3) == sorted(data, reverse=True)[:3]
+    # closures fall back to the host tier with decoded int64s
+    assert r.map(lambda x: x % 97).count() == len(data)
+    assert r.filter(lambda x: x > 0).count() == sum(1 for x in data if x > 0)
+    assert r.reduce(lambda a, b: a + b) == sum(data)
+    # host-fallback aggregations stay exact
+    assert r.count_by_value()[2**40] == 2
+    assert r.stats()["count"] == len(data)
+    edges, hist = r.histogram([-2**63, 0, 2**63 - 1])
+    assert sum(hist) == len(data)
+    assert r.zip_with_index().collect() == [(x, i) for i, x in
+                                            enumerate(data)]
+
+
+def test_keyless_int64_sum_overflow_exact(dctx):
+    """A keyless wide sum whose partials wrap int64 comes back as the
+    EXACT Python bignum (actions have host-return semantics; the sticky
+    device flag routes to a driver refold)."""
+    arr = np.array([2**62, 2**62, 2**62], dtype=np.int64)
+    r = dctx.dense_from_numpy(arr)
+    assert r.sum() == 3 * 2**62  # > int64 max, exact bignum
+    mixed = np.array([2**62, 2**62, -2**62, 5], dtype=np.int64)
+    assert dctx.dense_from_numpy(mixed).sum() == 2**62 + 5
+
+
+def test_values_dense_keeps_wide_pair_on_device(dctx):
+    """values_dense() over a wide-valued pair block yields a keyless wide
+    DenseRDD (no host detour) whose folds run on device."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    r = dctx.dense_from_numpy(np.array([1, 2, 1], dtype=np.int32),
+                              np.array([2**40, 5, 2**41], dtype=np.int64))
+    vals = r.values_dense()
+    assert isinstance(vals, DenseRDD)
+    assert vals.sum() == 2**40 + 2**41 + 5
+    assert vals.max() == 2**41

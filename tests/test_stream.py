@@ -239,3 +239,51 @@ def test_streamed_npz_int64_keys_consistent_chunks(ctx, tmp_path):
     for k in keys.tolist():
         exp[k] = exp.get(k, 0) + 1
     assert got == exp
+
+
+def test_streamed_wide_overflow_fold_keeps_placement_honest(ctx, monkeypatch):
+    """Regression: the streamed reduce accumulator must take hash_placed
+    from the MATERIALIZED merge node, not assume True. The reachable bug:
+    chunk 1's partial trips the wide-add overflow flag and host-folds
+    (positional, not hash, placement) — it IS the first accumulator — and
+    the old unconditional hash_placed=True made every later chunk's merge
+    ELIDE its exchange over mis-placed rows, silently dropping merges.
+    A sentinel low word present only in chunk 1 makes the flag fire there
+    deterministically (its exact totals still fit int64, so the fold
+    rebuilds densely); later chunks stay clean and would elide."""
+    import numpy as np
+
+    from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu import kernels
+    from vega_tpu.tpu.stream import streamed_npz
+
+    sent = 2**40 + 12345
+    _, sent_lo = block_lib.encode_i64(np.array([sent], dtype=np.int64))
+    sent_lo = int(sent_lo[0])
+    orig = kernels.wide_add_checked
+
+    def flag_on_sentinel(ah, al, bh, bl):
+        h, lo, ovf = orig(ah, al, bh, bl)
+        return h, lo, ovf | (al == sent_lo) | (bl == sent_lo)
+
+    monkeypatch.setattr(kernels, "wide_add_checked", flag_on_sentinel)
+
+    n_keys = 48
+    # chunk 1: two rows per key, one carrying the sentinel -> its segment
+    # combine sees sent_lo and flags -> partial host-folds
+    k1 = np.repeat(np.arange(n_keys), 2).astype(np.int64)
+    v1 = np.where(np.arange(2 * n_keys) % 2 == 0, sent,
+                  2**40).astype(np.int64)
+    # chunks 2..4: clean wide values, same keys
+    rng = np.random.RandomState(5)
+    k_rest = rng.randint(0, n_keys, size=3 * 2 * n_keys).astype(np.int64)
+    v_rest = (rng.randint(1, 2**20, size=k_rest.size).astype(np.int64)
+              + np.int64(2**41))
+    keys = np.concatenate([k1, k_rest])
+    vals = np.concatenate([v1, v_rest])
+    s = streamed_npz(ctx, {"k": keys, "v": vals}, chunk_rows=2 * n_keys)
+    got = dict(s.reduce_by_key(op="add").collect())
+    exp = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        exp[k] = exp.get(k, 0) + v
+    assert got == exp

@@ -301,10 +301,7 @@ def encode_value_columns(columns: Dict[str, np.ndarray]
     (name, name.lo) encoding; in-range integers keep the narrow path.
     Idempotent like encode_key_columns (pre-encoded ".lo" columns pass
     through — the streamed source encodes ONCE on the full column so
-    every chunk gets the same schema, then slices). The sole block layout
-    with no wide-value form is a bare VALUE column on a keyless block
-    (single_column gates it): every reduction there is a plain int64 fold
-    the host tier does exactly."""
+    every chunk gets the same schema, then slices)."""
     out: Dict[str, np.ndarray] = {}
     for name, col in columns.items():
         if is_lo(name):
@@ -405,10 +402,11 @@ def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
 
 
 def single_column(values, mesh=None) -> Block:
-    # Keyless single-column blocks have no wide form (every op on them is
-    # a whole-column fold/scan the host tier does exactly on int64) —
-    # out-of-range int64 raises in _check_dtype and the source degrades.
-    return from_numpy({VALUE: np.asarray(values)}, mesh, wide_values=False)
+    # Keyless int64 columns beyond int32 range use the wide (VALUE,
+    # VALUE.lo) encoding like every other column: named reductions fold
+    # the pair on device (dense_rdd._named_reduce_wide) and row-wise
+    # closures fall back to the host tier, which sees decoded int64s.
+    return from_numpy({VALUE: np.asarray(values)}, mesh)
 
 
 def pair_block(keys, values, mesh=None) -> Block:

@@ -173,15 +173,19 @@ def _lifetime_forget(rdd) -> None:
 
 def _lifetime_sweep(lru: dict) -> Tuple[int, list]:
     """Prune dead/evicted entries; return (total tracked bytes, live keys
-    in LRU->MRU order)."""
+    in LRU->MRU order). Concurrent-safe against evict/unpersist/touch on
+    other host-tier task threads: every read is a single snapshot (.get,
+    one _block capture), never a check-then-reread."""
     live = []
     total = 0
     for key in list(lru):
-        rdd = lru[key]()
-        if rdd is None or rdd._block is None:
-            del lru[key]
+        ref = lru.get(key)
+        rdd = ref() if ref is not None else None
+        blk = rdd._block if rdd is not None else None
+        if blk is None:
+            lru.pop(key, None)
             continue
-        total += rdd._block.nbytes
+        total += blk.nbytes
         live.append(key)
     return total, live
 
@@ -210,11 +214,9 @@ def _lifetime_evict(ctx, keep: Optional[int] = None) -> None:
             break
         if key == keep:
             continue
-        rdd = lru[key]()
-        if rdd is None:
-            lru.pop(key, None)
-            continue
-        blk = rdd._block
+        ref = lru.get(key)
+        rdd = ref() if ref is not None else None
+        blk = rdd._block if rdd is not None else None
         if blk is None:
             lru.pop(key, None)
             continue
@@ -223,7 +225,7 @@ def _lifetime_evict(ctx, keep: Optional[int] = None) -> None:
         total -= blk.nbytes
         rdd._block = None
         rdd.__dict__.pop("_pickle_state_memo", None)
-        del lru[key]
+        lru.pop(key, None)
         log.debug("dense lifetime: evicted block of rdd %s (%d bytes)",
                   rdd.rdd_id, blk.nbytes)
 
@@ -239,6 +241,18 @@ _HEAVY_ATTRS = frozenset({
 })
 
 
+def _heavy_value(v) -> bool:
+    """Fail-closed backstop for _detach: any attribute VALUE that is (or
+    shallowly contains) an RDD or Block pins lineage/HBM if captured in a
+    process-lifetime program closure — strip it even under a name
+    _HEAVY_ATTRS doesn't know (e.g. a future `self.table = other_rdd`)."""
+    if isinstance(v, (RDD, Block)):
+        return True
+    if isinstance(v, (tuple, list)):
+        return any(isinstance(x, (RDD, Block)) for x in v)
+    return False
+
+
 def _detach(node):
     """Light clone of a node for program-cache closures.
 
@@ -248,10 +262,13 @@ def _detach(node):
     materialize, including un-evictable source data — long after the
     pipeline dies. The clone shares the node's class (so _shard_fn /
     _segment_reduce and friends work unchanged) but carries only the
-    light transform state, never lineage or blocks."""
+    light transform state, never lineage or blocks: known-heavy names are
+    denylisted, and _heavy_value strips RDD/Block-valued attributes under
+    ANY name so a new attribute fails closed, not open."""
     clone = object.__new__(type(node))
     clone.__dict__.update(
-        (k, v) for k, v in node.__dict__.items() if k not in _HEAVY_ATTRS)
+        (k, v) for k, v in node.__dict__.items()
+        if k not in _HEAVY_ATTRS and not _heavy_value(v))
     return clone
 
 
@@ -650,6 +667,9 @@ class DenseRDD(RDD):
         tier needs a full counting job, base.py zip_with_index)."""
         if self.is_pair:
             raise VegaError("zip_with_index on pair DenseRDD — use values()")
+        if self._wide_value():
+            # the wide pair would become a wide KEY with dropped low word
+            return RDD.zip_with_index(self)
         return _ZipWithIndexRDD(self)
 
     def map_values(self, f: Callable):
@@ -1006,10 +1026,11 @@ class DenseRDD(RDD):
         return _ProjectRDD(self, KEY)
 
     def values_dense(self):
-        if block_lib.lo_of(VALUE) in dict(self._schema()):
-            # A keyless single-column block has no wide form (see
-            # block.single_column); decoded rows via the host tier.
-            return self.to_rdd().map(lambda kv: kv[1])
+        if self._wide_value():
+            # keep the wide pair on device: select() carries the low-word
+            # partner, yielding a keyless wide block (named reductions
+            # fold it on device; closures fall back to decoded rows)
+            return self.select(VALUE)
         return _ProjectRDD(self, VALUE)
 
     # --- actions ------------------------------------------------------------
@@ -1028,6 +1049,10 @@ class DenseRDD(RDD):
     def collect_arrays(self) -> dict:
         """Columnar collect — no per-row Python objects."""
         return self.block().to_numpy()
+
+    def _wide_value(self) -> bool:
+        """True when VALUE is a wide (two-column int64) encoding."""
+        return block_lib.lo_of(VALUE) in dict(self._schema())
 
     def sum(self):
         return self._named_reduce("add")
@@ -1052,6 +1077,10 @@ class DenseRDD(RDD):
         col = VALUE if not self.is_pair else None
         if col is None:
             return super().reduce(f)  # pairs: host semantics
+        if self._wide_value():
+            # No scalar row form for wide int64 — host fold sees the
+            # decoded int64s (and keeps exact bignum arithmetic).
+            return super().reduce(f)
         cap = blk.capacity
 
         def shard_reduce(vals, counts):
@@ -1085,6 +1114,8 @@ class DenseRDD(RDD):
         blk = self.block()
         if self.is_pair:
             raise VegaError(f"{op}() on pair DenseRDD — reduce values instead")
+        if block_lib.lo_of(VALUE) in blk.cols:
+            return self._named_reduce_wide(op, blk)
 
         def shard_fn(vals, counts):
             partial = kernels.masked_reduce(vals, counts[0], op)
@@ -1100,6 +1131,56 @@ class DenseRDD(RDD):
         if op == "min":
             return partials.min(axis=0).item()
         return partials.max(axis=0).item()
+
+    def _named_reduce_wide(self, op: str, blk: Block):
+        """sum/min/max over a wide (two-column int64) keyless VALUE: one
+        per-shard device fold with the same carry/lex combine the keyed
+        exchanges use, then an exact Python fold over the n_shards
+        partials on the driver. add partials carry the sticky overflow
+        flag (kernels.wide_add_checked) — a flagged shard's partial may
+        have wrapped, so the driver refolds exactly from the decoded
+        rows. Actions return Python ints, so even beyond-int64 totals
+        come back exact (host-tier semantics)."""
+        vlo = block_lib.lo_of(VALUE)
+        track = op == "add"
+
+        def shard_fold(hi, lo, counts):
+            count = counts[0]
+            cap = hi.shape[0]
+            keyed = {"__k": jnp.zeros((cap,), jnp.int32), VALUE: hi,
+                     vlo: lo}
+            names = [VALUE, vlo]
+            if track:
+                keyed[_SOVF] = jnp.zeros((cap,), jnp.int32)
+                names.append(_SOVF)
+            combine = _named_wide_combine(
+                op, names, {VALUE: vlo},
+                ovf_name=_SOVF if track else None)
+            out, n_out = kernels.segment_reduce_sorted(
+                keyed, count, "__k", combine, presorted=True)
+            flag = out[_SOVF][:1] if track else jnp.zeros((1,), jnp.int32)
+            return (out[VALUE][:1], out[vlo][:1], flag,
+                    (n_out > 0).reshape(1).astype(jnp.int32))
+
+        prog = _cached_program(
+            ("named_reduce_wide", self.mesh, op),
+            lambda: _shard_program(self.mesh, shard_fold, 3, (_SPEC,) * 4),
+        )
+        his, los, flags, nonempty = (
+            np.asarray(x) for x in mesh_lib.host_get(
+                prog(blk.cols[VALUE], blk.cols[vlo], blk.counts)))
+        valid = nonempty.reshape(-1) != 0
+        partials = block_lib.decode_i64(his.reshape(-1), los.reshape(-1))
+        if op == "add":
+            if np.any(flags.reshape(-1)[valid]):
+                # some shard partial wrapped int64: exact host refold
+                col = blk.to_numpy()[VALUE]
+                return sum(int(x) for x in col.tolist())
+            return sum(int(x) for x in partials[valid])
+        picked = partials[valid]
+        if picked.size == 0:
+            raise VegaError(f"{op}() of empty DenseRDD")
+        return int(picked.min()) if op == "min" else int(picked.max())
 
     def sample(self, with_replacement: bool, fraction: float,
                seed: Optional[int] = None):
@@ -1121,7 +1202,8 @@ class DenseRDD(RDD):
     def count_by_value(self) -> dict:
         """Device count_by_value: value->key exchange + segment count
         (host semantics: rdd.rs:450-464)."""
-        if self.is_pair:
+        if self.is_pair or self._wide_value():
+            # wide: no scalar row form for the value->key map closure
             return RDD.count_by_value(self)
         keyed = _MapRDD(self, lambda x: (x, jnp.int32(1)))
         return dict(_ReduceByKeyRDD(keyed, op="add", func=None).collect())
@@ -1134,14 +1216,16 @@ class DenseRDD(RDD):
         an ordering."""
         if key is not None:
             return RDD.take_ordered(self, n, key)
-        if self.is_pair:
+        if self.is_pair or self._wide_value():
+            # wide int64 values: the row sort orders the adjacent
+            # (VALUE, VALUE.lo) pair lexicographically == int64 order
             return self._device_topk_rows(n, largest=False)
         return self._device_topk(n, largest=False)
 
     def top(self, n: int, key=None) -> list:
         if key is not None:
             return RDD.top(self, n, key)
-        if self.is_pair:
+        if self.is_pair or self._wide_value():
             return self._device_topk_rows(n, largest=True)
         return self._device_topk(n, largest=True)
 
@@ -1264,6 +1348,8 @@ class DenseRDD(RDD):
                 for i in order[:n]]
         if out_names == [KEY, VALUE]:
             return [(k_.item(), v_.item()) for k_, v_ in rows]
+        if len(out_names) == 1:  # keyless single column: scalars, not
+            return [row[0].item() for row in rows]  # 1-tuples
         return [tuple(x.item() for x in row) for row in rows]
 
     def stats(self) -> dict:
@@ -1272,8 +1358,8 @@ class DenseRDD(RDD):
         import math
 
         blk = self.block()
-        if self.is_pair:
-            return RDD.stats(self)
+        if self.is_pair or self._wide_value():
+            return RDD.stats(self)  # wide: host sees decoded int64 rows
 
         def shard_stats(vals, counts):
             count = counts[0]
@@ -1308,6 +1394,9 @@ class DenseRDD(RDD):
 
     def _min_max(self):
         """Fused single-pass min+max (one device program, not two)."""
+        if self._wide_value():
+            # two wide folds (the fused f32 program can't carry int64)
+            return self._named_reduce("min"), self._named_reduce("max")
         blk = self.block()
 
         def shard_mm(vals, counts):
@@ -1330,7 +1419,8 @@ class DenseRDD(RDD):
 
     def histogram(self, buckets):
         """Device histogram: bucketize + per-shard bincount + driver sum."""
-        if self.is_pair:
+        if self.is_pair or self._wide_value():
+            # wide: float32 bucketing would mangle int64s; host is exact
             return RDD.histogram(self, buckets)
         if isinstance(buckets, int):
             lo, hi = self._min_max()

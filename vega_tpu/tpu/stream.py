@@ -239,11 +239,15 @@ class StreamedDenseRDD:
                           func, partitioner_or_num, op=op, exchange=exchange))
             # Materialize now and keep only the block: drops the lineage
             # references to this chunk's source so its HBM frees before the
-            # next chunk builds. hash_placed: both union sides are exchange
-            # outputs, so the per-chunk merge reduce elides its exchange
-            # (zero collectives in the accumulator fold).
+            # next chunk builds. hash_placed comes from the MATERIALIZED
+            # node, not assumed True: exchange outputs normally are (so
+            # the per-chunk merge reduce elides, zero collectives), but a
+            # wide-int64 overflow repair rebuilds via the host-exact fold
+            # with no device placement — eliding over that block would
+            # leave equal keys on different shards unmerged.
             blk = merged.block()
-            acc = dense_from_block(self.context, blk, hash_placed=True)
+            acc = dense_from_block(self.context, blk,
+                                   hash_placed=merged.hash_placed)
             log.info(
                 "streamed reduce_by_key: chunk %d/%d -> %d keys "
                 "(accumulator %.1f MiB device-resident)",
