@@ -1,0 +1,96 @@
+"""A/B: speculative settlement's driver-RTT elimination (round-3 work).
+
+A hinted (warm) exchange launches WITHOUT the blocking (counts, overflow)
+fetch and settles the whole backlog in ONE transfer at the next genuine
+host read. On the axon tunnel every blocking fetch is a full network RTT
+sitting between otherwise async-pipelined device launches, so the honest
+CPU-measurable proxy while the tunnel is wedged is the COUNT of blocking
+device->host transfers per pipeline run:
+
+  A) cold run (no hints): every exchange pays its sizing histogram fetch
+     and its (counts, overflow) fetch
+  B) warm rerun (hinted): zero per-exchange fetches; one settlement
+     transfer at the terminal read
+
+Prints one JSON line with both counts, the wall times, and the implied
+saving at a given tunnel RTT. Usage: python benchmarks/rtt_ab.py [rows]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# VEGA_RTT_AB_TPU=1 (tpu_jobs queue, healthy window) runs on the real
+# chip, where the warm/cold wall-time gap IS the tunnel-RTT effect.
+_TPU = os.environ.get("VEGA_RTT_AB_TPU") == "1"
+if not _TPU:
+    from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+    force_cpu_mesh(8)
+
+ASSUMED_TUNNEL_RTT_S = 0.050  # order-of-magnitude; measured when healthy
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+
+    import numpy as np
+
+    import vega_tpu as v
+    from vega_tpu.tpu import mesh as mesh_lib
+
+    counts = {"n": 0}
+    orig = mesh_lib.host_get
+
+    def counting_host_get(tree):
+        counts["n"] += 1
+        return orig(tree)
+
+    def build(ctx):
+        kv = ctx.dense_range(rows).map(lambda x: (x % 10_000, x * 1.0))
+        red = kv.reduce_by_key(op="add")
+        table = ctx.dense_from_numpy(np.arange(10_000, dtype=np.int32),
+                                     np.arange(10_000, dtype=np.float32))
+        return red.join(table)
+
+    ctx = v.Context("local")
+    try:
+        mesh_lib.host_get = counting_host_get
+        t0 = time.time()
+        n0 = counts["n"]
+        j1 = build(ctx)
+        cold_rows = j1.count()
+        cold_s = time.time() - t0
+        cold_fetches = counts["n"] - n0
+
+        t0 = time.time()
+        n0 = counts["n"]
+        j2 = build(ctx)
+        warm_rows = j2.count()
+        warm_s = time.time() - t0
+        warm_fetches = counts["n"] - n0
+        assert warm_rows == cold_rows
+    finally:
+        mesh_lib.host_get = orig
+        ctx.stop()
+
+    saved = cold_fetches - warm_fetches
+    print(json.dumps({
+        "bench": "rtt_ab",
+        "rows": rows,
+        "cold_fetches": cold_fetches,
+        "warm_fetches": warm_fetches,
+        "fetches_saved_per_run": saved,
+        "cold_s": round(cold_s, 3),
+        "warm_s": round(warm_s, 3),
+        "implied_saving_s_at_50ms_rtt": round(
+            saved * ASSUMED_TUNNEL_RTT_S, 3),
+        "backend": "tpu" if _TPU else "cpu-mesh-proxy",
+    }))
+
+
+if __name__ == "__main__":
+    main()

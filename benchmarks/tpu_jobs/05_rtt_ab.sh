@@ -1,0 +1,8 @@
+#!/bin/bash
+# Speculative-settlement RTT A/B on the real chip: warm (hinted,
+# deferred-fetch) vs cold (blocking) reduce+join. The warm/cold wall gap
+# here IS the tunnel-RTT effect the round-3 machinery targets; the CPU
+# proxy (docs/BENCH_NOTES.md round 4) measured 3 of 4 blocking fetches
+# eliminated.
+cd /root/repo
+VEGA_RTT_AB_TPU=1 exec python benchmarks/rtt_ab.py 20000000

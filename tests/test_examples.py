@@ -9,7 +9,9 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FAST_EXAMPLES = ["make_rdd.py", "subtract.py", "file_read.py",
-                 "columnar_analytics.py", "streamed_billion_rows.py"]
+                 "columnar_analytics.py", "streamed_billion_rows.py",
+                 "group_by.py", "join.py", "parquet_column_read.py",
+                 "distributed_cluster.py"]  # all nine ship runnable
 
 
 @pytest.mark.parametrize("example", FAST_EXAMPLES)

@@ -254,8 +254,14 @@ def test_streamed_wide_overflow_fold_keeps_placement_honest(ctx, monkeypatch):
     import numpy as np
 
     from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu import dense_rdd as dr
     from vega_tpu.tpu import kernels
     from vega_tpu.tpu.stream import streamed_npz
+
+    # Fresh program cache: a structurally identical program compiled by an
+    # earlier test would bypass the patched kernel (cache keys carry no
+    # kernel fingerprint) and make this test pass vacuously.
+    monkeypatch.setattr(dr, "_PROGRAM_CACHE", {})
 
     sent = 2**40 + 12345
     _, sent_lo = block_lib.encode_i64(np.array([sent], dtype=np.int64))

@@ -32,11 +32,11 @@ def hw_ctx():
     context.stop()
 
 
-def _reduce_join(ctx, n, n_keys):
-    kv = ctx.dense_range(n).map(lambda x: (x % 991, x * 1.0))
+def _reduce_join(ctx, n, n_keys=991):
+    kv = ctx.dense_range(n).map(lambda x, m=n_keys: (x % m, x * 1.0))
     red = kv.reduce_by_key(op="add")
-    table = ctx.dense_from_numpy(np.arange(991, dtype=np.int32),
-                                 np.arange(991, dtype=np.float32))
+    table = ctx.dense_from_numpy(np.arange(n_keys, dtype=np.int32),
+                                 np.arange(n_keys, dtype=np.float32))
     return red, red.join(table)
 
 

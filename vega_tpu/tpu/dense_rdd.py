@@ -1393,10 +1393,9 @@ class DenseRDD(RDD):
                 "min": mn, "max": mx}
 
     def _min_max(self):
-        """Fused single-pass min+max (one device program, not two)."""
-        if self._wide_value():
-            # two wide folds (the fused f32 program can't carry int64)
-            return self._named_reduce("min"), self._named_reduce("max")
+        """Fused single-pass min+max (one device program, not two). Only
+        histogram() calls this, and it routes wide-value blocks to the
+        host tier first, so this always sees a narrow VALUE column."""
         blk = self.block()
 
         def shard_mm(vals, counts):
