@@ -1,0 +1,8 @@
+#!/bin/bash
+# Reduce-plan A/B + exchange stage profile on the real chip: answers the
+# round-3 verdict's question (do the lax.sort passes dominate the warm
+# exchange?) and decides whether dense_rbk_plan should default to
+# sort_partition. CPU-mesh proxy result (docs/BENCH_NOTES.md round 4):
+# sort_partition ~20% faster end-to-end; sorts dominate the stages.
+cd /root/repo
+VEGA_PLAN_AB_TPU=1 exec python benchmarks/plan_ab.py 20000000

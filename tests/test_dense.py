@@ -1764,3 +1764,57 @@ def test_values_dense_keeps_wide_pair_on_device(dctx):
     assert isinstance(vals, DenseRDD)
     assert vals.sum() == 2**40 + 2**41 + 5
     assert vals.max() == 2**41
+
+
+def test_rbk_sort_partition_plan_parity(dctx):
+    """The alternative reduce exchange plan (key-only sort -> combine ->
+    counting partition, Configuration.dense_rbk_plan) computes identical
+    results to the fused multi-key-sort plan across named ops, traced
+    combiners, wide int64 values, and downstream joins."""
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_rbk_plan
+    Env.get().conf.dense_rbk_plan = "sort_partition"
+    try:
+        r = (dctx.dense_range(50_000).map(lambda x: (x % 997, x))
+             .reduce_by_key(op="add"))
+        got = dict(r.collect())
+        exp = {}
+        for x in range(50_000):
+            exp[x % 997] = exp.get(x % 997, 0) + x
+        assert got == exp
+        assert r.hash_placed and r.key_sorted
+
+        # traced-combiner path
+        got2 = dict(dctx.dense_range(10_000)
+                    .map(lambda x: (x % 53, x * 1.0))
+                    .reduce_by_key(lambda a, b: a + b).collect())
+        assert got2[0] == sum(float(x) for x in range(10_000) if x % 53 == 0)
+
+        # wide int64 values ride the plan (sovf column partitions too)
+        wide = dctx.dense_from_numpy(
+            np.array([1, 1, 2], dtype=np.int64),
+            np.array([2**40, 2**41, 7], dtype=np.int64))
+        assert dict(wide.reduce_by_key(op="add").collect()) == {
+            1: 2**40 + 2**41, 2: 7}
+
+        # downstream join over the plan's hash-placed output elides
+        table = dctx.dense_from_numpy(np.arange(997, dtype=np.int32),
+                                      np.arange(997, dtype=np.int32))
+        j = dict(r.join(table).collect())
+        assert j[5] == (exp[5], 5)
+    finally:
+        Env.get().conf.dense_rbk_plan = old
+
+
+def test_rbk_plan_typo_raises(dctx):
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_rbk_plan
+    Env.get().conf.dense_rbk_plan = "sort-partition"  # typo'd
+    try:
+        with pytest.raises(v.VegaError, match="dense_rbk_plan"):
+            (dctx.dense_range(1_000).map(lambda x: (x % 7, x))
+             .reduce_by_key(op="add").collect())
+    finally:
+        Env.get().conf.dense_rbk_plan = old

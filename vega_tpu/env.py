@@ -86,6 +86,14 @@ class Configuration:
     # conservative for a 16 GiB v5e chip once XLA workspace and a second
     # live block are accounted for.
     dense_hbm_budget: int = 4 << 30
+    # reduce_by_key exchange plan: "fused_sort" = ONE multi-key
+    # (bucket, key) lax.sort feeds the presorted combine AND a pregrouped
+    # exchange; "sort_partition" = key-only lax.sort -> combine -> stable
+    # counting partition by bucket (kernels.partition_by_bucket) — the
+    # partition is cheap VPU work over the POST-combine rows, so it wins
+    # when the combine shrinks data a lot (high key duplication) and the
+    # sort dominates. A/B on hardware: benchmarks/tpu_jobs/06_plan_ab.sh.
+    dense_rbk_plan: str = "fused_sort"
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -95,7 +103,7 @@ class Configuration:
         if env.get(pref + "DEPLOYMENT_MODE"):
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
-                     "HOSTS_FILE"):
+                     "DENSE_RBK_PLAN", "HOSTS_FILE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

@@ -3081,6 +3081,15 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # routes to the host-exact fold (see _host_exact_fold).
         track_sovf = self._op == "add" and bool(
             block_lib.wide_value_pairs(names))
+        from vega_tpu.env import Env as _Env
+
+        plan = getattr(_Env.get().conf, "dense_rbk_plan", "fused_sort")
+        if plan not in ("fused_sort", "sort_partition"):
+            # A typo'd plan silently running fused_sort would corrupt an
+            # A/B (a scarce tunnel-window job measuring fused vs fused).
+            raise VegaError(
+                f"dense_rbk_plan must be 'fused_sort' or 'sort_partition',"
+                f" got {plan!r}")
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -3088,7 +3097,31 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 cols, count = _apply_chain(chain, cols, counts[0])
                 if track_sovf:
                     cols[_SOVF] = jnp.zeros(cols[KEY].shape[0], jnp.int32)
-                if n > 1 and not elide:
+                if n > 1 and not elide and plan == "sort_partition":
+                    # Alternative plan: key-only sort -> presorted
+                    # map-side combine -> stable counting partition of
+                    # the (often much smaller) combined rows. Equal keys
+                    # share a bucket by hash determinism, so combining
+                    # across bucket boundaries is safe.
+                    cols = kernels.sort_by_column(
+                        cols, count, KEY, lo_name=_lo_of(cols))
+                    cols, count = this._segment_reduce(cols, count,
+                                                       presorted=True)
+                    capacity = cols[KEY].shape[0]
+                    mask = kernels.valid_mask(capacity, count)
+                    bucket = _bucket_cols(cols, n)
+                    bucket = jnp.where(mask, bucket, n)
+                    # counting-path intermediates are O(capacity * n):
+                    # bound them (~256 MiB) on big blocks via the argsort
+                    # escape so the plan can't OOM where fused_sort won't
+                    low_mem = capacity * (n + 1) * 4 > (256 << 20)
+                    cols, bucket = kernels.partition_by_bucket(
+                        cols, bucket, n, prefer_low_memory=low_mem)
+                    cols, count, overflow = exchange(
+                        cols, count, bucket, n, slot, out_cap,
+                        pregrouped=True,
+                    )
+                elif n > 1 and not elide:
                     # 2-sort exchange: ONE multi-key sort (bucket major,
                     # key minor) feeds both the presorted map-side combine
                     # (reference: dependency.rs:176-223) and a pregrouped
@@ -3136,7 +3169,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             key = ("rbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide, elide_sorted,
                    self.exchange_mode, self._op or _fp(self._func),
-                   track_sovf)
+                   track_sovf, plan)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(

@@ -199,6 +199,28 @@ def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
     return out, sorted_bucket
 
 
+def partition_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
+                        prefer_low_memory: bool = False
+                        ) -> Tuple[Cols, jax.Array]:
+    """Stable counting partition: rows become contiguous per bucket (the
+    ghost bucket n_shards sinks last), preserving in-bucket row order —
+    the sort-free way to feed a pregrouped exchange when rows are already
+    key-sorted. This is the 'sort_partition' reduce plan's grouping step:
+    key-only lax.sort -> map-side combine -> THIS, versus the fused
+    plan's multi-key (bucket, key) lax.sort over all pre-combine rows.
+
+    The counting path's one-hot/cumsum intermediates are O(capacity *
+    n_shards) — capacity is the STATIC pre-combine size, not the shrunk
+    row count — so callers bound it with prefer_low_memory (the
+    _group_by_bucket escape hatch: a single-key stable argsort by bucket
+    instead). Returns (grouped cols, grouped bucket)."""
+    grouped, _cto, _starts = _group_by_bucket(
+        dict(cols, __bucket=bucket), bucket, n_shards,
+        prefer_low_memory=prefer_low_memory)
+    b = grouped.pop("__bucket")
+    return grouped, b
+
+
 def range_bucket(bounds: jax.Array, keys: jax.Array,
                  ascending: bool, bounds_lo: jax.Array = None,
                  keys_lo: jax.Array = None) -> jax.Array:
