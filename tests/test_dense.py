@@ -1818,3 +1818,30 @@ def test_rbk_plan_typo_raises(dctx):
              .reduce_by_key(op="add").collect())
     finally:
         Env.get().conf.dense_rbk_plan = old
+
+
+def test_rbk_plan_with_pallas_partition_ranks(dctx, monkeypatch):
+    """The sort_partition plan computes identical results when the
+    counting partition's ranks come from the Pallas kernel (interpret
+    mode here; on TPU the dispatcher enables it automatically)."""
+    from vega_tpu.env import Env
+    from vega_tpu.tpu import dense_rdd as dr
+    from vega_tpu.tpu import pallas_kernels
+
+    monkeypatch.setattr(dr, "_PROGRAM_CACHE", {})  # force re-trace
+    monkeypatch.setattr(
+        pallas_kernels, "partition_pos",
+        lambda bucket, n_bins, starts, prefer_low_memory=False:
+        pallas_kernels.partition_pos_pallas(bucket, n_bins, starts, True))
+    old = Env.get().conf.dense_rbk_plan
+    Env.get().conf.dense_rbk_plan = "sort_partition"
+    try:
+        r = (dctx.dense_range(30_000).map(lambda x: (x % 433, x))
+             .reduce_by_key(op="add"))
+        got = dict(r.collect())
+        exp = {}
+        for x in range(30_000):
+            exp[x % 433] = exp.get(x % 433, 0) + x
+        assert got == exp
+    finally:
+        Env.get().conf.dense_rbk_plan = old

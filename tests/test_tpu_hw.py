@@ -168,3 +168,22 @@ def test_hw_elision_zero_collectives(hw_ctx):
     red2 = red1.reduce_by_key(op="add")
     red2.collect()
     assert red2._elided
+
+
+def test_hw_partition_rank_kernel(hw_ctx):
+    """The Pallas counting-partition rank kernel computes XLA-identical
+    positions on the real chip (compiled Mosaic, not interpret mode)."""
+    import jax.numpy as jnp
+
+    from vega_tpu.tpu.pallas_kernels import partition_pos_pallas
+
+    rng = np.random.RandomState(2)
+    bucket = rng.randint(0, 9, size=200_000).astype(np.int32)
+    counts = np.bincount(bucket, minlength=9)
+    starts = (np.cumsum(counts) - counts).astype(np.int32)
+    one_hot = (bucket[:, None] == np.arange(9)[None, :]).astype(np.int32)
+    rank = np.take_along_axis(np.cumsum(one_hot, axis=0),
+                              bucket[:, None], axis=1)[:, 0] - 1
+    exp = starts[bucket] + rank
+    got = partition_pos_pallas(jnp.asarray(bucket), 9, jnp.asarray(starts))
+    np.testing.assert_array_equal(np.asarray(got), exp)

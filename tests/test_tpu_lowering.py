@@ -1,0 +1,207 @@
+"""Offline TPU lowering tier: every core device program must LOWER for
+the tpu platform — validated on the CPU mesh via jax.export, no hardware.
+
+The axon tunnel is scarce; a program that traces and runs on the CPU mesh
+but fails Mosaic/TPU lowering (a Pallas kernel using an unsupported op, a
+collective layout XLA:TPU rejects) would otherwise only surface inside a
+tunnel window, burning it. These tests catch that class offline: export
+with platforms=["tpu"] runs the full TPU lowering pipeline (including
+Pallas->Mosaic kernel compilation into tpu_custom_call payloads).
+
+Complement, not substitute, for tests/test_tpu_hw.py: lowering proves the
+compiler accepts the program; the hw tier proves the chip computes the
+right answer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from vega_tpu.tpu import block as block_lib
+from vega_tpu.tpu import kernels
+from vega_tpu.tpu import mesh as mesh_lib
+from vega_tpu.tpu.block import KEY, KEY_LO, VALUE
+
+CAP = 1024
+N = 8
+
+
+def _export_sharded(prog, n_in, n_out, args):
+    mesh = mesh_lib.default_mesh()
+    sp = P(mesh_lib.SHARD_AXIS)
+    f = jax.jit(jax.shard_map(prog, mesh=mesh, in_specs=(sp,) * n_in,
+                              out_specs=(sp,) * n_out, check_vma=False))
+    exp = jax.export.export(f, platforms=["tpu"])(*args)
+    m = exp.mlir_module()
+    assert len(m) > 0
+    return m
+
+
+def _pair_args():
+    counts = jnp.full((N,), 900, jnp.int32)
+    keys = jnp.arange(N * CAP, dtype=jnp.int32) % 500
+    vals = jnp.ones(N * CAP, jnp.int32)
+    return counts, keys, vals
+
+
+def test_lowering_rbk_fused_sort():
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        bucket = (kernels.hash32(keys) % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        cols, bucket = kernels.bucket_key_sort(cols, count, bucket, KEY)
+        cols, count = kernels.segment_reduce_named(
+            cols, count, KEY, "add", presorted=True)
+        bucket = (kernels.hash32(cols[KEY])
+                  % jnp.uint32(N)).astype(jnp.int32)
+        out, n2, ovf = kernels.bucket_exchange(
+            cols, count, bucket, N, 256, CAP, pregrouped=True)
+        return out[KEY], out[VALUE], n2.reshape(1), ovf.reshape(1)
+
+    _export_sharded(prog, 3, 4, _pair_args())
+
+
+def test_lowering_rbk_sort_partition():
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        cols = kernels.sort_by_column(cols, count, KEY)
+        cols, count = kernels.segment_reduce_named(
+            cols, count, KEY, "add", presorted=True)
+        bucket = (kernels.hash32(cols[KEY])
+                  % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        cols, bucket = kernels.partition_by_bucket(cols, bucket, N)
+        out, n2, ovf = kernels.bucket_exchange(
+            cols, count, bucket, N, 256, CAP, pregrouped=True)
+        return out[KEY], out[VALUE], n2.reshape(1), ovf.reshape(1)
+
+    _export_sharded(prog, 3, 4, _pair_args())
+
+
+def test_lowering_ring_exchange():
+    from vega_tpu.tpu.ring import ring_exchange
+
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        bucket = (kernels.hash32(keys) % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        out, n2, ovf = ring_exchange(cols, count, bucket, N, 256, CAP)
+        return out[KEY], out[VALUE], n2.reshape(1), ovf.reshape(1)
+
+    _export_sharded(prog, 3, 4, _pair_args())
+
+
+def test_lowering_wide_int64_scan():
+    from vega_tpu.tpu.dense_rdd import _SOVF, _named_wide_combine
+
+    vlo = block_lib.lo_of(VALUE)
+
+    def prog(counts, keys, hi, lo):
+        count = counts[0]
+        cols = {KEY: keys, VALUE: hi, vlo: lo,
+                _SOVF: jnp.zeros((CAP,), jnp.int32)}
+        combine = _named_wide_combine(
+            "add", [VALUE, vlo, _SOVF], {VALUE: vlo}, ovf_name=_SOVF)
+        out, n2 = kernels.segment_reduce_sorted(
+            cols, count, KEY, combine, presorted=False)
+        flag = jnp.any(out[_SOVF] != 0)
+        return out[KEY], out[VALUE], out[vlo], flag.reshape(1)
+
+    counts = jnp.full((N,), 900, jnp.int32)
+    keys = jnp.arange(N * CAP, dtype=jnp.int32) % 300
+    hi = jnp.ones(N * CAP, jnp.int32)
+    lo = jnp.ones(N * CAP, jnp.int32)
+    _export_sharded(prog, 4, 4, (counts, keys, hi, lo))
+
+
+def test_lowering_merge_join_expand():
+    def prog(counts, keys, vals):
+        count = counts[0]
+        lcols = {KEY: keys, VALUE: vals}
+        rcols = {KEY: keys, VALUE: vals}
+        joined, jcount, jtotal = kernels.merge_join_expand(
+            lcols, count, rcols, count, KEY, CAP)
+        return (joined[KEY], joined[VALUE], joined[f"r_{VALUE}"],
+                jcount.reshape(1), jtotal.reshape(1))
+
+    _export_sharded(prog, 3, 5, _pair_args())
+
+
+def test_lowering_range_sort():
+    def prog(bounds, counts, keys, vals):
+        count = counts[0]
+        cols = {KEY: keys, VALUE: vals}
+        bucket = kernels.range_bucket(bounds, keys, True)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        out, n2, ovf = kernels.bucket_exchange(
+            cols, count, bucket, N, 512, CAP)
+        out = kernels.sort_by_column(out, n2, KEY)
+        return out[KEY], out[VALUE], n2.reshape(1), ovf.reshape(1)
+
+    mesh = mesh_lib.default_mesh()
+    sp = P(mesh_lib.SHARD_AXIS)
+    f = jax.jit(jax.shard_map(
+        prog, mesh=mesh, in_specs=(P(), sp, sp, sp),
+        out_specs=(sp,) * 4, check_vma=False))
+    bounds = jnp.arange(N - 1, dtype=jnp.int32) * 64
+    counts, keys, vals = _pair_args()
+    exp = jax.export.export(f, platforms=["tpu"])(bounds, counts, keys,
+                                                  vals)
+    assert len(exp.mlir_module()) > 0
+
+
+def test_lowering_composed_partition_carries_mosaic_kernel():
+    """The COMPOSED exchange program exported for tpu must contain the
+    Pallas rank kernel (lax.platform_dependent selects it at lowering):
+    a trace-time backend dispatch would export the XLA fallback and the
+    offline tier would never see the program the chip actually runs."""
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        bucket = (kernels.hash32(keys) % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        out, b2 = kernels.partition_by_bucket(cols, bucket, N)
+        return out[KEY], out[VALUE], b2
+
+    m = _export_sharded(prog, 3, 3, _pair_args())
+    assert "tpu_custom_call" in m
+
+    # the low-memory flavor (ring_exchange's grouping) carries it too
+    def prog_lm(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        bucket = (kernels.hash32(keys) % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        out, b2 = kernels.partition_by_bucket(cols, bucket, N,
+                                              prefer_low_memory=True)
+        return out[KEY], out[VALUE], b2
+
+    m = _export_sharded(prog_lm, 3, 3, _pair_args())
+    assert "tpu_custom_call" in m
+
+
+def test_lowering_pallas_hash_kernel():
+    from vega_tpu.tpu.pallas_kernels import hash_bucket_pallas
+
+    x = jnp.arange(2048, dtype=jnp.int32)
+    exp = jax.export.export(
+        jax.jit(lambda k: hash_bucket_pallas(k, N)), platforms=["tpu"],
+    )(x)
+    m = exp.mlir_module()
+    # the kernel must actually have gone through Mosaic
+    assert "tpu_custom_call" in m
+
+
+def test_lowering_wide_key_join_search():
+    def prog(counts, keys, vals):
+        count = counts[0]
+        hi, lo = keys, vals  # stand-ins with the right dtypes
+        idx = kernels.searchsorted2(hi, lo, hi, lo, "left")
+        return (idx.astype(jnp.int32),)
+
+    _export_sharded(prog, 3, 1, _pair_args())

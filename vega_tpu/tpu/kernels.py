@@ -148,19 +148,26 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
     counts_to = counts_all[:n_shards]
     starts_all = jnp.cumsum(counts_all) - counts_all  # exclusive prefix
     starts = starts_all[:n_shards]
-    if n_shards <= 64 and not prefer_low_memory:
+    if n_shards <= 64:
+        from vega_tpu.tpu import pallas_kernels
+
         capacity = bucket.shape[0]
-        one_hot = (bucket[:, None] ==
-                   jnp.arange(n_shards + 1)[None, :]).astype(jnp.int32)
-        rank = jnp.take_along_axis(
-            jnp.cumsum(one_hot, axis=0), bucket[:, None], axis=1
-        )[:, 0] - 1
-        pos = jnp.take(starts_all, bucket) + rank
-        grouped = {}
-        for name, col in cols.items():
-            dst = jnp.zeros((capacity,) + col.shape[1:], col.dtype)
-            grouped[name] = dst.at[pos].set(col, mode="drop")
-        return grouped, counts_to, starts
+        # Platform-selected ranks (lax.platform_dependent): TPU streams
+        # the bucket column once through the Pallas kernel (VMEM tile
+        # ranks + SMEM per-bucket carries — O(capacity) HBM, so even
+        # memory-bounded callers like ring_exchange use it); elsewhere
+        # the XLA one-hot path, or the argsort path when
+        # prefer_low_memory (the one-hot's O(capacity * n_shards)
+        # intermediates are what that flag exists to avoid).
+        pos = pallas_kernels.partition_pos(
+            bucket, n_shards + 1, starts_all,
+            prefer_low_memory=prefer_low_memory)
+        if pos is not None:
+            grouped = {}
+            for name, col in cols.items():
+                dst = jnp.zeros((capacity,) + col.shape[1:], col.dtype)
+                grouped[name] = dst.at[pos].set(col, mode="drop")
+            return grouped, counts_to, starts
     order = jnp.argsort(bucket, stable=True)
     return gather_rows(cols, order), counts_to, starts
 

@@ -18,6 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
 _SUBLANES = 8
@@ -68,3 +69,135 @@ def hash_bucket(keys: jax.Array, n_buckets: int) -> jax.Array:
     if keys.dtype == jnp.int32 and jax.default_backend() == "tpu":
         return hash_bucket_pallas(keys, n_buckets)
     return (kernels.hash32(keys) % jnp.uint32(n_buckets)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# counting-partition rank kernel
+# ---------------------------------------------------------------------------
+#
+# The stable counting partition (kernels._group_by_bucket, and through it
+# partition_by_bucket / the sort_partition reduce plan) needs, per row,
+# pos = starts[bucket] + (# earlier rows with the same bucket). The XLA
+# formulation materializes a [capacity, n_buckets+1] one-hot plus its
+# column cumsum in HBM — O(capacity * k) reads+writes. This kernel streams
+# the bucket column ONCE: per (8, 128) VMEM tile it computes in-tile
+# exclusive ranks with 2D cumsums (statically unrolled over the small
+# bucket range) and carries per-bucket running totals across the
+# sequential grid in a VMEM scratch — O(capacity) HBM traffic total.
+
+
+def _partition_pos_kernel(starts_ref, bucket_ref, pos_ref, carry_ref,
+                          *, n_bins: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        for bb in range(n_bins):  # SMEM takes scalar stores only
+            carry_ref[0, bb] = 0
+
+    b = bucket_ref[:]  # (8, 128) int32, values in [0, n_bins)
+    pos = jnp.zeros_like(b)
+    # Mosaic has no cumsum primitive: exclusive prefix sums become
+    # triangular matmuls (exact in f32 — tile counts are < 2^24).
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+    lane_t = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+    upper = (lane < lane_t).astype(jnp.float32)  # strict: exclusive
+    sub = jax.lax.broadcasted_iota(jnp.int32, (_SUBLANES, _SUBLANES), 0)
+    sub_t = jax.lax.broadcasted_iota(jnp.int32, (_SUBLANES, _SUBLANES), 1)
+    lower = (sub_t < sub).astype(jnp.float32)
+    for bb in range(n_bins):  # static unroll: n_bins small (mesh size + 1)
+        m = (b == bb).astype(jnp.float32)
+        # exclusive prefix count in row-major tile order: within-sublane
+        # prefix + whole-earlier-sublane totals
+        cs_l = jnp.dot(m, upper, preferred_element_type=jnp.float32)
+        row_tot = jnp.sum(m, axis=1, keepdims=True)  # (8, 1)
+        cs_s = jnp.dot(lower, row_tot,
+                       preferred_element_type=jnp.float32)
+        excl = (cs_l + cs_s).astype(jnp.int32)
+        base = starts_ref[0, bb] + carry_ref[0, bb]
+        sel = m.astype(jnp.int32)
+        pos = pos + sel * (base + excl)
+        carry_ref[0, bb] = carry_ref[0, bb] + \
+            jnp.sum(m).astype(jnp.int32)
+    pos_ref[:] = pos
+
+
+@functools.partial(jax.jit, static_argnums=(1, 3))
+def partition_pos_pallas(bucket: jax.Array, n_bins: int,
+                         starts: jax.Array,
+                         interpret: bool = False) -> jax.Array:
+    """pos[i] = starts[bucket[i]] + |{j < i : bucket[j] == bucket[i]}|.
+
+    bucket values must lie in [0, n_bins) (callers pass n_shards + 1 bins:
+    real buckets plus the ghost). starts is int32[n_bins] (exclusive
+    prefix of the per-bucket totals). Bit-identical to the XLA one-hot
+    rank path in kernels._group_by_bucket."""
+    n = bucket.shape[0]
+    padded = -(-n // _TILE) * _TILE
+    grid = padded // _TILE
+    # padding rows use bucket n_bins-1 (the ghost bin): they come after
+    # every real row, so real positions are unaffected; their pos values
+    # are sliced off below.
+    b2d = jnp.pad(bucket, (0, padded - n),
+                  constant_values=n_bins - 1).reshape(-1, _LANES)
+    starts_pad = -(-n_bins // _LANES) * _LANES
+    starts2d = jnp.pad(starts.astype(jnp.int32),
+                       (0, starts_pad - n_bins)).reshape(1, -1)
+
+    out = pl.pallas_call(
+        functools.partial(_partition_pos_kernel, n_bins=n_bins),
+        out_shape=jax.ShapeDtypeStruct(b2d.shape, jnp.int32),
+        grid=(grid,),
+        in_specs=[
+            # per-bucket scalars live in SMEM: the kernel reads/writes
+            # them one element at a time (VMEM refuses scalar stores)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        scratch_shapes=[pltpu.SMEM((1, starts_pad), jnp.int32)],
+        interpret=interpret,
+    )(starts2d, b2d)
+    return out.reshape(-1)[:n]
+
+
+def _xla_onehot_pos(bucket: jax.Array, starts: jax.Array,
+                    n_bins: int) -> jax.Array:
+    """XLA rank path: [n, n_bins] one-hot + column cumsum (O(n * n_bins)
+    HBM intermediates)."""
+    one_hot = (bucket[:, None] ==
+               jnp.arange(n_bins)[None, :]).astype(jnp.int32)
+    rank = jnp.take_along_axis(
+        jnp.cumsum(one_hot, axis=0), bucket[:, None], axis=1)[:, 0] - 1
+    return jnp.take(starts, bucket) + rank
+
+
+def _xla_argsort_pos(bucket: jax.Array, starts: jax.Array,
+                     n_bins: int) -> jax.Array:
+    """XLA low-memory rank path: positions from a stable argsort
+    (O(n log n) time, O(n) memory — no one-hot intermediates)."""
+    del starts  # the sorted order already encodes starts+rank
+    n = bucket.shape[0]
+    order = jnp.argsort(bucket, stable=True)
+    return jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
+def partition_pos(bucket: jax.Array, n_bins: int, starts: jax.Array,
+                  prefer_low_memory: bool = False):
+    """Partition ranks pos[i] = starts[bucket[i]] + earlier-equal count,
+    platform-selected AT LOWERING TIME (lax.platform_dependent): tpu gets
+    the Pallas kernel — so a program exported with platforms=["tpu"]
+    carries the Mosaic kernel and the offline lowering tier validates the
+    REAL composed TPU program — other platforms get the XLA one-hot path,
+    or the argsort path under prefer_low_memory (on TPU the Pallas kernel
+    already streams in O(n), so the flag only shapes the fallback).
+    Returns None when the kernel can't apply (caller keeps its own path)."""
+    if n_bins > 65 or bucket.dtype != jnp.int32:
+        return None
+    fallback = _xla_argsort_pos if prefer_low_memory else _xla_onehot_pos
+    return jax.lax.platform_dependent(
+        bucket, starts,
+        tpu=lambda b, s: partition_pos_pallas(b, n_bins, s),
+        default=lambda b, s: fallback(b, s, n_bins),
+    )
