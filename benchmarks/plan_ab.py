@@ -100,6 +100,12 @@ def main():
                 lambda c, b, ct: kernels.bucket_key_sort(c, ct, b, KEY)),
             "key_sort": jax.jit(
                 lambda c, ct: kernels.sort_by_column(c, ct, KEY)),
+            "radix_key_sort": jax.jit(
+                lambda c, ct: kernels.sort_by_column(c, ct, KEY,
+                                                     impl="radix")),
+            "radix4_key_sort": jax.jit(
+                lambda c, ct: kernels.sort_by_column(c, ct, KEY,
+                                                     impl="radix4")),
             "combine": jax.jit(
                 lambda c, ct: kernels.segment_reduce_named(
                     c, ct, KEY, "add", presorted=True)),
@@ -110,6 +116,10 @@ def main():
             _timed(stages["multikey_sort"], cols, bucket, count), 4)
         result["stage_s_key_sort"] = round(
             _timed(stages["key_sort"], cols, count), 4)
+        result["stage_s_radix_key_sort"] = round(
+            _timed(stages["radix_key_sort"], cols, count), 4)
+        result["stage_s_radix4_key_sort"] = round(
+            _timed(stages["radix4_key_sort"], cols, count), 4)
         sorted_cols = stages["key_sort"](cols, count)
         result["stage_s_combine_presorted"] = round(
             _timed(stages["combine"], sorted_cols, count), 4)

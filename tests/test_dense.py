@@ -1845,3 +1845,64 @@ def test_rbk_plan_with_pallas_partition_ranks(dctx, monkeypatch):
         assert got == exp
     finally:
         Env.get().conf.dense_rbk_plan = old
+
+
+def test_dense_sort_impl_radix_parity(dctx):
+    """dense_sort_impl='radix' computes identical results through the
+    whole dense surface: sort_by_key (asc/desc), reduce_by_key (both
+    plans), group_by_key, and int64 wide keys."""
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_sort_impl
+    Env.get().conf.dense_sort_impl = "radix"
+    try:
+        n = 20_000
+        kv = dctx.dense_range(n).map(lambda x: ((x * 2654435761) % n, x))
+        keys = [k for k, _ in kv.sort_by_key().collect()]
+        assert keys == sorted((x * 2654435761) % n for x in range(n))
+        keys_d = [k for k, _ in kv.sort_by_key(ascending=False).collect()]
+        assert keys_d == sorted(((x * 2654435761) % n for x in range(n)),
+                                reverse=True)
+
+        got = dict(dctx.dense_range(n).map(lambda x: (x % 211, x))
+                   .reduce_by_key(op="add").collect())
+        assert got[0] == sum(x for x in range(n) if x % 211 == 0)
+
+        g = (dctx.dense_range(5_000).map(lambda x: (x % 7, x))
+             .group_by_key())
+        ks, offs, vals = g.collect_grouped()
+        assert sorted(ks.tolist()) == list(range(7))
+
+        wide = dctx.dense_from_numpy(
+            np.array([2**40, 5, 2**40, 5], dtype=np.int64),
+            np.array([1, 2, 3, 4], dtype=np.int64))
+        srt = wide.sort_by_key().collect()
+        assert [k for k, _ in srt] == [5, 5, 2**40, 2**40]
+    finally:
+        Env.get().conf.dense_sort_impl = old
+
+
+def test_dense_sort_impl_typo_raises(dctx):
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_sort_impl
+    Env.get().conf.dense_sort_impl = "Radix"
+    try:
+        with pytest.raises(v.VegaError, match="dense_sort_impl"):
+            (dctx.dense_range(1_000).map(lambda x: (x % 7, x))
+             .reduce_by_key(op="add").collect())
+    finally:
+        Env.get().conf.dense_sort_impl = old
+
+
+def test_sort_by_key_descending_int_min(dctx):
+    """Regression: the descending range partitioner and per-shard sort
+    must not negate keys — negation wraps INT32_MIN onto itself, landing
+    the most negative key in the first (largest-keys) bucket."""
+    r = dctx.dense_from_numpy(
+        np.array([5, -2**31, 7, 0, -3], dtype=np.int32),
+        np.array([1, 2, 3, 4, 5], dtype=np.int32))
+    got = [k for k, _ in r.sort_by_key(ascending=False).collect()]
+    assert got == [7, 5, 0, -3, -2**31]
+    got_asc = [k for k, _ in r.sort_by_key().collect()]
+    assert got_asc == [-2**31, -3, 0, 5, 7]

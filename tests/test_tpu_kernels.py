@@ -256,3 +256,101 @@ def test_partition_pos_pallas_lowers_for_tpu():
         platforms=["tpu"],
     )(bucket, starts)
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_radix_sort_perm_matches_argsort():
+    """The LSD radix permutation is bit-identical to a stable argsort for
+    int32, float32, and wide int64 keys, ascending and descending, with
+    ghost rows sinking last."""
+    import jax
+    from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu import pallas_kernels as pk
+
+    rng = np.random.RandomState(9)
+    n, count = 5_000, 4_321
+
+    def run(words, descending):
+        return np.asarray(kernels.radix_sort_perm(
+            [jnp.asarray(w) for w in words], jnp.int32(count), descending))
+
+    # int32 (duplicates included: stability check)
+    ints = rng.randint(-2**31, 2**31 - 1, size=n).astype(np.int32)
+    ints[: n // 4] = rng.randint(-50, 50, size=n // 4)
+    u = kernels._orderable_u32(jnp.asarray(ints), False)
+    for desc in (False, True):
+        got = run([u], desc)
+        key = ints[:count] if not desc else None
+        order = np.argsort(ints[:count] if not desc else -ints[:count].astype(np.int64),
+                           kind="stable")
+        np.testing.assert_array_equal(got[:count], order)
+        assert sorted(got[count:].tolist()) == list(range(count, n))
+
+    # float32 incl. negatives
+    fl = (rng.randn(n) * 100).astype(np.float32)
+    uf = kernels._orderable_u32(jnp.asarray(fl), True)
+    got = run([uf], False)
+    np.testing.assert_array_equal(got[:count],
+                                  np.argsort(fl[:count], kind="stable"))
+
+    # wide int64: (hi, stored-lo) words, LSD order [lo, hi]
+    big = rng.randint(-2**62, 2**62, size=n).astype(np.int64)
+    hi, lo = block_lib.encode_i64(big)
+    wl = kernels._orderable_u32(jnp.asarray(lo), False)
+    wh = kernels._orderable_u32(jnp.asarray(hi), False)
+    got = run([wl, wh], False)
+    np.testing.assert_array_equal(got[:count],
+                                  np.argsort(big[:count], kind="stable"))
+
+
+def test_sort_by_column_radix_impl_parity():
+    """sort_by_column(impl='radix') returns exactly what the lax.sort
+    path returns for supported dtypes (int32, float32, wide)."""
+    from vega_tpu.tpu import block as block_lib
+    from vega_tpu.tpu.block import KEY, KEY_LO, VALUE
+
+    rng = np.random.RandomState(4)
+    n, count = 3_000, 2_700
+    vals = rng.randint(0, 10**6, size=n).astype(np.int32)
+
+    for keyset in ("int32", "float32", "wide"):
+        if keyset == "int32":
+            cols = {KEY: jnp.asarray(
+                rng.randint(-100, 100, size=n).astype(np.int32)),
+                VALUE: jnp.asarray(vals)}
+            lo_name = None
+        elif keyset == "float32":
+            cols = {KEY: jnp.asarray((rng.randn(n) * 10).astype(np.float32)),
+                    VALUE: jnp.asarray(vals)}
+            lo_name = None
+        else:
+            big = rng.randint(-2**50, 2**50, size=n).astype(np.int64)
+            hi, lo = block_lib.encode_i64(big)
+            cols = {KEY: jnp.asarray(hi), KEY_LO: jnp.asarray(lo),
+                    VALUE: jnp.asarray(vals)}
+            lo_name = KEY_LO
+        for desc in (False, True):
+            a = kernels.sort_by_column(dict(cols), jnp.int32(count), KEY,
+                                       descending=desc, lo_name=lo_name)
+            for impl in ("radix", "radix4"):
+                b = kernels.sort_by_column(dict(cols), jnp.int32(count),
+                                           KEY, descending=desc,
+                                           lo_name=lo_name, impl=impl)
+                for nm in cols:
+                    np.testing.assert_array_equal(
+                        np.asarray(a[nm])[:count],
+                        np.asarray(b[nm])[:count],
+                        err_msg=f"{keyset} {impl} desc={desc} col={nm}")
+
+
+def test_sort_by_column_descending_int_min():
+    """Regression: descending int sorts must not negate the key —
+    negation wraps INT32_MIN onto itself and sorts it FIRST instead of
+    last. Both impls agree on the fixed behavior."""
+    from vega_tpu.tpu.block import KEY
+
+    keys = np.array([5, -2**31, 7, 0], dtype=np.int32)
+    for impl in ("xla", "radix"):
+        out = kernels.sort_by_column({KEY: jnp.asarray(keys)},
+                                     jnp.int32(4), KEY, descending=True,
+                                     impl=impl)
+        assert np.asarray(out[KEY]).tolist() == [7, 5, 0, -2**31], impl

@@ -205,3 +205,50 @@ def test_lowering_wide_key_join_search():
         return (idx.astype(jnp.int32),)
 
     _export_sharded(prog, 3, 1, _pair_args())
+
+
+def test_lowering_radix_sort_carries_mosaic_kernels():
+    """The radix sort path exported for tpu must carry the Pallas digit
+    histogram + 256-bin rank kernels (platform_dependent selects them at
+    lowering) and pass Mosaic compilation, composed under shard_map."""
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        out = kernels.sort_by_column(cols, counts[0], KEY, impl="radix")
+        return out[KEY], out[VALUE]
+
+    m = _export_sharded(prog, 3, 2, _pair_args())
+    assert "tpu_custom_call" in m
+
+
+def test_lowering_radix_reduce_pipeline():
+    """Full reduce exchange with radix map-side + reduce-side sorts
+    lowers for tpu."""
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        cols = kernels.sort_by_column(cols, count, KEY, impl="radix")
+        cols, count = kernels.segment_reduce_named(
+            cols, count, KEY, "add", presorted=True)
+        bucket = (kernels.hash32(cols[KEY])
+                  % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        cols, bucket = kernels.partition_by_bucket(cols, bucket, N)
+        out, n2, ovf = kernels.bucket_exchange(
+            cols, count, bucket, N, 256, CAP, pregrouped=True)
+        out, n3 = kernels.segment_reduce_named(
+            out, n2, KEY, "add", sort_impl="radix")
+        return out[KEY], out[VALUE], n3.reshape(1), ovf.reshape(1)
+
+    m = _export_sharded(prog, 3, 4, _pair_args())
+    assert "tpu_custom_call" in m
+
+
+def test_lowering_radix4_sort():
+    """The 4-bit digit variant (16-bin kernels, 8 passes/word) lowers."""
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        out = kernels.sort_by_column(cols, counts[0], KEY, impl="radix4")
+        return out[KEY], out[VALUE]
+
+    m = _export_sharded(prog, 3, 2, _pair_args())
+    assert "tpu_custom_call" in m

@@ -94,6 +94,14 @@ class Configuration:
     # when the combine shrinks data a lot (high key duplication) and the
     # sort dominates. A/B on hardware: benchmarks/tpu_jobs/06_plan_ab.sh.
     dense_rbk_plan: str = "fused_sort"
+    # Key-sort implementation inside exchange programs: "xla" = lax.sort
+    # comparator network; "radix" / "radix4" = LSD radix over
+    # orderable-uint32 words (8-bit digits / 4 passes per word, or 4-bit
+    # digits / 8 passes with 16x less per-tile kernel unroll;
+    # Pallas-streamed histogram + rank kernels on TPU) for
+    # int32/float32/wide-int64 keys — other dtypes keep lax.sort. A/B on
+    # hardware: benchmarks/tpu_jobs/07_radix_ab.sh.
+    dense_sort_impl: str = "xla"
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -103,7 +111,7 @@ class Configuration:
         if env.get(pref + "DEPLOYMENT_MODE"):
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
-                     "DENSE_RBK_PLAN", "HOSTS_FILE"):
+                     "DENSE_RBK_PLAN", "DENSE_SORT_IMPL", "HOSTS_FILE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

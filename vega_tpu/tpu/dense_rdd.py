@@ -2387,6 +2387,22 @@ def _chain_fp(chain) -> tuple:
     return tuple(nd._node_fp() for nd in chain)
 
 
+def _sort_impl() -> str:
+    """Configuration.dense_sort_impl, validated. 'radix' routes the key
+    sorts in the exchange programs (sort_by_column + the reduce-side
+    merge sorts) through the LSD radix path — Pallas-streamed passes on
+    TPU instead of lax.sort. Read at trace time; callers put the value
+    in their program-cache keys."""
+    from vega_tpu.env import Env
+
+    impl = getattr(Env.get().conf, "dense_sort_impl", "xla")
+    if impl not in ("xla", "radix", "radix4"):
+        raise VegaError(
+            "dense_sort_impl must be 'xla', 'radix' (8-bit digits) or "
+            f"'radix4' (4-bit digits), got {impl!r}")
+    return impl
+
+
 def _bucket_cols(cols, n: int) -> jax.Array:
     """Hash-bucket rows by key, two-column int64 keys included. The
     composite hash mixes BOTH words (hash32_pair) so placement keeps its
@@ -2945,7 +2961,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
     def _fp_extra(self):
         return (self._op or _fp(self._func), self.exchange_mode)
 
-    def _segment_reduce(self, cols, count, presorted):
+    def _segment_reduce(self, cols, count, presorted, sort_impl="xla"):
         lo_name = _lo_of(cols)
         if self._op is not None:
             wide = block_lib.wide_value_pairs(cols)
@@ -2961,11 +2977,11 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     ovf_name=_SOVF if _SOVF in cols else None)
                 return kernels.segment_reduce_sorted(
                     cols, count, KEY, combine, presorted=presorted,
-                    lo_name=lo_name,
+                    lo_name=lo_name, sort_impl=sort_impl,
                 )
             return kernels.segment_reduce_named(
                 cols, count, KEY, self._op, presorted=presorted,
-                lo_name=lo_name,
+                lo_name=lo_name, sort_impl=sort_impl,
             )
         f = self._func
         names = self._value_names
@@ -2981,7 +2997,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 return dict(zip(names, out))
 
         return kernels.segment_reduce_sorted(
-            cols, count, KEY, combine, presorted=presorted, lo_name=lo_name
+            cols, count, KEY, combine, presorted=presorted, lo_name=lo_name,
+            sort_impl=sort_impl,
         )
 
     def _host_exact_fold(self) -> Block:
@@ -3073,6 +3090,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
         exchange = _get_exchange(self.exchange_mode)
+        sort_impl = _sort_impl()
         this = _detach(self)  # _segment_reduce state without the node
         # Wide int64 adds track signed overflow through the whole exchange
         # (the capacity-flag pattern applied to arithmetic): an injected
@@ -3104,9 +3122,10 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     # share a bucket by hash determinism, so combining
                     # across bucket boundaries is safe.
                     cols = kernels.sort_by_column(
-                        cols, count, KEY, lo_name=_lo_of(cols))
-                    cols, count = this._segment_reduce(cols, count,
-                                                       presorted=True)
+                        cols, count, KEY, lo_name=_lo_of(cols),
+                        impl=sort_impl)
+                    cols, count = this._segment_reduce(
+                        cols, count, presorted=True, sort_impl=sort_impl)
                     capacity = cols[KEY].shape[0]
                     mask = kernels.valid_mask(capacity, count)
                     bucket = _bucket_cols(cols, n)
@@ -3134,8 +3153,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     cols, bucket = kernels.bucket_key_sort(
                         cols, count, bucket, KEY, lo_name=_lo_of(cols)
                     )
-                    cols, count = this._segment_reduce(cols, count,
-                                                       presorted=True)
+                    cols, count = this._segment_reduce(
+                        cols, count, presorted=True, sort_impl=sort_impl)
                     # compact kept (bucket, key) order; re-derive the
                     # combiner rows' buckets from their keys (hash is cheap
                     # and deterministic).
@@ -3155,8 +3174,9 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                         cols, count, capacity, out_cap
                     )
                 # reduce-side merge (reference: shuffled_rdd.rs:149-170)
-                cols, count = this._segment_reduce(cols, count,
-                                                   presorted=elide_sorted)
+                cols, count = this._segment_reduce(
+                    cols, count, presorted=elide_sorted,
+                    sort_impl=sort_impl)
                 res = (count.reshape(1),)
                 if track_sovf:
                     m = kernels.valid_mask(cols[_SOVF].shape[0], count)
@@ -3169,7 +3189,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             key = ("rbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide, elide_sorted,
                    self.exchange_mode, self._op or _fp(self._func),
-                   track_sovf, plan)
+                   track_sovf, plan, sort_impl)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -3248,6 +3268,7 @@ class _GroupByKeyRDD(_ExchangeRDD):
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
         exchange = _get_exchange(self.exchange_mode)
+        sort_impl = _sort_impl()
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -3265,14 +3286,15 @@ class _GroupByKeyRDD(_ExchangeRDD):
                     )
                 if not elide_sorted:  # already sorted rows skip the sort
                     cols = kernels.sort_by_column(cols, count, KEY,
-                                                  lo_name=_lo_of(cols))
+                                                  lo_name=_lo_of(cols),
+                                                  impl=sort_impl)
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
 
             key = ("gbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide,
-                   elide_sorted, self.exchange_mode)
+                   elide_sorted, self.exchange_mode, sort_impl)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -3664,6 +3686,7 @@ class _SortByKeyRDD(_ExchangeRDD):
             bounds_lo_dev = None
         ascending = self.ascending
         exchange = _get_exchange(self.exchange_mode)
+        sort_impl = _sort_impl()
 
         def build(slot, out_cap):
             def prog_fn(*args):
@@ -3687,7 +3710,7 @@ class _SortByKeyRDD(_ExchangeRDD):
                 )
                 cols = kernels.sort_by_column(
                     cols, count, KEY, descending=not ascending,
-                    lo_name=lo_name,
+                    lo_name=lo_name, impl=sort_impl,
                 )
                 return (count.reshape(1),) + tuple(
                     cols[nm] for nm in names
@@ -3695,7 +3718,7 @@ class _SortByKeyRDD(_ExchangeRDD):
 
             key = ("sort", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap,
-                   ascending, self.exchange_mode)
+                   ascending, self.exchange_mode, sort_impl)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(

@@ -206,6 +206,63 @@ def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
     return out, sorted_bucket
 
 
+def _orderable_u32(word: jax.Array, is_float: bool) -> jax.Array:
+    """Map a 32-bit word to uint32 whose UNSIGNED order equals the source
+    order: ints flip the sign bit; floats use the sign-magnitude flip
+    (negative floats reverse). Radix digit source."""
+    u = lax.bitcast_convert_type(word, jnp.uint32)
+    if is_float:
+        mask = jnp.where((u >> jnp.uint32(31)) != jnp.uint32(0),
+                         jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
+        return u ^ mask
+    return u ^ jnp.uint32(0x80000000)
+
+
+def radix_sort_perm(words, count: jax.Array,
+                    descending: bool = False, bits: int = 8) -> jax.Array:
+    """Stable LSD radix sort permutation over orderable-uint32 words
+    (LEAST significant word first); ghost rows (index >= count) sink to
+    the end. Each 8-bit pass streams the digits once through the Pallas
+    histogram + rank kernels on TPU (XLA equivalents elsewhere via
+    lax.platform_dependent) and scatters only the still-needed words +
+    the permutation — payload columns move ONCE, via the returned perm:
+    output row j should be source row perm[j] (gather_rows semantics,
+    same contract as the argsort order in sort_by_column)."""
+    from vega_tpu.tpu import pallas_kernels as pk
+
+    cap = words[0].shape[0]
+    mask = valid_mask(cap, count)
+    active = []
+    for w in words:
+        if descending:
+            w = ~w
+        # ghosts get the max word EVERY pass: they start last and stay
+        # last under stability
+        active.append(jnp.where(mask, w, jnp.uint32(0xFFFFFFFF)))
+    perm = lax.iota(jnp.int32, cap)
+    n_bins = 1 << bits
+    digit_mask = jnp.uint32(n_bins - 1)
+    while active:
+        word = active[0]
+        for shift in range(0, 32, bits):
+            d = ((word >> jnp.uint32(shift))
+                 & digit_mask).astype(jnp.int32)
+            hist = pk.radix_hist(d, n_bins)
+            starts = (jnp.cumsum(hist) - hist).astype(jnp.int32)
+            pos = pk.radix_pos(d, starts, n_bins)
+            # pos is a full permutation (every digit in range): scatter
+            # the still-needed words + perm
+            active = [jnp.zeros_like(a).at[pos].set(a) for a in active]
+            perm = jnp.zeros_like(perm).at[pos].set(perm)
+            word = active[0]
+        active = active[1:]  # this word's digits are consumed
+    return perm
+
+
+def _radix_supported(key: jax.Array) -> bool:
+    return key.dtype in (jnp.dtype(jnp.int32), jnp.dtype(jnp.float32))
+
+
 def partition_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
                         prefer_low_memory: bool = False
                         ) -> Tuple[Cols, jax.Array]:
@@ -238,7 +295,11 @@ def range_bucket(bounds: jax.Array, keys: jax.Array,
     if bounds_lo is None:
         if ascending:
             return jnp.searchsorted(bounds, keys).astype(jnp.int32)
-        return jnp.searchsorted(-bounds, -keys).astype(jnp.int32)
+        if jnp.issubdtype(keys.dtype, jnp.floating):
+            return jnp.searchsorted(-bounds, -keys).astype(jnp.int32)
+        # bitwise-not, not negation: -INT32_MIN wraps onto itself and
+        # lands the most negative key in the first (largest) bucket
+        return jnp.searchsorted(~bounds, ~keys).astype(jnp.int32)
     if not ascending:
         # bitwise-not is order-reversing for int32 with no INT_MIN
         # negation overflow; applied to both words it reverses the
@@ -324,10 +385,27 @@ def bucket_exchange(
 
 
 def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
-                   descending: bool = False, lo_name: str = None) -> Cols:
+                   descending: bool = False, lo_name: str = None,
+                   impl: str = "xla") -> Cols:
     """Stable sort valid rows by one column (or a (key, lo) two-column
-    int64 key when lo_name is given); invalid rows sink to the end."""
+    int64 key when lo_name is given); invalid rows sink to the end.
+    impl='radix' (Configuration.dense_sort_impl) uses the LSD radix path
+    for int32/float32/wide keys — Pallas-streamed passes on TPU instead
+    of lax.sort's comparator network; unsupported dtypes keep lax.sort."""
     key = cols[key_name]
+    if impl.startswith("radix") and (lo_name is not None
+                                     or _radix_supported(key)):
+        if lo_name is not None:
+            # wide int64: stored lo's signed order == true-lo unsigned
+            # order, so the plain int transform applies to both words
+            words = [_orderable_u32(cols[lo_name], False),
+                     _orderable_u32(key, False)]
+        else:
+            words = [_orderable_u32(
+                key, jnp.issubdtype(key.dtype, jnp.floating))]
+        order = radix_sort_perm(words, count, descending,
+                                bits=4 if impl == "radix4" else 8)
+        return gather_rows(cols, order)
     capacity = key.shape[0]
     mask = valid_mask(capacity, count)
     if lo_name is not None:
@@ -341,8 +419,13 @@ def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
                                is_stable=True)
         return gather_rows(cols, order)
     if descending:
+        k = _orderable(key)
+        # bitwise-not is the overflow-free order flip for ints (negation
+        # wraps INT32_MIN onto itself and mis-sorts it first); floats
+        # negate exactly
+        flipped = -k if jnp.issubdtype(k.dtype, jnp.floating) else ~k
         order = jnp.argsort(
-            jnp.where(mask, -_orderable(key), _orderable_max(key)), stable=True
+            jnp.where(mask, flipped, _orderable_max(key)), stable=True
         )
     else:
         order = jnp.argsort(
@@ -416,6 +499,7 @@ def segment_reduce_sorted(
     combine: Callable,  # (value_cols_a, value_cols_b) -> value_cols
     presorted: bool = False,
     lo_name: str = None,
+    sort_impl: str = "xla",
 ) -> Tuple[Cols, jax.Array]:
     """Generic reduce_by_key over a shard: sort by key, then a segmented
     associative scan with an arbitrary traceable combiner; the last row of
@@ -428,7 +512,8 @@ def segment_reduce_sorted(
     of chasing hash buckets."""
     capacity = cols[key_name].shape[0]
     if not presorted:
-        cols = sort_by_column(cols, count, key_name, lo_name=lo_name)
+        cols = sort_by_column(cols, count, key_name, lo_name=lo_name,
+                              impl=sort_impl)
     mask = valid_mask(capacity, count)
     keys = cols[key_name]
     first = jnp.concatenate([
@@ -477,14 +562,15 @@ _FAST_SEGMENT_OPS = {
 
 def segment_reduce_named(
     cols: Cols, count: jax.Array, key_name: str, op: str,
-    presorted: bool = False, lo_name: str = None,
+    presorted: bool = False, lo_name: str = None, sort_impl: str = "xla",
 ) -> Tuple[Cols, jax.Array]:
     """Fast path for the common monoids via XLA segment ops. lo_name names
     the low word of a two-column int64 key (sorts/segments with the key)."""
     seg_op = _FAST_SEGMENT_OPS[op]
     capacity = cols[key_name].shape[0]
     if not presorted:
-        cols = sort_by_column(cols, count, key_name, lo_name=lo_name)
+        cols = sort_by_column(cols, count, key_name, lo_name=lo_name,
+                              impl=sort_impl)
     mask = valid_mask(capacity, count)
     keys = cols[key_name]
     first = jnp.concatenate(

@@ -161,6 +161,49 @@ def partition_pos_pallas(bucket: jax.Array, n_bins: int,
     return out.reshape(-1)[:n]
 
 
+def _digit_hist_kernel(d_ref, hist_ref, *, n_bins: int):
+    """Accumulate per-bin counts across the sequential grid. hist lives
+    in SMEM (scalar stores only)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        for bb in range(n_bins):
+            hist_ref[0, bb] = 0
+
+    b = d_ref[:]
+    for bb in range(n_bins):
+        hist_ref[0, bb] += jnp.sum((b == bb).astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def digit_hist_pallas(digits: jax.Array, n_bins: int,
+                      interpret: bool = False) -> jax.Array:
+    """Histogram of small-range int32 digits in one streaming pass
+    (per-tile counts accumulated in SMEM) — no [n, n_bins] one-hot in
+    HBM. Padding rows land in bin n_bins-1; the caller's use (exclusive
+    prefix starts) never reads that bin's count downstream of real rows
+    in lower bins."""
+    n = digits.shape[0]
+    padded = -(-n // _TILE) * _TILE
+    grid = padded // _TILE
+    d2d = jnp.pad(digits, (0, padded - n),
+                  constant_values=n_bins - 1).reshape(-1, _LANES)
+    pad_bins = -(-n_bins // _LANES) * _LANES
+
+    out = pl.pallas_call(
+        functools.partial(_digit_hist_kernel, n_bins=n_bins),
+        out_shape=jax.ShapeDtypeStruct((1, pad_bins), jnp.int32),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        interpret=interpret,
+    )(d2d)
+    hist = out.reshape(-1)[:n_bins]
+    # un-count the padding rows from the top bin
+    return hist.at[n_bins - 1].add(-(padded - n))
+
+
 def _xla_onehot_pos(bucket: jax.Array, starts: jax.Array,
                     n_bins: int) -> jax.Array:
     """XLA rank path: [n, n_bins] one-hot + column cumsum (O(n * n_bins)
@@ -181,6 +224,29 @@ def _xla_argsort_pos(bucket: jax.Array, starts: jax.Array,
     order = jnp.argsort(bucket, stable=True)
     return jnp.zeros((n,), jnp.int32).at[order].set(
         jnp.arange(n, dtype=jnp.int32))
+
+
+def radix_hist(digits: jax.Array, n_bins: int = 256) -> jax.Array:
+    """Digit histogram for one radix pass, platform-selected at lowering:
+    the Pallas streaming kernel on TPU, bincount elsewhere. n_bins = 2^bits
+    (8-bit digits -> fewer passes, 4-bit -> 16x less per-tile unroll; the
+    hardware A/B decides)."""
+    return jax.lax.platform_dependent(
+        digits,
+        tpu=lambda d: digit_hist_pallas(d, n_bins),
+        default=lambda d: jnp.bincount(d, length=n_bins).astype(jnp.int32),
+    )
+
+
+def radix_pos(digits: jax.Array, starts: jax.Array,
+              n_bins: int = 256) -> jax.Array:
+    """Stable counting-partition positions for one radix pass,
+    platform-selected at lowering (Pallas rank kernel on TPU)."""
+    return jax.lax.platform_dependent(
+        digits, starts,
+        tpu=lambda d, s: partition_pos_pallas(d, n_bins, s),
+        default=lambda d, s: _xla_onehot_pos(d, s, n_bins),
+    )
 
 
 def partition_pos(bucket: jax.Array, n_bins: int, starts: jax.Array,
