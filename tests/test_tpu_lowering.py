@@ -252,3 +252,19 @@ def test_lowering_radix4_sort():
 
     m = _export_sharded(prog, 3, 2, _pair_args())
     assert "tpu_custom_call" in m
+
+
+def test_lowering_fused_radix_bucket_key_sort():
+    """The radix form of the fused (bucket, key) sort — with its narrow
+    8-bit bucket word — lowers for tpu with the Mosaic kernels."""
+    def prog(counts, keys, vals):
+        cols = {KEY: keys, VALUE: vals}
+        count = counts[0]
+        bucket = (kernels.hash32(keys) % jnp.uint32(N)).astype(jnp.int32)
+        bucket = jnp.where(kernels.valid_mask(CAP, count), bucket, N)
+        out, b2 = kernels.bucket_key_sort(cols, count, bucket, KEY,
+                                          impl="radix", n_shards=N)
+        return out[KEY], out[VALUE], b2
+
+    m = _export_sharded(prog, 3, 3, _pair_args())
+    assert "tpu_custom_call" in m

@@ -3151,7 +3151,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     bucket = _bucket_cols(cols, n)
                     bucket = jnp.where(mask, bucket, n)
                     cols, bucket = kernels.bucket_key_sort(
-                        cols, count, bucket, KEY, lo_name=_lo_of(cols)
+                        cols, count, bucket, KEY, lo_name=_lo_of(cols),
+                        impl=sort_impl, n_shards=n,
                     )
                     cols, count = this._segment_reduce(
                         cols, count, presorted=True, sort_impl=sort_impl)
@@ -3406,6 +3407,7 @@ class _JoinRDD(_ExchangeRDD):
         l_chain = _detached_chain(l_chain)
         r_chain = _detached_chain(r_chain)
         outer, fill_value = self.outer, self.fill_value
+        sort_impl = _sort_impl()
         lblk = l_root.block_spec()  # we register our own pending entry
         rblk = r_root.block_spec()
         l_in = list(lblk.cols)
@@ -3458,7 +3460,7 @@ class _JoinRDD(_ExchangeRDD):
                     lcols, lcount, rcols, rcount, KEY, join_cap,
                     outer=outer, fill_value=fill_value,
                     left_sorted=l_sorted, right_sorted=r_sorted,
-                    lo_name=lo_name,
+                    lo_name=lo_name, sort_impl=sort_impl,
                 )
                 return (
                     jcount.reshape(1), jtotal.reshape(1),
@@ -3473,7 +3475,8 @@ class _JoinRDD(_ExchangeRDD):
                  tuple(r_in), _chain_fp(l_chain), _chain_fp(r_chain),
                  slot_pair, out_cap,
                  join_cap, l_elide, r_elide, l_sorted, r_sorted,
-                 self.exchange_mode, self.outer, repr(self.fill_value)),
+                 self.exchange_mode, self.outer, repr(self.fill_value),
+                 sort_impl),
                 lambda: _shard_program(
                     self.mesh, prog_fn, 2 + len(l_in) + len(r_in),
                     (_SPEC,) * (3 + len(key_names) + n_vals)),

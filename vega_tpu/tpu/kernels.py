@@ -144,7 +144,9 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
     O(capacity * n_shards), so callers with a memory bound to honor
     (ring_exchange) set prefer_low_memory and larger meshes always take the
     argsort path."""
-    counts_all = jnp.bincount(bucket, length=n_shards + 1)
+    from vega_tpu.tpu import pallas_kernels as _pk
+
+    counts_all = _pk.bucket_hist(bucket, n_shards + 1)
     counts_to = counts_all[:n_shards]
     starts_all = jnp.cumsum(counts_all) - counts_all  # exclusive prefix
     starts = starts_all[:n_shards]
@@ -173,8 +175,9 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
 
 
 def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
-                    key_name: str, lo_name: str = None
-                    ) -> Tuple[Cols, jax.Array]:
+                    key_name: str, lo_name: str = None,
+                    impl: str = "xla",
+                    n_shards: int = None) -> Tuple[Cols, jax.Array]:
     """One stable multi-key sort by (bucket major, key minor).
 
     Rows become bucket-grouped with a key-sorted run per bucket, so a single
@@ -185,8 +188,34 @@ def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
     ghosted invalid rows (bucket = n_shards) so they sink to the end.
     lo_name names the low word of a two-column int64 key (block.py KEY_LO):
     it joins the sort keys so runs are sorted by the full 64-bit key.
-    Returns (cols, bucket), both permuted."""
+    Returns (cols, bucket), both permuted.
+
+    impl='radix'/'radix4': the LSD radix form — key word passes plus ONE
+    narrow pass for the bucket as the most significant word (8-bit
+    buckets; n_shards tells the radix path the bucket range, and values
+    past 254 keep lax.sort)."""
     capacity = bucket.shape[0]
+    key = cols[key_name]
+    if impl.startswith("radix") and n_shards is not None \
+            and n_shards < 255 \
+            and (lo_name is not None or _radix_supported(key)):
+        # bucket values (incl. the ghost n_shards) fit the 8-bit word
+        words = []
+        word_bits = []
+        if lo_name is not None:
+            words = [_orderable_u32(cols[lo_name], False),
+                     _orderable_u32(key, False)]
+            word_bits = [32, 32]
+        else:
+            words = [_orderable_u32(
+                key, jnp.issubdtype(key.dtype, jnp.floating))]
+            word_bits = [32]
+        words.append(lax.bitcast_convert_type(bucket, jnp.uint32))
+        word_bits.append(8)
+        order = radix_sort_perm(words, count, bits=4 if impl == "radix4"
+                                else 8, word_bits=word_bits)
+        out = gather_rows(cols, order)
+        return out, jnp.take(bucket, order)
     perm_src = lax.iota(jnp.int32, capacity)
     if lo_name is None:
         sorted_bucket, sorted_key, perm = lax.sort(
@@ -219,32 +248,43 @@ def _orderable_u32(word: jax.Array, is_float: bool) -> jax.Array:
 
 
 def radix_sort_perm(words, count: jax.Array,
-                    descending: bool = False, bits: int = 8) -> jax.Array:
+                    descending: bool = False, bits: int = 8,
+                    word_bits=None) -> jax.Array:
     """Stable LSD radix sort permutation over orderable-uint32 words
     (LEAST significant word first); ghost rows (index >= count) sink to
-    the end. Each 8-bit pass streams the digits once through the Pallas
+    the end. Each pass streams the digits once through the Pallas
     histogram + rank kernels on TPU (XLA equivalents elsewhere via
     lax.platform_dependent) and scatters only the still-needed words +
     the permutation — payload columns move ONCE, via the returned perm:
     output row j should be source row perm[j] (gather_rows semantics,
-    same contract as the argsort order in sort_by_column)."""
+    same contract as the argsort order in sort_by_column).
+
+    word_bits optionally gives each word's significant width (default 32
+    each): a bucket id carried as the MOST significant word costs one
+    8-bit pass instead of four — the radix form of the fused
+    (bucket, key) multi-key sort. Narrow words must be value-bounded by
+    their width; descending requires full-width words (the flip is ~w)."""
     from vega_tpu.tpu import pallas_kernels as pk
 
+    if word_bits is None:
+        word_bits = [32] * len(words)
+    assert not (descending and any(b != 32 for b in word_bits))
     cap = words[0].shape[0]
     mask = valid_mask(cap, count)
     active = []
-    for w in words:
+    for w, wb in zip(words, word_bits):
         if descending:
             w = ~w
-        # ghosts get the max word EVERY pass: they start last and stay
-        # last under stability
-        active.append(jnp.where(mask, w, jnp.uint32(0xFFFFFFFF)))
+        # ghosts get the max significant value EVERY pass: they start
+        # last and stay last under stability
+        active.append(jnp.where(mask, w, jnp.uint32((1 << wb) - 1)))
+    widths = list(word_bits)
     perm = lax.iota(jnp.int32, cap)
     n_bins = 1 << bits
     digit_mask = jnp.uint32(n_bins - 1)
     while active:
         word = active[0]
-        for shift in range(0, 32, bits):
+        for shift in range(0, widths[0], bits):
             d = ((word >> jnp.uint32(shift))
                  & digit_mask).astype(jnp.int32)
             hist = pk.radix_hist(d, n_bins)
@@ -256,6 +296,7 @@ def radix_sort_perm(words, count: jax.Array,
             perm = jnp.zeros_like(perm).at[pos].set(perm)
             word = active[0]
         active = active[1:]  # this word's digits are consumed
+        widths = widths[1:]
     return perm
 
 
@@ -311,8 +352,10 @@ def range_bucket(bounds: jax.Array, keys: jax.Array,
 
 def pregrouped_group(bucket: jax.Array, n_shards: int):
     """(counts_to, starts) for rows already contiguous per bucket — the
-    bincount shortcut both exchanges use instead of _group_by_bucket."""
-    counts_all = jnp.bincount(bucket, length=n_shards + 1)
+    histogram shortcut both exchanges use instead of _group_by_bucket."""
+    from vega_tpu.tpu import pallas_kernels as _pk
+
+    counts_all = _pk.bucket_hist(bucket, n_shards + 1)
     counts_to = counts_all[:n_shards]
     starts = (jnp.cumsum(counts_all) - counts_all)[:n_shards]
     return counts_to, starts
@@ -645,6 +688,7 @@ def merge_join_expand(
     left_sorted: bool = False,   # caller guarantees valid-prefix + sorted
     right_sorted: bool = False,
     lo_name: str = None,         # low word of a two-column int64 key
+    sort_impl: str = "xla",
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """General sort-merge join with duplicate keys on BOTH sides.
 
@@ -667,9 +711,11 @@ def merge_join_expand(
     lcap = left[key_name].shape[0]
     rcap = right[key_name].shape[0]
     if not left_sorted:
-        left = sort_by_column(left, left_count, key_name, lo_name=lo_name)
+        left = sort_by_column(left, left_count, key_name, lo_name=lo_name,
+                              impl=sort_impl)
     if not right_sorted:
-        right = sort_by_column(right, right_count, key_name, lo_name=lo_name)
+        right = sort_by_column(right, right_count, key_name,
+                               lo_name=lo_name, impl=sort_impl)
     lkeys = left[key_name]
     rkeys = right[key_name]
     rmask = valid_mask(rcap, right_count)

@@ -226,6 +226,21 @@ def _xla_argsort_pos(bucket: jax.Array, starts: jax.Array,
         jnp.arange(n, dtype=jnp.int32))
 
 
+def bucket_hist(bucket: jax.Array, n_bins: int) -> jax.Array:
+    """Per-bucket counts (bincount replacement for small bucket ranges),
+    platform-selected at lowering: the Pallas streaming histogram on TPU
+    (jnp.bincount lowers to scatter-adds there), bincount elsewhere.
+    Large ranges keep bincount everywhere — the kernel statically
+    unrolls a per-bin step, same bound as the rank kernel's gate."""
+    if n_bins > 65:
+        return jnp.bincount(bucket, length=n_bins).astype(jnp.int32)
+    return jax.lax.platform_dependent(
+        bucket,
+        tpu=lambda b: digit_hist_pallas(b, n_bins),
+        default=lambda b: jnp.bincount(b, length=n_bins).astype(jnp.int32),
+    )
+
+
 def radix_hist(digits: jax.Array, n_bins: int = 256) -> jax.Array:
     """Digit histogram for one radix pass, platform-selected at lowering:
     the Pallas streaming kernel on TPU, bincount elsewhere. n_bins = 2^bits
