@@ -2,7 +2,7 @@
 
 These run ONLY on the actual chip: the tpu_jobs queue invokes them with
 VEGA_TPU_HW_TESTS=1 in a healthy tunnel window (benchmarks/tpu_jobs/
-04_hw_tests.sh); under the normal CPU-mesh suite they are skipped by
+01_hw_tests.sh); under the normal CPU-mesh suite they are skipped by
 conftest. They validate exactly the paths whose behavior differs most
 between the CPU emulation mesh and hardware: capacity sizing + overflow
 retry, speculative settlement + repair, streaming under an HBM budget,

@@ -92,7 +92,7 @@ class Configuration:
     # counting partition by bucket (kernels.partition_by_bucket) — the
     # partition is cheap VPU work over the POST-combine rows, so it wins
     # when the combine shrinks data a lot (high key duplication) and the
-    # sort dominates. A/B on hardware: benchmarks/tpu_jobs/06_plan_ab.sh.
+    # sort dominates. A/B on hardware: benchmarks/tpu_jobs/02_plan_ab.sh.
     dense_rbk_plan: str = "fused_sort"
     # Key-sort implementation inside exchange programs: "xla" = lax.sort
     # comparator network; "radix" / "radix4" = LSD radix over
@@ -100,7 +100,7 @@ class Configuration:
     # digits / 8 passes with 16x less per-tile kernel unroll;
     # Pallas-streamed histogram + rank kernels on TPU) for
     # int32/float32/wide-int64 keys — other dtypes keep lax.sort. A/B on
-    # hardware: benchmarks/tpu_jobs/07_radix_ab.sh.
+    # hardware: benchmarks/tpu_jobs/03_radix_ab.sh.
     dense_sort_impl: str = "xla"
 
     @staticmethod
