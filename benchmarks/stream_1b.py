@@ -9,10 +9,22 @@ scale via argv.
 Usage: python benchmarks/stream_1b.py [rows] [n_keys] [chunk_rows]
 """
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# VEGA_STREAM_1B_TPU=1 (the tpu_jobs queue, healthy window) targets the
+# real chip; anything else forces the CPU mesh via jax.config — env vars
+# alone are too late here: the axon register hooks get_backend and probes
+# the tunnel regardless of JAX_PLATFORMS, hanging when it is wedged.
+if os.environ.get("VEGA_STREAM_1B_TPU") != "1":
+    from _cpu_mesh import force_cpu_mesh
+
+    force_cpu_mesh(8)
 
 
 def main():
@@ -41,9 +53,24 @@ def main():
 
         import jax
 
-        print(f"backend={jax.default_backend()} streamed={streamed} "
-              f"chunks={getattr(src, 'n_chunks', 1)} rows={rows} "
-              f"keys={n_keys}: {dt:.1f}s  {rows/dt/1e6:.1f} M rows/s")
+        # The group_by+join number banks BEFORE the second full pass: a
+        # timeout or assert in the take_ordered phase must not lose the
+        # measurement the tunnel window was opened for.
+        head = (f"backend={jax.default_backend()} streamed={streamed} "
+                f"chunks={getattr(src, 'n_chunks', 1)} rows={rows} "
+                f"keys={n_keys}")
+        print(f"{head}: group_by+join {dt:.1f}s "
+              f"({rows/dt/1e6:.1f} M rows/s)", flush=True)
+
+        # BASELINE config 5's order statistic at full scale: streamed
+        # take_ordered scans chunk by chunk (per-chunk device sort +
+        # driver best-n merge) — no resident materialization.
+        t1 = time.time()
+        smallest = src.take_ordered(10)
+        dt_to = time.time() - t1
+        assert smallest == list(range(10)), smallest[:3]
+        print(f"{head}: take_ordered {dt_to:.1f}s "
+              f"({rows/max(dt_to, 1e-9)/1e6:.1f} M rows/s)", flush=True)
     finally:
         ctx.stop()
 

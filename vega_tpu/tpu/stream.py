@@ -286,6 +286,30 @@ class StreamedDenseRDD:
     def max(self):
         return self._fold_named("max")
 
+    def _stream_best(self, n: int, method: str, reverse: bool) -> list:
+        best: list = []
+        for chunk in self._make_chunks():
+            best.extend(getattr(chunk, method)(n))
+            best = sorted(best, reverse=reverse)[:n]
+        return best
+
+    def take_ordered(self, n: int, key=None) -> list:
+        """Streamed order statistic (BASELINE config 5's take_ordered at
+        1B rows): each chunk's device take_ordered yields <= n
+        candidates; the driver keeps the running best n — the streamed
+        analogue of the host tier's BoundedPriorityQueue merge
+        (rdd.rs:1124-1153). Equivalent to sort_by_key().take_ordered(n)
+        without materializing (or sorting) the full dataset. Custom key
+        functions take the resident fallback like other closures."""
+        if key is not None:
+            return self.resident().take_ordered(n, key)
+        return self._stream_best(n, "take_ordered", reverse=False)
+
+    def top(self, n: int, key=None) -> list:
+        if key is not None:
+            return self.resident().top(n, key)
+        return self._stream_best(n, "top", reverse=True)
+
 
 def streamed_range(ctx, n: int, chunk_rows: int, mesh=None,
                    dtype=None) -> StreamedDenseRDD:
