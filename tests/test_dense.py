@@ -1906,3 +1906,34 @@ def test_sort_by_key_descending_int_min(dctx):
     assert got == [7, 5, 0, -3, -2**31]
     got_asc = [k for k, _ in r.sort_by_key().collect()]
     assert got_asc == [-2**31, -3, 0, 5, 7]
+
+
+def test_take_ordered_top_radix_parity(dctx):
+    """take_ordered/top row sorts under dense_sort_impl=radix match the
+    lax.sort path across value-only, pair, wide-int64, and float blocks
+    (both directions)."""
+    from vega_tpu.env import Env
+
+    rng = np.random.RandomState(12)
+    vals32 = rng.randint(-10**6, 10**6, 5_000).astype(np.int32)
+    keys32 = rng.randint(-500, 500, 5_000).astype(np.int32)
+    flo = (rng.randn(5_000) * 100).astype(np.float32)
+    wide = rng.randint(-2**50, 2**50, 3_000).astype(np.int64)
+    wkeys = rng.randint(0, 100, 3_000).astype(np.int64)
+
+    cases = [
+        ("scalar", dctx.dense_from_numpy(vals32)),
+        ("pair", dctx.dense_from_numpy(keys32, vals32)),
+        ("float", dctx.dense_from_numpy(flo)),
+        ("wide-pair", dctx.dense_from_numpy(wkeys, wide)),
+    ]
+    exp = {name: (r.take_ordered(9), r.top(9)) for name, r in cases}
+
+    old = Env.get().conf.dense_sort_impl
+    Env.get().conf.dense_sort_impl = "radix"
+    try:
+        for name, r in cases:
+            assert r.take_ordered(9) == exp[name][0], name
+            assert r.top(9) == exp[name][1], name
+    finally:
+        Env.get().conf.dense_sort_impl = old

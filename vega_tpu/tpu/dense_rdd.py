@@ -1287,6 +1287,23 @@ class DenseRDD(RDD):
         # adjacent (KEY=hi, KEY_LO=lo) columns, so lexicographic schema
         # order IS int64 order in place.
         k = min(max(n, 1), blk.capacity)
+        impl = _sort_impl()
+        # radix needs every column as an orderable-uint32 word
+        use_radix = impl.startswith("radix") and all(
+            jnp.dtype(dt) in (jnp.dtype(jnp.int32), jnp.dtype(jnp.float32))
+            for _, dt in self._schema())
+
+        def shard_sorted_radix(counts, *cols):
+            count = counts[0]
+            words = [kernels._orderable_u32(
+                c, jnp.issubdtype(c.dtype, jnp.floating))
+                for c in reversed(cols)]  # LSD = last schema column
+            order = kernels.radix_sort_perm(
+                words, count, descending=largest,
+                bits=4 if impl == "radix4" else 8)
+            n_valid = jnp.minimum(count, k).reshape(1)
+            # original (unflipped) values, gathered once
+            return (n_valid,) + tuple(jnp.take(c, order[:k]) for c in cols)
 
         def shard_sorted(counts, *cols):
             capacity = cols[0].shape[0]
@@ -1309,9 +1326,12 @@ class DenseRDD(RDD):
 
         prog = _cached_program(
             ("topk_rows", self.mesh, tuple(names), k, largest,
-             tuple(str(dt) for _, dt in self._schema())),
+             tuple(str(dt) for _, dt in self._schema()),
+             impl if use_radix else "xla"),
             lambda: _shard_program(
-                self.mesh, shard_sorted, 1 + len(names),
+                self.mesh,
+                shard_sorted_radix if use_radix else shard_sorted,
+                1 + len(names),
                 (_SPEC,) * (1 + len(names)),
             ),
         )
@@ -1329,8 +1349,9 @@ class DenseRDD(RDD):
             return []
         merged = {nm: np.concatenate([rows[i] for rows in keep])
                   for i, nm in enumerate(names)}
-        if largest:
-            # un-flip (the device returned flipped sort operands)
+        if largest and not use_radix:
+            # un-flip (the lax.sort path returned flipped sort operands;
+            # the radix path gathers original values)
             for nm in names:
                 col = merged[nm]
                 merged[nm] = -col if np.issubdtype(col.dtype, np.floating) \
