@@ -1927,11 +1927,13 @@ def test_take_ordered_top_radix_parity(dctx):
         ("float", dctx.dense_from_numpy(flo)),
         ("wide-pair", dctx.dense_from_numpy(wkeys, wide)),
     ]
-    exp = {name: (r.take_ordered(9), r.top(9)) for name, r in cases}
-
     old = Env.get().conf.dense_sort_impl
-    Env.get().conf.dense_sort_impl = "radix"
     try:
+        # baseline PINNED to the lax.sort path — comparing radix to the
+        # ambient default could degenerate into radix vs itself
+        Env.get().conf.dense_sort_impl = "xla"
+        exp = {name: (r.take_ordered(9), r.top(9)) for name, r in cases}
+        Env.get().conf.dense_sort_impl = "radix"
         for name, r in cases:
             assert r.take_ordered(9) == exp[name][0], name
             assert r.top(9) == exp[name][1], name

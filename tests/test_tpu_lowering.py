@@ -268,3 +268,70 @@ def test_lowering_fused_radix_bucket_key_sort():
 
     m = _export_sharded(prog, 3, 3, _pair_args())
     assert "tpu_custom_call" in m
+
+
+def test_lowering_real_pipeline_programs(monkeypatch):
+    """Export THE actual programs the dense tier builds — not hand-built
+    reconstructions: run a representative pipeline matrix on the CPU
+    mesh with a _shard_program hook that records each jitted program and
+    its first-call args, then export every one for tpu. Catches Mosaic /
+    XLA:TPU lowering regressions in the exact composed programs
+    production runs (fused chains, segment reduces, histograms, deferred
+    exchanges, topk, zip, union — whatever the pipelines built)."""
+    import vega_tpu as v
+    from vega_tpu.env import Env
+    from vega_tpu.tpu import dense_rdd as dr
+
+    recorded = []
+    orig = dr._shard_program
+
+    def wrapping(mesh, fn, in_specs, out_specs):
+        prog = orig(mesh, fn, in_specs, out_specs)
+
+        def wrapper(*args):
+            if not hasattr(wrapper, "_args"):
+                wrapper._args = args
+                recorded.append(wrapper)
+            return prog(*args)
+
+        wrapper._prog = prog
+        return wrapper
+
+    monkeypatch.setattr(dr, "_shard_program", wrapping)
+    monkeypatch.setattr(dr, "_PROGRAM_CACHE", {})
+
+    ctx = v.Context("local", num_workers=2)
+    conf = Env.get().conf
+    old = (conf.dense_rbk_plan, conf.dense_sort_impl)
+    try:
+        for plan, impl in (("fused_sort", "xla"),
+                           ("sort_partition", "radix")):
+            conf.dense_rbk_plan, conf.dense_sort_impl = plan, impl
+            kv = ctx.dense_range(20_000).map(lambda x: (x % 211, x * 1.0))
+            red = kv.reduce_by_key(op="add")
+            table = ctx.dense_from_numpy(np.arange(211, dtype=np.int32),
+                                         np.arange(211, dtype=np.float32))
+            assert red.join(table).count() == 211
+            assert len(kv.sort_by_key(ascending=False).take(5)) == 5
+            kv.group_by_key().collect_grouped()
+            assert len(kv.take_ordered(5)) == 5
+        # wide int64 values + overflow tracking
+        conf.dense_rbk_plan, conf.dense_sort_impl = old
+        wide = ctx.dense_from_numpy(
+            np.array([1, 1, 2], dtype=np.int64),
+            np.array([2**40, 2**41, 7], dtype=np.int64))
+        wide.reduce_by_key(op="add").collect()
+        bare = ctx.dense_from_numpy(np.array([2**40, 5], dtype=np.int64))
+        bare.sum()
+    finally:
+        conf.dense_rbk_plan, conf.dense_sort_impl = old
+        ctx.stop()
+
+    assert len(recorded) >= 12, len(recorded)
+    failures = []
+    for w in recorded:
+        try:
+            jax.export.export(w._prog, platforms=["tpu"])(*w._args)
+        except Exception as e:  # noqa: BLE001 — collect all failures
+            failures.append(f"{type(e).__name__}: {str(e)[:200]}")
+    assert not failures, "\n".join(failures)

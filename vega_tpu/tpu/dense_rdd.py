@@ -1295,9 +1295,8 @@ class DenseRDD(RDD):
 
         def shard_sorted_radix(counts, *cols):
             count = counts[0]
-            words = [kernels._orderable_u32(
-                c, jnp.issubdtype(c.dtype, jnp.floating))
-                for c in reversed(cols)]  # LSD = last schema column
+            # LSD = last schema column
+            words = kernels.orderable_words(list(reversed(cols)))
             order = kernels.radix_sort_perm(
                 words, count, descending=largest,
                 bits=4 if impl == "radix4" else 8)

@@ -200,16 +200,10 @@ def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
             and n_shards < 255 \
             and (lo_name is not None or _radix_supported(key)):
         # bucket values (incl. the ghost n_shards) fit the 8-bit word
-        words = []
-        word_bits = []
-        if lo_name is not None:
-            words = [_orderable_u32(cols[lo_name], False),
-                     _orderable_u32(key, False)]
-            word_bits = [32, 32]
-        else:
-            words = [_orderable_u32(
-                key, jnp.issubdtype(key.dtype, jnp.floating))]
-            word_bits = [32]
+        key_cols = ([cols[lo_name], key] if lo_name is not None
+                    else [key])
+        words = orderable_words(key_cols)
+        word_bits = [32] * len(words)
         words.append(lax.bitcast_convert_type(bucket, jnp.uint32))
         word_bits.append(8)
         order = radix_sort_perm(words, count, bits=4 if impl == "radix4"
@@ -298,6 +292,15 @@ def radix_sort_perm(words, count: jax.Array,
         active = active[1:]  # this word's digits are consumed
         widths = widths[1:]
     return perm
+
+
+def orderable_words(cols) -> list:
+    """[_orderable_u32(c)] for a sequence of 32-bit columns — the shared
+    radix word construction (sort_by_column, bucket_key_sort, and the
+    take_ordered row sort all build word lists from columns; one site
+    keeps the orderable encoding in lockstep)."""
+    return [_orderable_u32(c, jnp.issubdtype(c.dtype, jnp.floating))
+            for c in cols]
 
 
 def _radix_supported(key: jax.Array) -> bool:
@@ -441,11 +444,9 @@ def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
         if lo_name is not None:
             # wide int64: stored lo's signed order == true-lo unsigned
             # order, so the plain int transform applies to both words
-            words = [_orderable_u32(cols[lo_name], False),
-                     _orderable_u32(key, False)]
+            words = orderable_words([cols[lo_name], key])
         else:
-            words = [_orderable_u32(
-                key, jnp.issubdtype(key.dtype, jnp.floating))]
+            words = orderable_words([key])
         order = radix_sort_perm(words, count, descending,
                                 bits=4 if impl == "radix4" else 8)
         return gather_rows(cols, order)
