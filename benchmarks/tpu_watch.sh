@@ -1,82 +1,129 @@
 #!/bin/bash
-# Background watcher for the flaky axon TPU tunnel (rounds 3+).
+# Background watcher for the flaky axon TPU tunnel (v2, round 5).
 #
-# Loop: probe device init in a short-timeout subprocess; on a healthy
-# probe, drain the job queue (benchmarks/tpu_jobs/NN_*.sh, lexical
-# order). Each job runs under a hard timeout; success renames it to
-# *.done, failure to *.fail<N> after $MAX_TRIES attempts. Everything is
-# appended to the round measurement log ($VEGA_TPU_LOG, default
-# docs/TPU_MEASUREMENTS_r04.log) so a later wedge cannot erase banked
-# numbers.
+# Round-4 postmortem: the old watcher's only probe was a full
+# jax.devices() init under a 90s timeout. Every hung probe burned its
+# whole timeout, so the effective cadence was ~5.5 min at best and a
+# short healthy window could fall entirely between probes. v2 fixes the
+# cadence with a two-stage probe:
+#
+#   stage 1 (cheap, <1s, fixed 45s cadence): TCP connect to the
+#     loopback relay 127.0.0.1:8083 (the stateless axon endpoint that
+#     serves jax.devices()). When the tunnel is wedged the relay is not
+#     listening -- connection refused in under a millisecond. No python,
+#     no device init, no timeout burn.
+#   stage 2 (bounded, only when the port answers): a real jax.devices()
+#     probe under a hard timeout confirms the chip is reachable through
+#     the relay; only a SUCCESSFUL stage-2 probe launches the
+#     long-running job queue.
+#
+# Jobs (benchmarks/tpu_jobs/NN_*.sh, lexical order) run under a hard
+# timeout; success renames to *.done. A job failure only consumes one of
+# its MAX_TRIES attempts if the relay port is still open right after the
+# failure -- if the port is gone, the window closed mid-job and the job
+# keeps its remaining tries for the next window. Everything appends to
+# $VEGA_TPU_LOG so a later wedge cannot erase banked numbers.
 #
 # The TPU is per-process exclusive: only this watcher should touch the
 # real chip. All interactive dev work stays on the CPU mesh.
 
 set -u
 REPO=/root/repo
-LOG="${VEGA_TPU_LOG:-$REPO/docs/TPU_MEASUREMENTS_r04.log}"
+LOG="${VEGA_TPU_LOG:-$REPO/docs/TPU_MEASUREMENTS_r05.log}"
 QUEUE="$REPO/benchmarks/tpu_jobs"
-PROBE_TIMEOUT="${VEGA_PROBE_TIMEOUT_S:-90}"
+RELAY_HOST=127.0.0.1
+RELAY_PORT="${VEGA_RELAY_PORT:-8083}"
+TCP_INTERVAL_S="${VEGA_TCP_INTERVAL_S:-45}"
+PROBE_TIMEOUT="${VEGA_PROBE_TIMEOUT_S:-75}"
 JOB_TIMEOUT="${VEGA_JOB_TIMEOUT_S:-2400}"
-SLEEP_S="${VEGA_PROBE_INTERVAL_S:-240}"
 MAX_TRIES=3
 
 say() { echo "$(date '+%Y-%m-%d %H:%M:%S') $*" >> "$LOG"; }
 
-probe() {
+tcp_probe() {
+  # Pure-bash TCP connect; refused/filtered both fail fast under the 2s cap.
+  timeout 2 bash -c "</dev/tcp/$RELAY_HOST/$RELAY_PORT" 2>/dev/null
+}
+
+jax_probe() {
   timeout -k 10 "$PROBE_TIMEOUT" python - <<'EOF' 2>/dev/null
 import jax
 d = jax.devices()
 assert d[0].platform == "tpu", d
-print(f"OK {d[0].device_kind}")
+print(f"OK {d[0].device_kind} x{len(d)}")
 EOF
 }
 
-say "watcher: started (probe every ${SLEEP_S}s, job timeout ${JOB_TIMEOUT}s)"
-while true; do
-  out=$(probe)
-  rc=$?
-  if [ $rc -ne 0 ]; then
-    # Probe failure lines are cheap but noisy; log one per ~30 min.
-    n=$(( $(date +%s) / 1800 ))
-    if [ "${last_fail_bucket:-}" != "$n" ]; then
-      say "probe: tunnel not answering (rc=$rc)"
-      last_fail_bucket=$n
-    fi
-    sleep "$SLEEP_S"
-    continue
-  fi
-  say "probe: $out"
-  ran_any=0
+run_queue() {
+  # Drain pending jobs while the window stays open. Returns when the
+  # queue is empty or a job fails with the relay port closed.
   for job in "$QUEUE"/[0-9]*.sh; do
     [ -e "$job" ] || continue
     name=$(basename "$job")
     tries_file="$QUEUE/.tries_$name"
     tries=$(cat "$tries_file" 2>/dev/null || echo 0)
-    say "job $name: starting (attempt $((tries + 1)))"
+    say "job $name: starting (attempt $((tries + 1))/$MAX_TRIES)"
     timeout -k 15 "$JOB_TIMEOUT" bash "$job" >> "$LOG" 2>&1
     jrc=$?
     if [ $jrc -eq 0 ]; then
       say "job $name: DONE"
       mv "$job" "$job.done"
       rm -f "$tries_file"
-    else
-      tries=$((tries + 1))
-      echo "$tries" > "$tries_file"
-      say "job $name: FAILED rc=$jrc (attempt $tries/$MAX_TRIES)"
-      if [ "$tries" -ge "$MAX_TRIES" ]; then
-        mv "$job" "$job.fail$tries"
-        rm -f "$tries_file"
-      fi
-      # A failure usually means the window closed; re-probe before more.
-      ran_any=1
-      break
+      continue
     fi
-    ran_any=1
+    if ! tcp_probe; then
+      # Window closed mid-job: not the job's fault, keep its tries.
+      say "job $name: rc=$jrc with relay port closed -- window lost, attempt not counted"
+      return 1
+    fi
+    tries=$((tries + 1))
+    echo "$tries" > "$tries_file"
+    say "job $name: FAILED rc=$jrc with relay still up (attempt $tries/$MAX_TRIES)"
+    if [ "$tries" -ge "$MAX_TRIES" ]; then
+      mv "$job" "$job.fail$tries"
+      rm -f "$tries_file"
+    fi
   done
-  if [ $ran_any -eq 0 ]; then
-    # Queue empty: stay alive, keep logging health so new jobs added
-    # later in the round get picked up in the next window.
-    sleep "$SLEEP_S"
+  return 0
+}
+
+say "watcher v2: started (tcp probe :$RELAY_PORT every ${TCP_INTERVAL_S}s, jax probe timeout ${PROBE_TIMEOUT}s, job timeout ${JOB_TIMEOUT}s)"
+port_was_open=0
+last_beat_bucket=""
+while true; do
+  if tcp_probe; then
+    if [ "$port_was_open" -eq 0 ]; then
+      say "relay: port $RELAY_PORT OPEN (window may be starting)"
+      port_was_open=1
+    fi
+    out=$(jax_probe)
+    rc=$?
+    if [ $rc -eq 0 ]; then
+      say "probe: $out -- draining queue"
+      run_queue
+      pending=$(ls "$QUEUE"/[0-9]*.sh 2>/dev/null | wc -l)
+      say "queue: $pending job(s) still pending"
+      if [ "$pending" -eq 0 ]; then
+        # Keep recording window health so late-added jobs get picked up
+        # and window lengths are measurable from the log.
+        sleep "$TCP_INTERVAL_S"
+      fi
+      continue
+    fi
+    say "probe: port open but device init failed (rc=$rc) -- retrying"
+    # Port open but init hanging: short sleep, the window may firm up.
+    sleep 15
+    continue
   fi
+  if [ "$port_was_open" -eq 1 ]; then
+    say "relay: port $RELAY_PORT CLOSED (window over)"
+    port_was_open=0
+  fi
+  # Hourly heartbeat so the log proves the watcher itself stayed alive.
+  n=$(( $(date +%s) / 3600 ))
+  if [ "$last_beat_bucket" != "$n" ]; then
+    say "heartbeat: watcher alive, relay port closed"
+    last_beat_bucket=$n
+  fi
+  sleep "$TCP_INTERVAL_S"
 done
