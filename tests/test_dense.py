@@ -1069,6 +1069,12 @@ def test_hash_placed_propagation_and_elision(dctx):
     kv = dctx.dense_range(10_000).map(lambda x: (x % 50, x))
     assert not kv.hash_placed
     reduced = kv.reduce_by_key(op="add")
+    # A bare property read is PURE (round-4 advisor): unmaterialized it
+    # answers a conservative False and does NOT launch the exchange.
+    assert not reduced.hash_placed
+    assert reduced._block is None
+    # Planners get the materialized truth via the explicit settle.
+    reduced._settle_placement()
     assert reduced.hash_placed
     assert reduced.map_values(lambda v: v * 2).hash_placed
     assert reduced.filter(lambda p: p[1] > 0).hash_placed
@@ -1109,6 +1115,7 @@ def test_key_sorted_propagation_skips_sorts(dctx):
     still produce exact results (the skipped sorts were redundant)."""
     kv = dctx.dense_range(20_000).map(lambda x: (x % 101, x))
     reduced = kv.reduce_by_key(op="add")
+    reduced._settle_placement()  # property reads are pure (conservative)
     assert reduced.key_sorted and reduced.map_values(lambda v: v).key_sorted
     assert not kv.key_sorted
 
@@ -1766,15 +1773,18 @@ def test_values_dense_keeps_wide_pair_on_device(dctx):
     assert vals.max() == 2**41
 
 
-def test_rbk_sort_partition_plan_parity(dctx):
-    """The alternative reduce exchange plan (key-only sort -> combine ->
-    counting partition, Configuration.dense_rbk_plan) computes identical
-    results to the fused multi-key-sort plan across named ops, traced
-    combiners, wide int64 values, and downstream joins."""
+@pytest.mark.parametrize("plan", ["fused_sort", "sort_partition"])
+def test_rbk_sort_partition_plan_parity(dctx, plan):
+    """Both reduce exchange plans (fused multi-key sort; key-only sort ->
+    combine -> counting partition, Configuration.dense_rbk_plan) compute
+    identical results across named ops, traced combiners, wide int64
+    values, and downstream joins. Parametrized explicitly since the
+    round-5 'auto' default resolves per backend — neither plan may lose
+    coverage to the default."""
     from vega_tpu.env import Env
 
     old = Env.get().conf.dense_rbk_plan
-    Env.get().conf.dense_rbk_plan = "sort_partition"
+    Env.get().conf.dense_rbk_plan = plan
     try:
         r = (dctx.dense_range(50_000).map(lambda x: (x % 997, x))
              .reduce_by_key(op="add"))

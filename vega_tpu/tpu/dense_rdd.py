@@ -140,10 +140,43 @@ class _HostMeshStub:
 # entry must settle/repair through the SAME object).
 #
 # Multi-process note: in SPMD multihost runs the driver program is
-# replicated, so registration order, byte totals, and therefore eviction
-# decisions are identical on every process — a divergent decision would
-# make one process re-dispatch exchange collectives the others skip.
-# Decisions depend only on refcount-deterministic state (no wall clock).
+# replicated, so eviction decisions must be identical on every process —
+# a divergent decision would make one process re-dispatch exchange
+# collectives the others skip. Round-4 advisor finding: weakref liveness
+# (GC timing) and LRU touch order are NOT replicated — a user reference
+# cycle collects at process-divergent times, and concurrent host-tier
+# task threads reorder touches thread-interleaving-dependently. So when
+# jax.process_count() > 1 the policy hardens to a deterministic FIFO:
+#   - entries are keyed by rdd_id (allocation order is replicated;
+#     id() reuse after GC is not),
+#   - touches do not reorder (registration order is the eviction order),
+#   - accounting uses the byte size RECORDED AT REGISTRATION and an
+#     entry leaves the accounting only via eviction or explicit
+#     release — never via weakref death (a dead entry's eviction is a
+#     deterministic no-op pop; its HBM freed when the object died, only
+#     the accounting persists until the sweep reaches it).
+# Single-process keeps true LRU with live-byte accounting and dead-ref
+# pruning (no cross-process divergence to protect against there).
+
+
+_lifetime_multiproc_memo: Optional[bool] = None
+
+
+def _lifetime_multiproc() -> bool:
+    # Safe to ask jax here: lifetime hooks only run on nodes that hold a
+    # materialized device block, so the backend is already initialized
+    # (the CLAUDE.md "never probe backends" rule is about import paths
+    # and pre-init probes on a wedged tunnel). Memoized — process count
+    # is fixed once jax.distributed is up (Context joins the mesh before
+    # any materialization), and this runs on every touch/sweep in the
+    # hot block_spec() path.
+    global _lifetime_multiproc_memo
+    if _lifetime_multiproc_memo is None:
+        try:
+            _lifetime_multiproc_memo = jax.process_count() > 1
+        except Exception:  # noqa: BLE001 — no backend: single-process
+            return False  # don't memoize a pre-init answer
+    return _lifetime_multiproc_memo
 
 
 def _lifetime_lru(ctx) -> dict:
@@ -152,35 +185,52 @@ def _lifetime_lru(ctx) -> dict:
 
 def _lifetime_touch(rdd) -> None:
     lru = rdd.context.__dict__.get("_dense_block_lru")
-    if lru is not None:
-        ref = lru.pop(id(rdd), None)
-        if ref is not None:
-            lru[id(rdd)] = ref  # re-insert at MRU end
+    if lru is None:
+        return
+    if _lifetime_multiproc():
+        return  # FIFO: touch order is thread-interleaving-dependent
+    entry = lru.pop(rdd.rdd_id, None)
+    if entry is not None:
+        lru[rdd.rdd_id] = entry  # re-insert at MRU end
 
 
 def _lifetime_register(rdd) -> None:
     lru = _lifetime_lru(rdd.context)
-    lru.pop(id(rdd), None)
-    lru[id(rdd)] = weakref.ref(rdd)
-    _lifetime_evict(rdd.context, keep=id(rdd))
+    blk = rdd._block
+    lru.pop(rdd.rdd_id, None)
+    lru[rdd.rdd_id] = (weakref.ref(rdd), blk.nbytes if blk is not None
+                       else 0)
+    _lifetime_evict(rdd.context, keep=rdd.rdd_id)
 
 
 def _lifetime_forget(rdd) -> None:
     lru = rdd.context.__dict__.get("_dense_block_lru")
     if lru is not None:
-        lru.pop(id(rdd), None)
+        lru.pop(rdd.rdd_id, None)
 
 
-def _lifetime_sweep(lru: dict) -> Tuple[int, list]:
-    """Prune dead/evicted entries; return (total tracked bytes, live keys
-    in LRU->MRU order). Concurrent-safe against evict/unpersist/touch on
-    other host-tier task threads: every read is a single snapshot (.get,
-    one _block capture), never a check-then-reread."""
+def _lifetime_sweep(lru: dict, multiproc: bool) -> Tuple[int, list]:
+    """Return (total tracked bytes, candidate keys in eviction order).
+    Single-process: prunes dead/evicted entries and counts live block
+    bytes (LRU->MRU order). Multi-process: counts REGISTERED bytes of
+    every entry, dead or alive, in registration order — liveness is GC
+    timing, which diverges across processes, so it must not influence
+    totals or ordering (dead entries fall out when the evictor reaches
+    them, identically everywhere). Concurrent-safe against evict/
+    unpersist on other host-tier task threads: every read is a single
+    snapshot (.get, one _block capture), never a check-then-reread."""
     live = []
     total = 0
     for key in list(lru):
-        ref = lru.get(key)
-        rdd = ref() if ref is not None else None
+        entry = lru.get(key)
+        if entry is None:
+            continue
+        ref, reg_bytes = entry
+        if multiproc:
+            total += reg_bytes
+            live.append(key)
+            continue
+        rdd = ref()
         blk = rdd._block if rdd is not None else None
         if blk is None:
             lru.pop(key, None)
@@ -192,11 +242,14 @@ def _lifetime_sweep(lru: dict) -> Tuple[int, list]:
 
 def dense_hbm_in_use(ctx) -> int:
     """Tracked device-resident bytes of materialized dense intermediates
-    (sources excluded — see the lifetime note above). Prunes dead refs."""
+    (sources excluded — see the lifetime note above). Single-process this
+    prunes dead refs and reports live bytes; multi-process it reports the
+    deterministic registered-byte accounting (which may briefly include
+    blocks whose owner died — see the multi-process note)."""
     lru = ctx.__dict__.get("_dense_block_lru")
     if not lru:
         return 0
-    return _lifetime_sweep(lru)[0]
+    return _lifetime_sweep(lru, _lifetime_multiproc())[0]
 
 
 def _lifetime_evict(ctx, keep: Optional[int] = None) -> None:
@@ -206,23 +259,38 @@ def _lifetime_evict(ctx, keep: Optional[int] = None) -> None:
     lru = ctx.__dict__.get("_dense_block_lru")
     if not lru:
         return
-    total, live = _lifetime_sweep(lru)
+    multiproc = _lifetime_multiproc()
+    total, live = _lifetime_sweep(lru, multiproc)
     if total <= budget:
         return
-    for key in live:  # LRU -> MRU (dict insertion order)
+    for key in live:  # registration (FIFO) / LRU order
         if total <= budget:
             break
         if key == keep:
             continue
-        ref = lru.get(key)
-        rdd = ref() if ref is not None else None
+        entry = lru.get(key)
+        if entry is None:
+            continue
+        ref, reg_bytes = entry
+        rdd = ref()
         blk = rdd._block if rdd is not None else None
         if blk is None:
+            # Dead or already-released: deterministic pop, accounting
+            # freed. (Multi-process: one process's GC may see the object
+            # alive while another's doesn't — both still pop this entry
+            # here, subtract the same registered bytes, and dispatch no
+            # collectives, so decisions stay aligned.)
+            total -= reg_bytes if multiproc else 0
             lru.pop(key, None)
             continue
         if blk.settle is not None:
-            continue  # pending speculation: evictable only once settled
-        total -= blk.nbytes
+            # Pending speculation: evictable only once settled. This
+            # check is multi-process deterministic: a pending node is
+            # strongly held by ctx._dense_pending (entry["rdd"]) on
+            # every process, so ref() cannot be dead on one process and
+            # pending-alive on another.
+            continue
+        total -= reg_bytes if multiproc else blk.nbytes
         rdd._block = None
         rdd.__dict__.pop("_pickle_state_memo", None)
         lru.pop(key, None)
@@ -238,18 +306,33 @@ _HEAVY_ATTRS = frozenset({
     "context", "_deps", "_dense_parents", "parent", "left", "right",
     "first", "second", "_block", "_pickle_state_memo", "_fp_memo",
     "_cfp_memo", "_checkpointed_rdd", "_deferred_entry",
+    "_host_stage_block",
 })
 
 
 def _heavy_value(v) -> bool:
     """Fail-closed backstop for _detach: any attribute VALUE that is (or
-    shallowly contains) an RDD or Block pins lineage/HBM if captured in a
-    process-lifetime program closure — strip it even under a name
-    _HEAVY_ATTRS doesn't know (e.g. a future `self.table = other_rdd`)."""
-    if isinstance(v, (RDD, Block)):
-        return True
-    if isinstance(v, (tuple, list)):
-        return any(isinstance(x, (RDD, Block)) for x in v)
+    contains, at any container depth) an RDD or Block pins lineage/HBM if
+    captured in a process-lifetime program closure — strip it even under
+    a name _HEAVY_ATTRS doesn't know (e.g. a future `self.table =
+    other_rdd`). Full recursion through tuples/lists/sets/dicts (an RDD
+    inside a dict-valued attribute must not slip through); the visited
+    set bounds cyclic structures."""
+    stack = [v]
+    seen = set()
+    while stack:
+        x = stack.pop()
+        if isinstance(x, (RDD, Block)):
+            return True
+        if id(x) in seen:
+            continue
+        if isinstance(x, (tuple, list, set, frozenset)):
+            seen.add(id(x))
+            stack.extend(x)
+        elif isinstance(x, dict):
+            seen.add(id(x))
+            stack.extend(x.keys())
+            stack.extend(x.values())
     return False
 
 
@@ -424,6 +507,7 @@ class DenseRDD(RDD):
             self._block = None
             self.__dict__.pop("_pickle_state_memo", None)
             _lifetime_forget(self)
+        self.__dict__.pop("_host_stage_block", None)
         return self
 
     def _counts_fp(self):
@@ -481,7 +565,13 @@ class DenseRDD(RDD):
         analogue of the host tier's partitioner-equality shuffle elision
         (reference: co_grouped_rdd.rs:102-127, a CLAUDE.md invariant).
         Key-preserving narrow ops propagate it; anything that can rewrite
-        keys resets it."""
+        keys resets it.
+
+        PURE: reading this property never materializes anything. Nodes
+        whose placement is only knowable post-materialization (the
+        reduce's host-exact fold takeover) answer conservatively (False)
+        while unmaterialized; exchange planners call _settle_placement()
+        first to get the materialized truth."""
         return False
 
     @property
@@ -493,6 +583,16 @@ class DenseRDD(RDD):
         exchange or a union concat."""
         return False
 
+    def _settle_placement(self) -> None:
+        """Make hash_placed/key_sorted answer truthfully, materializing
+        whatever that requires (explicit side effect — the property reads
+        themselves stay pure). Narrow nodes forward to the parent their
+        placement delegates to; the reduce materializes itself (its
+        host-fold takeover is only known post-exchange); everything else
+        is a no-op. Exchange planners MUST call this on an input before
+        reading its flags for an elision decision (round-4 advisor:
+        a bare property read must never launch an exchange)."""
+
     def _schema(self) -> Tuple[Tuple[str, jnp.dtype], ...]:
         """(name, dtype) of columns without materializing."""
         raise NotImplementedError
@@ -502,26 +602,56 @@ class DenseRDD(RDD):
     def num_partitions(self) -> int:
         return self.mesh.size
 
-    def splits(self) -> List[Split]:
-        # Host-tier interop only (dense actions bypass the scheduler).
-        # On a multi-process mesh, pre-gather an already-materialized
-        # block's columns HERE: splits() runs on the driver thread at
-        # stage submission (dag.py submit_missing_tasks /
-        # _get_preferred_locs), while compute() fans out to scheduler
-        # task threads whose interleaving differs across processes —
-        # and jax.distributed collectives must be dispatched in the
-        # same order on every process. (An unmaterialized block still
-        # dispatches its exchanges from whichever thread first calls
-        # block(); keep multihost dense pipelines on the dense tier.)
+    def _spans_processes(self) -> bool:
+        """Does this node's data live on a multi-process (jax.distributed)
+        mesh? Read from the materialized block when there is one (no
+        backend probe); otherwise from the mesh's device->process map —
+        safe, because a Mesh only exists after backend init."""
         blk = self._block
         if blk is not None and blk.cols:
             first = next(iter(blk.cols.values()))
-            if isinstance(first, jax.Array) and \
-                    not first.is_fully_addressable:
-                blk.host_cols()
+            return (isinstance(first, jax.Array)
+                    and not first.is_fully_addressable)
+        devs = getattr(self.mesh, "devices", None)
+        if devs is None:  # _HostMeshStub: host data, single process
+            return False
+        try:
+            return len({d.process_index for d in devs.flat}) > 1
+        except Exception:  # noqa: BLE001 — stub/CPU meshes: no span
+            return False
+
+    def splits(self) -> List[Split]:
+        # Host-tier interop only (dense actions bypass the scheduler).
+        # On a multi-process mesh the block is materialized AND
+        # snapshotted to host numpy HERE: splits() runs on the driver
+        # thread at stage submission (dag.py submit_missing_tasks /
+        # _get_preferred_locs), while compute() fans out to scheduler
+        # task threads whose interleaving differs across processes — and
+        # jax.distributed collectives must be dispatched in the same
+        # order on every process. Materializing here (not just
+        # pre-gathering an already-built block, as rounds 3-4 did) also
+        # closes the round-4 advisor race: _lifetime_evict may null
+        # _block between stage submission and compute(), and the
+        # re-materialization would otherwise dispatch collectives from
+        # task threads. The snapshot hangs off the node (not the LRU'd
+        # Block), so a mid-stage eviction cannot resurrect device work;
+        # unpersist() drops it.
+        if self._spans_processes() \
+                and self.__dict__.get("_host_stage_block") is None:
+            blk = self.block()  # driver thread: deterministic collectives
+            self._host_stage_block = Block(
+                cols={n: np.asarray(c)
+                      for n, c in blk.host_cols().items()},
+                counts=blk.counts_np, capacity=blk.capacity,
+                mesh=_HostMeshStub(self.mesh.size),
+            )
         return [Split(i) for i in range(self.num_partitions)]
 
     def compute(self, split: Split, task_context=None):
+        hb = self.__dict__.get("_host_stage_block")
+        if hb is not None:  # multi-process: device-free task threads
+            yield from _yield_rows(hb.shard_rows(split.index))
+            return
         yield from _yield_rows(self.block().shard_rows(split.index))
 
     @property
@@ -1698,6 +1828,9 @@ class _MapValuesRDD(_NarrowRDD):
     def key_sorted(self) -> bool:
         return self.parent.key_sorted  # order untouched
 
+    def _settle_placement(self) -> None:
+        self.parent._settle_placement()
+
 
 class _FilterRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, pred):
@@ -1726,6 +1859,9 @@ class _FilterRDD(_NarrowRDD):
     @property
     def key_sorted(self) -> bool:
         return self.parent.key_sorted  # compact is stable
+
+    def _settle_placement(self) -> None:
+        self.parent._settle_placement()
 
 
 def _fixed_payload_schema(payload, width: int, what: str):
@@ -1982,6 +2118,9 @@ class _SelectRDD(_NarrowRDD):
     def key_sorted(self) -> bool:
         return KEY in self._names and self.parent.key_sorted
 
+    def _settle_placement(self) -> None:
+        self.parent._settle_placement()
+
 
 class _RenameRDD(_NarrowRDD):
     """Value-column rename (keys untouched, so placement/order survive)."""
@@ -2004,6 +2143,9 @@ class _RenameRDD(_NarrowRDD):
     @property
     def key_sorted(self) -> bool:
         return self.parent.key_sorted
+
+    def _settle_placement(self) -> None:
+        self.parent._settle_placement()
 
 
 class _OnesValueRDD(_NarrowRDD):
@@ -2031,6 +2173,9 @@ class _OnesValueRDD(_NarrowRDD):
     @property
     def key_sorted(self) -> bool:
         return self.parent.key_sorted
+
+    def _settle_placement(self) -> None:
+        self.parent._settle_placement()
 
 
 class _WidenKeyRDD(_NarrowRDD):
@@ -2898,19 +3043,28 @@ class _ReduceByKeyRDD(_ExchangeRDD):
     def hash_placed(self) -> bool:
         """Output rows live on shard hash(key) % n — EXCEPT after a
         host-exact fold (wide-sum overflow takeover), which rebuilds with
-        no device placement. Read from the materialized truth:
-        block_spec() doesn't settle, and a later failed speculation
-        invalidates dependents through _settle_pending's lineage walk, so
-        an early read stays sound."""
-        self.block_spec()
+        no device placement. PURE read: while unmaterialized the answer
+        is a conservative False (a bare attribute read — repr, debug,
+        monitoring — must not launch the exchange as a side effect);
+        planners call _settle_placement() first for the materialized
+        truth. block_spec() doesn't settle, and a later failed
+        speculation invalidates dependents through _settle_pending's
+        lineage walk, so an early post-materialization read stays
+        sound."""
+        if self._block is None:
+            return False
         return not getattr(self, "_host_folded", False)
 
     @property
     def key_sorted(self) -> bool:
         """Segment ends come out in key order — except after a host-exact
-        fold (same materialized-truth read as hash_placed)."""
-        self.block_spec()
+        fold (same conservative-until-materialized read as hash_placed)."""
+        if self._block is None:
+            return False
         return not getattr(self, "_host_folded", False)
+
+    def _settle_placement(self) -> None:
+        self.block_spec()
 
     def __init__(self, parent: DenseRDD, op: Optional[str], func):
         super().__init__(parent.context, parent.mesh, [parent])
@@ -3090,6 +3244,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # parent already has every key's rows on their reducer shard, so
         # the whole exchange (hash + multi-key sort + collective)
         # collapses to one per-shard segment reduce — zero collectives.
+        self.parent._settle_placement()  # materialized truth, explicitly
         elide = self.parent.hash_placed and n > 1
         # Order survives the elided passthrough's stable compact, letting
         # the reduce run presorted (no sort at all in reduce-of-reduce).
@@ -3121,13 +3276,20 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             block_lib.wide_value_pairs(names))
         from vega_tpu.env import Env as _Env
 
-        plan = getattr(_Env.get().conf, "dense_rbk_plan", "fused_sort")
-        if plan not in ("fused_sort", "sort_partition"):
+        plan = getattr(_Env.get().conf, "dense_rbk_plan", "auto")
+        if plan not in ("auto", "fused_sort", "sort_partition"):
             # A typo'd plan silently running fused_sort would corrupt an
             # A/B (a scarce tunnel-window job measuring fused vs fused).
             raise VegaError(
-                f"dense_rbk_plan must be 'fused_sort' or 'sort_partition',"
-                f" got {plan!r}")
+                f"dense_rbk_plan must be 'auto', 'fused_sort' or "
+                f"'sort_partition', got {plan!r}")
+        if plan == "auto":
+            # Per-backend resolution from measured evidence (env.py
+            # dense_rbk_plan note; docs/BENCH_NOTES.md round 5). Safe to
+            # ask the backend here: resolution happens at materialize
+            # time, inside device work.
+            plan = ("sort_partition" if jax.default_backend() == "cpu"
+                    else "fused_sort")
 
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
@@ -3278,6 +3440,7 @@ class _GroupByKeyRDD(_ExchangeRDD):
 
     def _materialize(self) -> Block:
         n = self.mesh.size
+        self.parent._settle_placement()  # materialized truth, explicitly
         elide = self.parent.hash_placed and n > 1  # rows already placed
         elide_sorted = elide and self.parent.key_sorted
         # Fused only on the real-exchange path (see reduce: elided/1-shard
@@ -3413,6 +3576,8 @@ class _JoinRDD(_ExchangeRDD):
         # on their key's shard (reduce/group/join outputs), so only the
         # other side moves — the north-star reduced.join(table) pipeline
         # pays ONE collective instead of two.
+        self.left._settle_placement()   # materialized truth, explicitly
+        self.right._settle_placement()
         l_elide = self.left.hash_placed and n > 1
         r_elide = self.right.hash_placed and n > 1
         # Pending narrow chains fuse into the join program (same
@@ -4014,6 +4179,10 @@ class _DenseUnionRDD(DenseRDD):
     def hash_placed(self) -> bool:
         # Same placement function on both sides -> concat preserves it.
         return self.first.hash_placed and self.second.hash_placed
+
+    def _settle_placement(self) -> None:
+        self.first._settle_placement()
+        self.second._settle_placement()
 
     def _schema(self):
         return self.first._schema()

@@ -186,6 +186,7 @@ class StreamedDenseRDD:
         if isinstance(other, StreamedDenseRDD):
             other = other.resident()
         if isinstance(other, DenseRDD) and partitioner_or_num is None:
+            other._settle_placement()  # hash_placed reads are pure
             if not other.hash_placed:
                 # One exchange+sort re-places the table; per-chunk joins
                 # then skip the right side's exchange AND sort entirely.
