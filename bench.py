@@ -153,6 +153,74 @@ def _bank_partial_device(n_rows, n_keys, dev_s, dev_rows_per_s) -> None:
     })
 
 
+def _leg_history_path():
+    import os
+
+    return os.path.join(_here(), "docs", "BENCH_LEG_HISTORY.jsonl")
+
+
+def _leg_history_compare_and_append(detail: dict) -> None:
+    """Per-leg, per-round bench accounting (round-4 verdict: the r03->r04
+    'improvement' 1.28x->1.48x was the HOST leg regressing 16% while the
+    device leg also got slower — the ratio flattered a double regression
+    and nothing tracked it). Each completed bench appends a commit-stamped
+    row per leg; the most recent prior row at the same backend+scale
+    yields leg deltas that go into the result detail, with a LOUD
+    regression marker when either leg slowed >5%. Never costs the result
+    line: all I/O errors are swallowed."""
+    import os
+
+    try:
+        entry = {
+            "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "commit": _git_head(),
+            "backend": detail.get("backend"),
+            "rows": detail.get("rows"),
+            "device_seconds": detail.get("device_seconds"),
+            "host_seconds": detail.get("host_seconds"),
+            "host_rows_per_sec": detail.get("host_rows_per_sec"),
+        }
+        prior = None
+        path = _leg_history_path()
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (row.get("backend") == entry["backend"]
+                            and row.get("rows") == entry["rows"]):
+                        prior = row  # last matching row wins
+        if prior:
+            deltas = {}
+            for leg in ("device_seconds", "host_seconds"):
+                old, new = prior.get(leg), entry.get(leg)
+                if old and new:
+                    pct = (new - old) / old * 100.0
+                    deltas[leg.replace("_seconds", "_delta_pct")] = round(
+                        pct, 1)
+            if deltas:
+                detail["legs_vs_prior"] = dict(
+                    deltas, prior_commit=prior.get("commit"),
+                    prior_ts=prior.get("ts"))
+                worst = max(deltas.values())
+                if worst > 5.0:
+                    detail["LEG_REGRESSION"] = (
+                        f"a leg slowed {worst:.1f}% vs the prior run at "
+                        "this backend+scale — the headline ratio cannot "
+                        "be trusted until this is reproduced or "
+                        "attributed (docs/BENCH_NOTES.md)")
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        print(f"[bench] leg history failed (ignored): {e}", file=sys.stderr,
+              flush=True)
+
+
 def _emit_banked_tpu(reason: str) -> bool:
     """If a banked real-TPU measurement exists, emit it (labeled with its
     capture timestamp and why it is being replayed) and return True. A
@@ -380,17 +448,38 @@ def main():
         _phase(f"device warmup ({n_rows:,} rows)")
         warm = device_pipeline(ctx, n_rows, n_keys)
         assert warm == n_keys
-        _phase("device measured run")
-        t0 = time.time()
-        dev_count = device_pipeline(ctx, n_rows, n_keys)
-        dev_s = time.time() - t0
-        assert dev_count == n_keys
-        dev_rows_per_s = n_rows / dev_s
-        banked.update(rows_per_s=dev_rows_per_s, dev_s=round(dev_s, 3))
-        _phase(f"device done: {dev_s:.3f}s; host baseline next")
+        # Median of up to 3 measured reps (deadline-guarded): single-run
+        # legs on the 1-core sandbox carry ~±15% noise (round-5 leg
+        # attribution, docs/BENCH_NOTES.md) — enough to fake or mask a
+        # real regression. The first rep always completes; later reps
+        # only start while >25% of budget remains.
         import jax as _j
 
-        if _j.default_backend() == "tpu" and not on_fallback:
+        dev_reps = []
+        for rep in range(3):
+            _phase(f"device measured run {rep + 1}")
+            t0 = time.time()
+            dev_count = device_pipeline(ctx, n_rows, n_keys)
+            dev_reps.append(time.time() - t0)
+            assert dev_count == n_keys
+            # Lower-middle on even lengths: a deadline-truncated 2-rep
+            # run must not bank the SLOWER rep as its "median".
+            dev_s = sorted(dev_reps)[(len(dev_reps) - 1) // 2]
+            banked.update(rows_per_s=n_rows / dev_s, dev_s=round(dev_s, 3))
+            if rep == 0 and _j.default_backend() == "tpu" \
+                    and not on_fallback:
+                # Bank the first rep IMMEDIATELY — the tunnel window can
+                # close during reps 2-3; the re-bank below upgrades the
+                # banked number to the median if they complete.
+                _bank_partial_device(n_rows, n_keys, dev_s, n_rows / dev_s)
+            if time.time() > deadline - 0.25 * budget:
+                break
+        dev_s = sorted(dev_reps)[(len(dev_reps) - 1) // 2]
+        dev_rows_per_s = n_rows / dev_s
+        _phase(f"device done: median {dev_s:.3f}s over {len(dev_reps)}; "
+               "host baseline next")
+        if len(dev_reps) > 1 and _j.default_backend() == "tpu" \
+                and not on_fallback:
             _bank_partial_device(n_rows, n_keys, dev_s, dev_rows_per_s)
 
         # Device number is banked: swap the stall rescue for a
@@ -403,12 +492,17 @@ def main():
         # device run: same rows, same keys, identical results — the
         # apples-to-apples ratio round 1 lacked (it compared tiers at
         # different scales) ---
-        t0 = time.time()
-        host_count = host_pipeline(ctx, n_rows, n_keys)
-        host_s = time.time() - t0
+        host_reps = []
+        for rep in range(3):
+            t0 = time.time()
+            host_count = host_pipeline(ctx, n_rows, n_keys)
+            host_reps.append(time.time() - t0)
+            assert host_count == n_keys
+            if time.time() > deadline - 0.25 * budget:
+                break
+        host_s = sorted(host_reps)[(len(host_reps) - 1) // 2]
         host_rows_per_s = n_rows / host_s
-        assert host_count == n_keys
-        _phase(f"host done: {host_s:.3f}s")
+        _phase(f"host done: median {host_s:.3f}s over {len(host_reps)}")
 
         import jax
 
@@ -428,10 +522,13 @@ def main():
             "host_seconds": round(host_s, 3),
             "host_rows_per_sec": round(host_rows_per_s),
             "hbm_gbps_lower_bound": round(gbps_lb, 1),
+            "device_rep_seconds": [round(t, 3) for t in dev_reps],
+            "host_rep_seconds": [round(t, 3) for t in host_reps],
         }
         if backend == "tpu":
             # v5e HBM bandwidth ~819 GB/s.
             detail["hbm_utilization_lower_bound"] = round(gbps_lb / 819, 3)
+        _leg_history_compare_and_append(detail)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "
                       "1M-key inner join; host tier measured at identical "

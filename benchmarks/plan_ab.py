@@ -65,6 +65,7 @@ def main():
     ctx = v.Context("local")
     try:
         # --- end-to-end A/B (warm: second run of each shape) ------------
+        plan_before = Env.get().conf.dense_rbk_plan
         for plan in ("fused_sort", "sort_partition"):
             Env.get().conf.dense_rbk_plan = plan
 
@@ -79,7 +80,9 @@ def main():
             n1 = run()  # warm
             result[f"warm_s_{plan}"] = round(time.time() - t0, 4)
             assert n0 == n1 == n_keys
-        Env.get().conf.dense_rbk_plan = "fused_sort"
+        # Restore the SHIPPED default ("auto" since round 5), not a
+        # hardcoded plan: anything measured below must run what ships.
+        Env.get().conf.dense_rbk_plan = plan_before
 
         # --- stage breakdown (per-shard shapes, jitted pieces) ----------
         mesh = mesh_lib.default_mesh()

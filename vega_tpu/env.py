@@ -92,8 +92,14 @@ class Configuration:
     # counting partition by bucket (kernels.partition_by_bucket) — the
     # partition is cheap VPU work over the POST-combine rows, so it wins
     # when the combine shrinks data a lot (high key duplication) and the
-    # sort dominates. A/B on hardware: benchmarks/tpu_jobs/02_plan_ab.sh.
-    dense_rbk_plan: str = "fused_sort"
+    # sort dominates. "auto" (round-5 default) resolves per backend from
+    # the measured evidence: sort_partition on CPU (won the A/B at both
+    # 2M and 5M bench shapes, 10-20% faster warm end-to-end —
+    # docs/BENCH_NOTES.md round 5), fused_sort on TPU until the queued
+    # on-chip A/B (benchmarks/tpu_jobs/02_plan_ab.sh) decides: the only
+    # hardware number ever captured used fused_sort, and the headline
+    # bench must not gamble on a plan with no on-chip measurement.
+    dense_rbk_plan: str = "auto"
     # Key-sort implementation inside exchange programs: "xla" = lax.sort
     # comparator network; "radix" / "radix4" = LSD radix over
     # orderable-uint32 words (8-bit digits / 4 passes per word, or 4-bit
