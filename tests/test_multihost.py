@@ -254,6 +254,125 @@ _MULTIHOST_LIFETIME_SCRIPT = textwrap.dedent("""
 """)
 
 
+_MULTIHOST_COVERAGE_SCRIPT = textwrap.dedent("""
+    import gc
+    import signal
+    import sys
+
+    sys.path.insert(0, "__REPO__")
+    from _cpu_mesh import force_cpu_mesh
+
+    force_cpu_mesh(2, assert_count=False)
+
+    signal.alarm(300)  # divergence hangs in a collective: die loudly
+
+    import jax
+
+    import vega_tpu as v
+    from vega_tpu.env import Env
+    from vega_tpu.tpu.stream import streamed_range
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    ctx = v.Context("local", multihost=dict(
+        coordinator=coordinator, num_processes=2, process_id=pid))
+    try:
+        assert jax.process_count() == 2
+
+        # cogroup over the global mesh (both sides exchange + device sort).
+        a = ctx.dense_range(30_000).map(lambda x: (x % 64, x))
+        b = ctx.dense_range(10_000).map(lambda x: (x % 64, x * 2))
+        got = dict(a.cogroup(b).collect())
+        for k in (0, 17, 63):
+            lv, rv = got[k]
+            assert sorted(lv) == [x for x in range(30_000) if x % 64 == k]
+            assert sorted(rv) == [x * 2 for x in range(10_000)
+                                  if x % 64 == k]
+
+        # sort_by_key at larger scale (range exchange: replicated bound
+        # sampling + a real cross-process collective per shard move).
+        n = 50_000
+        sk = (ctx.dense_range(n).map(lambda x: (x * 2654435761 % n, x))
+              .sort_by_key())
+        keys = [k for k, _ in sk.collect()]
+        assert keys == sorted(x * 2654435761 % n for x in range(n))
+
+        # A streamed source over the global mesh: per-chunk device
+        # reduces + accumulator folds, all SPMD across both processes.
+        s = streamed_range(ctx, 60_000, chunk_rows=20_000)
+        red = s.map(lambda x: (x % 41, x % 97)).reduce_by_key(op="add")
+        sgot = dict(red.collect())
+        assert sgot[7] == sum(x % 97 for x in range(60_000)
+                              if x % 41 == 7)
+
+        # Adversarial eviction determinism under ASYMMETRIC GC: process 0
+        # hides nodes in reference cycles and collects them at a time of
+        # its own choosing; process 1 keeps strong references. Eviction
+        # accounting follows registration order + explicit release ONLY
+        # (weakref death must not influence decisions), so both processes
+        # keep dispatching identical collectives — a divergence deadlocks
+        # and the alarm kills us.
+        Env.get().conf.dense_hbm_budget = 600_000
+        keep = []
+        for i in range(6):
+            nd = ctx.dense_range(20_000).map(lambda x, i=i: x + i)
+            nd.block()
+            if pid == 1:
+                keep.append(nd)
+            else:
+                cyc = [nd]
+                cyc.append(cyc)  # cycle: dies only at gc.collect()
+                del nd, cyc
+        if pid == 0:
+            gc.collect()  # process-divergent collection point
+        for i in range(4):
+            r = (ctx.dense_range(20_000).map(lambda x: (x % 31, x))
+                 .reduce_by_key(op="add"))
+            assert dict(r.collect())[0] == sum(
+                x for x in range(20_000) if x % 31 == 0)
+        assert ctx.dense_hbm_in_use() <= 600_000
+        print("MULTIHOST_COVERAGE_OK", pid, flush=True)
+    finally:
+        ctx.stop()
+""")
+
+
+_MULTIHOST_PEER_LOSS_SCRIPT = textwrap.dedent("""
+    import os
+    import signal
+    import sys
+    import time
+
+    sys.path.insert(0, "__REPO__")
+    from _cpu_mesh import force_cpu_mesh
+
+    force_cpu_mesh(2, assert_count=False)
+
+    # The point of the test is that the COORDINATION SERVICE bounds the
+    # hang, not this alarm; the alarm is the loud backstop that proves
+    # the bound was missed.
+    signal.alarm(150)
+
+    import vega_tpu as v
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    ctx = v.Context("local", multihost=dict(
+        coordinator=coordinator, num_processes=2, process_id=pid,
+        heartbeat_timeout_s=10))
+    kv = ctx.dense_range(8_000).map(lambda x: (x % 13, x))
+    got = dict(kv.reduce_by_key(op="add").collect())
+    assert got[0] == sum(x for x in range(8_000) if x % 13 == 0)
+    print("FIRST_OK", pid, flush=True)
+    if pid == 1:
+        os._exit(31)  # abrupt death: no shutdown, no goodbye
+    # Survivor: this pipeline's exchange collective needs process 1.
+    print("SURVIVOR_ENTERING", flush=True)
+    r2 = (ctx.dense_range(8_000).map(lambda x: (x % 7, x))
+          .reduce_by_key(op="add"))
+    dict(r2.collect())
+    print("SURVIVOR_UNEXPECTED_COMPLETION", flush=True)
+""")
+
+
 def _run_two_process(tmp_path, script_body, timeout_s=420):
     """Spawn the same worker script as processes 0 and 1 joined through one
     jax.distributed coordinator; return [(rc, out, err), ...] or skip if
@@ -317,6 +436,68 @@ def test_multihost_dense_lifetime_eviction(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
         assert "MULTIHOST_LIFETIME_OK" in out
+
+
+def test_multihost_dense_wider_surface(tmp_path):
+    """Round-4 verdict item 7: the rest of the dense surface over a real
+    2-process global mesh — cogroup, sort_by_key at larger scale, a
+    streamed source, and eviction under HBM pressure with ASYMMETRIC
+    per-process GC (process 0 collects reference cycles at a divergent
+    time; eviction decisions must stay replicated because accounting
+    ignores weakref death — the round-4 advisor's determinism fix)."""
+    outs = _run_two_process(tmp_path, _MULTIHOST_COVERAGE_SCRIPT)
+    for rc, out, err in outs:
+        assert rc == 0, f"rc={rc}\nstdout={out}\nstderr={err}"
+        assert "MULTIHOST_COVERAGE_OK" in out
+
+
+def test_multihost_dense_peer_loss_fails_crisply(tmp_path):
+    """Round-4 verdict item 6: a process dying mid-pipeline must leave
+    the survivor with a crisp, BOUNDED failure — the jax.distributed
+    coordination service detects the lost heartbeat (configured to 10s
+    here; jax default 100s) and terminates the survivor with a fatal
+    "another task died" error instead of letting it hang forever inside
+    a collective that can no longer complete. Reference analogue:
+    executor-loss detection, distributed_scheduler.rs:434-445."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_MULTIHOST_PEER_LOSS_SCRIPT.replace("__REPO__", repo))
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        # Process 1 exits almost immediately after FIRST_OK; the survivor
+        # must be dead well within this window (10s heartbeat timeout +
+        # polling slack). A hang here is THE failure this test guards.
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("survivor hung in the collective after peer loss — "
+                    "the coordination-service bound did not fire")
+    (rc0, out0, err0), (rc1, out1, err1) = outs
+    if "FIRST_OK" not in out0 or "FIRST_OK" not in out1:
+        pytest.skip("jax.distributed CPU rendezvous/collectives "
+                    f"unsupported here: rc0={rc0} rc1={rc1}\n{err0[-500:]}")
+    assert rc1 == 31, f"peer should have died by design: rc={rc1}"
+    assert "SURVIVOR_ENTERING" in out0
+    assert "SURVIVOR_UNEXPECTED_COMPLETION" not in out0
+    assert rc0 not in (0, None), "survivor must fail, not succeed"
+    crisp = ("task" in err0.lower() and "died" in err0.lower()) or \
+        "unhealthy" in err0.lower() or "heartbeat" in err0.lower()
+    assert crisp, f"no crisp peer-loss error in stderr:\n{err0[-800:]}"
 
 
 def test_jax_distributed_two_process_smoke(tmp_path):

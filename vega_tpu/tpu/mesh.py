@@ -25,7 +25,8 @@ _default_mesh: Optional[Mesh] = None
 
 def init_multihost(coordinator: Optional[str] = None,
                    num_processes: Optional[int] = None,
-                   process_id: Optional[int] = None) -> None:
+                   process_id: Optional[int] = None,
+                   heartbeat_timeout_s: Optional[int] = None) -> None:
     """Join a multi-host device mesh via jax.distributed.
 
     The DCN analogue of the reference's multi-host deployment
@@ -38,7 +39,17 @@ def init_multihost(coordinator: Optional[str] = None,
 
     Args default from the standard env vars (JAX_COORDINATOR_ADDRESS /
     JAX_NUM_PROCESSES / JAX_PROCESS_ID) or the TPU metadata service.
-    """
+
+    Failure semantics (peer loss): a process that dies mid-pipeline stops
+    heartbeating; the jax.distributed coordination service detects this
+    within heartbeat_timeout_s (jax default 100s) and TERMINATES every
+    surviving process with a fatal "another task died" error — a crisp,
+    bounded failure instead of survivors hanging forever inside a
+    collective that can no longer complete (the SPMD analogue of the
+    reference's executor-loss detection,
+    distributed_scheduler.rs:434-445; tested in
+    tests/test_multihost.py::test_multihost_dense_peer_loss_fails_crisply).
+    Lower heartbeat_timeout_s to tighten the bound."""
     coordinator, num_processes, process_id = _normalize_multihost(
         coordinator, num_processes, process_id)
     kwargs = {}
@@ -48,13 +59,17 @@ def init_multihost(coordinator: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+    if heartbeat_timeout_s is not None:
+        kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_s
     jax.distributed.initialize(**kwargs)
     set_default_mesh(None)  # rebuild over the now-global device set
-    global _multihost_settings
+    global _multihost_settings, _multihost_heartbeat_s
     _multihost_settings = (coordinator, num_processes, process_id)
+    _multihost_heartbeat_s = heartbeat_timeout_s
 
 
 _multihost_settings: Optional[tuple] = None  # set once per process
+_multihost_heartbeat_s: Optional[int] = None  # the timeout actually applied
 
 
 def _normalize_multihost(coordinator, num_processes, process_id) -> tuple:
@@ -74,7 +89,8 @@ def _normalize_multihost(coordinator, num_processes, process_id) -> tuple:
 
 def ensure_multihost(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     heartbeat_timeout_s: Optional[int] = None) -> None:
     """Idempotent init_multihost: jax.distributed.initialize raises on a
     second call, but a process may legitimately build several successive
     Contexts (stop() then a new one) against the SAME global mesh. Asking
@@ -92,8 +108,20 @@ def ensure_multihost(coordinator: Optional[str] = None,
                 f"{requested} cannot re-rendezvous (jax.distributed "
                 "initializes once per process)"
             )
+        if heartbeat_timeout_s is not None \
+                and heartbeat_timeout_s != _multihost_heartbeat_s:
+            from vega_tpu.errors import VegaError
+
+            raise VegaError(
+                "this process already joined its jax.distributed mesh "
+                f"with heartbeat_timeout_s={_multihost_heartbeat_s}; "
+                f"requesting {heartbeat_timeout_s} cannot be honored "
+                "(the coordination service is configured once per "
+                "process)"
+            )
         return
-    init_multihost(coordinator, num_processes, process_id)
+    init_multihost(coordinator, num_processes, process_id,
+                   heartbeat_timeout_s=heartbeat_timeout_s)
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
