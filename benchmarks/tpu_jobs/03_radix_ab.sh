@@ -1,8 +1,10 @@
 #!/bin/bash
-# Radix-vs-lax.sort A/B on the real chip: the warm reduce pipeline and
-# per-stage sort timings under dense_sort_impl=radix (Pallas digit
-# histogram + 256-bin rank kernels) vs the default lax.sort. Decides
-# whether the radix path becomes the default for int32/float32/wide keys.
+# Sort-impl A/B on the real chip: the warm reduce pipeline and per-stage
+# sort timings under dense_sort_impl=radix/radix4 (Pallas digit
+# histogram + 256-bin rank kernels), packed (single-operand 63-bit word
+# sort — 3.8x on CPU, unmeasured on TPU), and xla (lax.sort comparator
+# network, the current TPU default). Decides what "auto" resolves to on
+# TPU for int32/float32/wide keys.
 cd /root/repo
 echo "=== radix (8-bit) impl ==="
 VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_SORT_IMPL=radix \
@@ -10,5 +12,9 @@ VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_SORT_IMPL=radix \
 echo "=== radix4 (4-bit) impl ==="
 VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_SORT_IMPL=radix4 \
   timeout -k 10 900 python benchmarks/plan_ab.py 20000000
+echo "=== packed impl ==="
+VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_SORT_IMPL=packed \
+  timeout -k 10 900 python benchmarks/plan_ab.py 20000000
 echo "=== xla impl ==="
-VEGA_PLAN_AB_TPU=1 exec python benchmarks/plan_ab.py 20000000
+VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_SORT_IMPL=xla \
+  exec python benchmarks/plan_ab.py 20000000

@@ -1857,14 +1857,16 @@ def test_rbk_plan_with_pallas_partition_ranks(dctx, monkeypatch):
         Env.get().conf.dense_rbk_plan = old
 
 
-def test_dense_sort_impl_radix_parity(dctx):
-    """dense_sort_impl='radix' computes identical results through the
-    whole dense surface: sort_by_key (asc/desc), reduce_by_key (both
+@pytest.mark.parametrize("impl", ["radix", "packed"])
+def test_dense_sort_impl_radix_parity(dctx, impl):
+    """Alternative dense_sort_impls ('radix' LSD digits; 'packed'
+    single-operand 63-bit word sort) compute identical results through
+    the whole dense surface: sort_by_key (asc/desc), reduce_by_key (both
     plans), group_by_key, and int64 wide keys."""
     from vega_tpu.env import Env
 
     old = Env.get().conf.dense_sort_impl
-    Env.get().conf.dense_sort_impl = "radix"
+    Env.get().conf.dense_sort_impl = impl
     try:
         n = 20_000
         kv = dctx.dense_range(n).map(lambda x: ((x * 2654435761) % n, x))

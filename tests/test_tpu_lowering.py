@@ -305,7 +305,8 @@ def test_lowering_real_pipeline_programs(monkeypatch):
     old = (conf.dense_rbk_plan, conf.dense_sort_impl)
     try:
         for plan, impl in (("fused_sort", "xla"),
-                           ("sort_partition", "radix")):
+                           ("sort_partition", "radix"),
+                           ("sort_partition", "packed")):
             conf.dense_rbk_plan, conf.dense_sort_impl = plan, impl
             kv = ctx.dense_range(20_000).map(lambda x: (x % 211, x * 1.0))
             red = kv.reduce_by_key(op="add")

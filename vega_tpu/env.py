@@ -101,13 +101,19 @@ class Configuration:
     # bench must not gamble on a plan with no on-chip measurement.
     dense_rbk_plan: str = "auto"
     # Key-sort implementation inside exchange programs: "xla" = lax.sort
-    # comparator network; "radix" / "radix4" = LSD radix over
-    # orderable-uint32 words (8-bit digits / 4 passes per word, or 4-bit
-    # digits / 8 passes with 16x less per-tile kernel unroll;
-    # Pallas-streamed histogram + rank kernels on TPU) for
-    # int32/float32/wide-int64 keys — other dtypes keep lax.sort. A/B on
-    # hardware: benchmarks/tpu_jobs/03_radix_ab.sh.
-    dense_sort_impl: str = "xla"
+    # comparator network; "packed" = (key, perm) packed into one 63-bit
+    # word so the sort is XLA's fast SINGLE-operand case (its
+    # multi-operand sort is 4-8x slower at bench shapes on CPU);
+    # "radix" / "radix4" = LSD radix over orderable-uint32 words (8-bit
+    # digits / 4 passes per word, or 4-bit digits / 8 passes with 16x
+    # less per-tile kernel unroll; Pallas-streamed histogram + rank
+    # kernels on TPU) for int32/float32/wide-int64 keys — other dtypes
+    # keep lax.sort. "auto" (round-5 default) resolves per backend:
+    # packed on CPU (measured 3.8x on the dominant reduce sort at the 5M
+    # bench shape — docs/BENCH_NOTES.md round 5), xla on TPU until the
+    # queued on-chip A/B (benchmarks/tpu_jobs/03_radix_ab.sh, which
+    # also measures packed) decides.
+    dense_sort_impl: str = "auto"
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":

@@ -1418,8 +1418,8 @@ class DenseRDD(RDD):
         # order IS int64 order in place.
         k = min(max(n, 1), blk.capacity)
         impl = _sort_impl()
-        # radix needs every column as an orderable-uint32 word
-        use_radix = impl.startswith("radix") and all(
+        # radix/packed need every column as an orderable-uint32 word
+        use_radix = impl in ("radix", "radix4", "packed") and all(
             jnp.dtype(dt) in (jnp.dtype(jnp.int32), jnp.dtype(jnp.float32))
             for _, dt in self._schema())
 
@@ -1427,9 +1427,13 @@ class DenseRDD(RDD):
             count = counts[0]
             # LSD = last schema column
             words = kernels.orderable_words(list(reversed(cols)))
-            order = kernels.radix_sort_perm(
-                words, count, descending=largest,
-                bits=4 if impl == "radix4" else 8)
+            if impl == "packed":
+                order = kernels.packed_sort_perm(words, count,
+                                                 descending=largest)
+            else:
+                order = kernels.radix_sort_perm(
+                    words, count, descending=largest,
+                    bits=4 if impl == "radix4" else 8)
             n_valid = jnp.minimum(count, k).reshape(1)
             # original (unflipped) values, gathered once
             return (n_valid,) + tuple(jnp.take(c, order[:k]) for c in cols)
@@ -2553,19 +2557,12 @@ def _chain_fp(chain) -> tuple:
 
 
 def _sort_impl() -> str:
-    """Configuration.dense_sort_impl, validated. 'radix' routes the key
-    sorts in the exchange programs (sort_by_column + the reduce-side
-    merge sorts) through the LSD radix path — Pallas-streamed passes on
-    TPU instead of lax.sort. Read at trace time; callers put the value
-    in their program-cache keys."""
-    from vega_tpu.env import Env
-
-    impl = getattr(Env.get().conf, "dense_sort_impl", "xla")
-    if impl not in ("xla", "radix", "radix4"):
-        raise VegaError(
-            "dense_sort_impl must be 'xla', 'radix' (8-bit digits) or "
-            f"'radix4' (4-bit digits), got {impl!r}")
-    return impl
+    """kernels.resolve_sort_impl — 'radix' routes the key sorts in the
+    exchange programs through the LSD radix path (Pallas-streamed passes
+    on TPU), 'packed' packs (key, perm) into one 63-bit word for XLA's
+    fast single-operand sort, 'auto' resolves per backend from measured
+    evidence (env.py dense_sort_impl note)."""
+    return kernels.resolve_sort_impl()
 
 
 def _bucket_cols(cols, n: int) -> jax.Array:

@@ -170,7 +170,18 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
                 dst = jnp.zeros((capacity,) + col.shape[1:], col.dtype)
                 grouped[name] = dst.at[pos].set(col, mode="drop")
             return grouped, counts_to, starts
-    order = jnp.argsort(bucket, stable=True)
+    # Escape hatch (>64 buckets, or low-memory without the Pallas path).
+    # Honors dense_sort_impl: 'packed' (and CPU 'auto') takes the
+    # single-operand packed sort by bucket — same stable order as the
+    # argsort at a fraction of the comparator cost; anything else keeps
+    # the argsort so a pinned 'xla' (the unmeasured-on-chip-packed TPU
+    # default) never executes packed code. Every row participates;
+    # padding rows carry bucket == n_shards and sort last by value.
+    if resolve_sort_impl() == "packed":
+        order = packed_sort_perm(orderable_words([bucket]),
+                                 jnp.int32(bucket.shape[0]))
+    else:
+        order = jnp.argsort(bucket, stable=True)
     return gather_rows(cols, order), counts_to, starts
 
 
@@ -208,6 +219,17 @@ def bucket_key_sort(cols: Cols, count: jax.Array, bucket: jax.Array,
         word_bits.append(8)
         order = radix_sort_perm(words, count, bits=4 if impl == "radix4"
                                 else 8, word_bits=word_bits)
+        out = gather_rows(cols, order)
+        return out, jnp.take(bucket, order)
+    if impl == "packed" and (lo_name is not None or _radix_supported(key)):
+        # LSD packed passes: key word(s) then the bucket as the most
+        # significant word — one fast single-operand sort per word
+        # instead of one slow multi-operand comparator sort.
+        key_cols = ([cols[lo_name], key] if lo_name is not None
+                    else [key])
+        words = orderable_words(key_cols)
+        words.append(_orderable_u32(bucket, False))
+        order = packed_sort_perm(words, count)
         out = gather_rows(cols, order)
         return out, jnp.take(bucket, order)
     perm_src = lax.iota(jnp.int32, capacity)
@@ -305,6 +327,68 @@ def orderable_words(cols) -> list:
 
 def _radix_supported(key: jax.Array) -> bool:
     return key.dtype in (jnp.dtype(jnp.int32), jnp.dtype(jnp.float32))
+
+
+def resolve_sort_impl() -> str:
+    """Configuration.dense_sort_impl, validated and with 'auto' resolved
+    per backend (packed on CPU — measured 3.8x on the dominant sort at
+    bench shapes; xla on TPU until the queued on-chip A/B decides, see
+    env.py). Read at trace time; callers put the resolved value in their
+    program-cache keys. Lives here (not dense_rdd) so kernel-internal
+    sort choices honor the same setting."""
+    from vega_tpu.env import Env
+    from vega_tpu.errors import VegaError
+
+    impl = getattr(Env.get().conf, "dense_sort_impl", "auto")
+    if impl not in ("auto", "xla", "packed", "radix", "radix4"):
+        raise VegaError(
+            "dense_sort_impl must be 'auto', 'xla', 'packed', 'radix' "
+            f"(8-bit digits) or 'radix4' (4-bit digits), got {impl!r}")
+    if impl == "auto":
+        impl = "packed" if jax.default_backend() == "cpu" else "xla"
+    return impl
+
+
+def packed_sort_perm(words, count: jax.Array,
+                     descending: bool = False) -> jax.Array:
+    """Stable sort permutation over orderable-uint32 words via
+    SINGLE-OPERAND int64 sorts of (word << 31 | position).
+
+    XLA:CPU's multi-operand comparator sort is 4-8x slower than its
+    single-operand sort at bench shapes (5M rows: sort_key_val 2.01s,
+    3-operand 2.69s, packed 0.53s — docs/BENCH_NOTES.md round 5), so
+    packing the key and the permutation into one 63-bit word turns the
+    sort+permutation problem into the fast single-column case. The
+    position in the low 31 bits is also the stability tie-break. Words
+    are LSD-first like radix_sort_perm (wide int64 keys: [lo, hi]);
+    multi-word keys run one stable packed pass per word. Invalid rows
+    (position >= count) sort last (their word is forced to the max;
+    among max-ties the position tie-break keeps valid rows - which
+    always occupy lower positions - in front). int64 exists only inside
+    the scoped enable_x64 (the block dtype contract stays 32-bit).
+
+    Requires capacity < 2^31 (position must fit 31 bits) — HBM bounds
+    any real shard far below that."""
+    capacity = words[0].shape[0]
+    if capacity >= (1 << 31):
+        raise ValueError("packed_sort_perm: capacity must fit 31 bits")
+    mask = valid_mask(capacity, count)
+    order = None
+    with jax.enable_x64():
+        idx0 = lax.iota(jnp.int64, capacity)
+        for w in words:  # LSD -> MSD: one stable pass per word
+            if descending:
+                w = ~w
+            w = jnp.where(mask, w, jnp.uint32(0xFFFFFFFF))
+            if order is not None:
+                w = jnp.take(w, order, axis=0)
+            packed = (lax.convert_element_type(w, jnp.int64)
+                      << jnp.int64(31)) | idx0
+            sw = lax.sort(packed)
+            pos = lax.convert_element_type(sw & jnp.int64(0x7FFFFFFF),
+                                           jnp.int32)
+            order = pos if order is None else jnp.take(order, pos, axis=0)
+    return order
 
 
 def partition_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
@@ -437,18 +521,23 @@ def sort_by_column(cols: Cols, count: jax.Array, key_name: str,
     int64 key when lo_name is given); invalid rows sink to the end.
     impl='radix' (Configuration.dense_sort_impl) uses the LSD radix path
     for int32/float32/wide keys — Pallas-streamed passes on TPU instead
-    of lax.sort's comparator network; unsupported dtypes keep lax.sort."""
+    of lax.sort's comparator network; impl='packed' packs (key, perm)
+    into one 63-bit word so the sort is XLA's fast single-operand case
+    (packed_sort_perm). Unsupported dtypes keep lax.sort."""
     key = cols[key_name]
-    if impl.startswith("radix") and (lo_name is not None
-                                     or _radix_supported(key)):
+    if impl in ("radix", "radix4", "packed") and (
+            lo_name is not None or _radix_supported(key)):
         if lo_name is not None:
             # wide int64: stored lo's signed order == true-lo unsigned
             # order, so the plain int transform applies to both words
             words = orderable_words([cols[lo_name], key])
         else:
             words = orderable_words([key])
-        order = radix_sort_perm(words, count, descending,
-                                bits=4 if impl == "radix4" else 8)
+        if impl == "packed":
+            order = packed_sort_perm(words, count, descending)
+        else:
+            order = radix_sort_perm(words, count, descending,
+                                    bits=4 if impl == "radix4" else 8)
         return gather_rows(cols, order)
     capacity = key.shape[0]
     mask = valid_mask(capacity, count)

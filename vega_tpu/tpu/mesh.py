@@ -65,11 +65,28 @@ def init_multihost(coordinator: Optional[str] = None,
     set_default_mesh(None)  # rebuild over the now-global device set
     global _multihost_settings, _multihost_heartbeat_s
     _multihost_settings = (coordinator, num_processes, process_id)
-    _multihost_heartbeat_s = heartbeat_timeout_s
+    # Record the EFFECTIVE timeout (jax's own default when none was
+    # passed) so a later Context explicitly requesting that same value
+    # is recognized as compatible, not spuriously rejected.
+    _multihost_heartbeat_s = (heartbeat_timeout_s
+                              if heartbeat_timeout_s is not None
+                              else _jax_default_heartbeat_s())
 
 
 _multihost_settings: Optional[tuple] = None  # set once per process
 _multihost_heartbeat_s: Optional[int] = None  # the timeout actually applied
+
+
+def _jax_default_heartbeat_s() -> Optional[int]:
+    """jax.distributed.initialize's own heartbeat_timeout_seconds
+    default, read from its signature (100 in jax 0.9)."""
+    import inspect
+
+    try:
+        p = inspect.signature(jax.distributed.initialize).parameters
+        return p["heartbeat_timeout_seconds"].default
+    except (KeyError, ValueError, TypeError):
+        return None
 
 
 def _normalize_multihost(coordinator, num_processes, process_id) -> tuple:
