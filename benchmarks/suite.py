@@ -115,10 +115,12 @@ def config3_parquet_count(ctx, scale, bank=None):
         import pyarrow.parquet as pq
         import glob as g
 
-        cols = pq.read_table(g.glob(os.path.join(path, "*.parquet"))[0],
-                             columns=["word_id"]).to_pydict()
+        # Columnar all the way: arrow -> numpy -> device put. (to_pydict
+        # materialized 2M Python ints and dominated the measured leg.)
+        col = pq.read_table(g.glob(os.path.join(path, "*.parquet"))[0],
+                            columns=["word_id"]).column("word_id")
         rdd = ctx.dense_from_columns(
-            {"word_id": np.asarray(cols["word_id"], dtype=np.int32)},
+            {"word_id": col.to_numpy().astype(np.int32, copy=False)},
             key="word_id")
         return dict(rdd.count_by_key_dense().collect())
 
