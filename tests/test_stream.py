@@ -333,3 +333,22 @@ def test_streamed_take_ordered_and_top(ctx):
         assert big.top(3) == [59_999, 59_998, 59_997]
     finally:
         Env.get().conf.dense_hbm_budget = old
+
+
+def test_streamed_accumulator_capacity_bounded(ctx):
+    """The per-chunk merge reduce must NOT inherit cap(acc)+cap(chunk):
+    capacity-sum union sizing doubled the accumulator every chunk at
+    constant key count (16->32->64->128 MiB at 1M keys — round-5
+    stream_1b profiling; 7.6x wall-clock once fixed). With counts-known
+    sizing the accumulator capacity stays at the key-bounded rounding
+    bucket however many chunks fold in."""
+    from vega_tpu.tpu.stream import streamed_range
+
+    s = streamed_range(ctx, 80_000, chunk_rows=10_000)  # 8 chunks
+    red = s.map(lambda x: (x % 1_000, x)).reduce_by_key(op="add")
+    # 1000 keys over the 8-shard mesh: ~125 rows/shard. Geometric growth
+    # across 8 chunks would leave this orders of magnitude larger.
+    assert red._block is not None
+    assert red._block.capacity <= 2048, red._block.capacity
+    got = dict(red.collect())
+    assert got[0] == sum(x for x in range(80_000) if x % 1_000 == 0)

@@ -4188,7 +4188,25 @@ class _DenseUnionRDD(DenseRDD):
         a = self.first.block()
         b = self.second.block()
         names = [n for n, _ in self._schema()]
-        out_cap = block_lib._round_capacity(a.capacity + b.capacity)
+        concat_cap = a.capacity + b.capacity
+        # Size the output from VALID counts when both sides already know
+        # them on host (block() settled them; no fetch here, ever) —
+        # capacity-sum sizing made the streamed reduce's accumulator
+        # union grow its capacity geometrically: each chunk's elided
+        # merge inherited cap(acc)+cap(partial), so the accumulator
+        # DOUBLED per chunk at constant key count (16->32->64->128 MiB
+        # at 1M keys; round-5 stream_1b profiling). Known counts also
+        # ride out on the Block so downstream elided exchanges
+        # (_elide_out_cap) size tightly instead of falling back to
+        # capacity.
+        counts_host = None
+        if a.counts_host is not None and b.counts_host is not None:
+            counts_host = (np.asarray(a.counts_host)
+                           + np.asarray(b.counts_host))
+            out_cap = block_lib._round_capacity(
+                max(int(counts_host.max()), 1))
+        else:
+            out_cap = block_lib._round_capacity(concat_cap)
         cap_a = a.capacity  # plain int: the closure must not pin the Block
 
         def shard_concat(ac, bc, *cols):
@@ -4196,17 +4214,12 @@ class _DenseUnionRDD(DenseRDD):
             a_cols = dict(zip(names, cols[:half]))
             b_cols = dict(zip(names, cols[half:]))
             a_count, b_count = ac[0], bc[0]
-            out = {}
-            for name in names:
-                col_a, col_b = a_cols[name], b_cols[name]
-                pad = out_cap - col_a.shape[0] - col_b.shape[0]
-                merged = jnp.concatenate([
-                    col_a, col_b,
-                    jnp.zeros((pad,) + col_a.shape[1:], col_a.dtype),
-                ])
-                out[name] = merged
+            # Concatenate at full width, then compact into the (possibly
+            # smaller, counts-sized) output capacity.
+            out = {name: jnp.concatenate([a_cols[name], b_cols[name]])
+                   for name in names}
             # mark validity: rows [0,a_count) and [cap_a, cap_a+b_count)
-            idx = lax.iota(jnp.int32, out_cap)
+            idx = lax.iota(jnp.int32, concat_cap)
             keep = (idx < a_count) | (
                 (idx >= cap_a) & (idx < cap_a + b_count)
             )
@@ -4228,7 +4241,8 @@ class _DenseUnionRDD(DenseRDD):
                     *[a.cols[n] for n in names], *[b.cols[n] for n in names])
         counts, col_arrays = outs[0], outs[1:]
         return Block(cols=dict(zip(names, col_arrays)), counts=counts,
-                     capacity=out_cap, mesh=self.mesh)
+                     capacity=out_cap, mesh=self.mesh,
+                     counts_host=counts_host)
 
 
 def _infer_named_op(func) -> Optional[str]:
