@@ -267,6 +267,7 @@ _MULTIHOST_COVERAGE_SCRIPT = textwrap.dedent("""
     signal.alarm(300)  # divergence hangs in a collective: die loudly
 
     import jax
+    import numpy as np
 
     import vega_tpu as v
     from vega_tpu.env import Env
@@ -303,6 +304,17 @@ _MULTIHOST_COVERAGE_SCRIPT = textwrap.dedent("""
         sgot = dict(red.collect())
         assert sgot[7] == sum(x % 97 for x in range(60_000)
                               if x % 41 == 7)
+
+        # Device cartesian over the global mesh (right side replicates to
+        # every shard; the product never leaves the device tier).
+        ca = ctx.dense_range(3_000)
+        cb = ctx.dense_from_numpy(
+            (np.arange(4) + 1).astype(np.int32))
+        prod = ca.cartesian(cb)
+        assert prod.count() == 12_000
+        csum = prod.map(lambda p: p[0] * p[1]).sum()
+        assert csum == sum(x * y for x in range(3_000)
+                           for y in (1, 2, 3, 4))
 
         # Adversarial eviction determinism under ASYMMETRIC GC: process 0
         # hides nodes in reference cycles and collects them at a time of
