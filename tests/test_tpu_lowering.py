@@ -308,11 +308,27 @@ def test_lowering_real_pipeline_programs(monkeypatch):
                            ("sort_partition", "radix"),
                            ("sort_partition", "packed")):
             conf.dense_rbk_plan, conf.dense_sort_impl = plan, impl
-            kv = ctx.dense_range(20_000).map(lambda x: (x % 211, x * 1.0))
-            red = kv.reduce_by_key(op="add")
+            # A range hint banked by the previous config would send this
+            # config's cold reduce to the table plan — which ignores
+            # plan/impl — so the standard program under test would never
+            # compile (round-5 review finding). Capacity hints likewise.
+            ctx.__dict__.get("_dense_key_range_hints", {}).clear()
+            ctx.__dict__.get("_dense_capacity_hints", {}).clear()
+
+            def reduce_once():
+                kv = ctx.dense_range(20_000).map(
+                    lambda x: (x % 211, x * 1.0))
+                return kv, kv.reduce_by_key(op="add")
+
+            kv, red = reduce_once()
             table = ctx.dense_from_numpy(np.arange(211, dtype=np.int32),
                                          np.arange(211, dtype=np.float32))
             assert red.join(table).count() == 211
+            # Warm rerun: the speculative dense-key TABLE plan program
+            # (scatter table + psum + hash-mask compact) must lower too.
+            _, red_warm = reduce_once()
+            assert dict(red_warm.collect())
+            assert red_warm._table_plan is True
             assert len(kv.sort_by_key(ascending=False).take(5)) == 5
             kv.group_by_key().collect_grouped()
             assert len(kv.take_ordered(5)) == 5

@@ -3288,6 +3288,134 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             plan = ("sort_partition" if jax.default_backend() == "cpu"
                     else "fused_sort")
 
+        # ---- speculative dense-key TABLE plan (round 5) --------------
+        # When a prior run of this lineage+sizes OBSERVED a small key
+        # range [kmin, kmax] (learned for free off the standard
+        # program's output keys, riding the counts fetch), the whole
+        # reduce collapses to a per-shard scatter into a dense table +
+        # ONE psum + a per-shard hash-mask compact: no sort, no row
+        # exchange, and the output arrives hash-placed AND key-sorted.
+        # Entirely speculative and SOUND (CLAUDE.md: no value probing
+        # may select a fast path unguarded): the program flags any valid
+        # key outside the hinted range — checked on the raw key values,
+        # never via wrap-prone subtraction — or an output-capacity
+        # overflow, and a set flag settles through the normal
+        # _settle_pending repair, which re-runs under _dense_no_defer
+        # where this plan is gated off. Gated to named add/min/max over
+        # ONE narrow 32-bit value column with a single int32 key.
+        schema_d = dict(self._schema())
+        vname = (self._value_names[0]
+                 if len(self._value_names) == 1 else None)
+        learn_range = (
+            self._op in ("add", "min", "max") and vname is not None
+            and not track_sovf and KEY_LO not in schema_d
+            and jnp.dtype(schema_d[vname]) in (jnp.dtype(jnp.int32),
+                                               jnp.dtype(jnp.float32)))
+        range_hints = self.context.__dict__.setdefault(
+            "_dense_key_range_hints", {})
+        table_range = None
+        if learn_range and not elide \
+                and not self.context.__dict__.get("_dense_no_defer"):
+            rh = range_hints.get(self._hint_key())
+            if rh is not None:
+                kmin_h, kmax_h = rh
+                # Bucket the range so drifting hints (streamed chunks
+                # whose keys slide run to run) reuse one compiled
+                # program instead of minting a fresh _PROGRAM_CACHE
+                # entry per observed range: align kmin down to 4K and
+                # round the spread to a capacity bucket. A WIDER table
+                # is trivially sound — extra slots end with cnt==0 and
+                # emit nothing — and the range check covers the widened
+                # bounds, so it only gets laxer, never wrong.
+                kmin_b = (int(kmin_h) >> 12) << 12  # floor, sign-safe
+                spread_b = block_lib._round_capacity(
+                    int(kmax_h) - kmin_b + 1)
+                # Table work is O(spread) per shard (+ an O(spread)
+                # psum): require it comfortably under the input size and
+                # an absolute cap (32 MB of table+counts per shard).
+                if 0 < spread_b <= min(1 << 22, 2 * blk.capacity * n) \
+                        and kmin_b + spread_b - 1 <= np.iinfo(np.int32).max:
+                    table_range = (kmin_b, spread_b)
+
+        if table_range is not None:
+            kmin_c, spread = table_range
+            op = self._op
+            vdt = jnp.dtype(schema_d[vname])
+            out_cap_t = block_lib._round_capacity(
+                min(spread, int(spread / max(n, 1) * 1.3) + 128))
+
+            def table_prog(counts, *col_arrays):
+                cols = dict(zip(in_names, col_arrays))
+                cols, count = _apply_chain(chain, cols, counts[0])
+                keys = cols[KEY]
+                vals = cols[vname]
+                vdt_t = vals.dtype  # trace-time truth, never closure bake
+                cap = keys.shape[0]
+                maskv = kernels.valid_mask(cap, count)
+                in_rng = ((keys >= jnp.int32(kmin_c))
+                          & (keys <= jnp.int32(kmin_c + spread - 1)))
+                bad = jnp.any(maskv & ~in_rng)
+                ok = maskv & in_rng
+                # Dropped rows (invalid or out-of-range) scatter to the
+                # out-of-bounds slot `spread`, which mode="drop" ignores.
+                idx = jnp.where(ok, keys - jnp.int32(kmin_c),
+                                jnp.int32(spread))
+                if op == "add":
+                    tbl = jnp.zeros((spread,), vdt_t)
+                    tbl = tbl.at[idx].add(vals, mode="drop")
+                elif op == "min":
+                    init = (jnp.inf if vdt_t == jnp.dtype(jnp.float32)
+                            else jnp.iinfo(jnp.int32).max)
+                    tbl = jnp.full((spread,), init, vdt_t)
+                    tbl = tbl.at[idx].min(vals, mode="drop")
+                else:
+                    init = (-jnp.inf if vdt_t == jnp.dtype(jnp.float32)
+                            else jnp.iinfo(jnp.int32).min)
+                    tbl = jnp.full((spread,), init, vdt_t)
+                    tbl = tbl.at[idx].max(vals, mode="drop")
+                cnt = jnp.zeros((spread,), jnp.int32)
+                cnt = cnt.at[idx].add(1, mode="drop")
+                tbl = jax.lax.psum(tbl, mesh_lib.SHARD_AXIS)
+                cnt = jax.lax.psum(cnt, mesh_lib.SHARD_AXIS)
+                keys_all = jnp.int32(kmin_c) + lax.iota(jnp.int32, spread)
+                me = jax.lax.axis_index(mesh_lib.SHARD_AXIS)
+                mine = ((_bucket_cols({KEY: keys_all}, n) == me)
+                        & (cnt > 0))  # absent keys must not emit rows
+                out, out_count = kernels.compact(
+                    {KEY: keys_all, vname: tbl}, mine, out_cap_t)
+                overflow = bad | (out_count > jnp.int32(out_cap_t))
+                return (out_count.reshape(1), out[KEY], out[vname],
+                        overflow.reshape(1).astype(jnp.int32))
+
+            prog = _cached_program(
+                ("rbk_table", self.mesh, tuple(in_names), vname,
+                 str(vdt), _chain_fp(chain), n, out_cap_t, kmin_c,
+                 spread, op),
+                lambda: _shard_program(self.mesh, table_prog,
+                                       1 + len(in_names), (_SPEC,) * 4),
+            )
+            # The gate guarantees _dense_no_defer is off, so this is
+            # exactly _run_exchange's deferred fixed-caps launch — bus
+            # events, the pending entry, and settlement/repair all ride
+            # the shared choreography (a failed flag repairs through the
+            # standard plan: the rerun holds _dense_no_defer).
+            self._fetch_extra_outs = 0
+            self._elided = False
+            self._table_plan = True  # observability/tests
+            outs_t, _ = self._run_exchange(
+                lambda slot, cap: (
+                    prog, (blk.counts,
+                           *[blk.cols[nm] for nm in in_names])),
+                lambda: blk.counts_np,
+                fixed_caps=(0, out_cap_t),
+            )
+            t_counts, t_keys, t_vals = outs_t
+            return self._attach_pending(Block(
+                cols={KEY: t_keys, vname: t_vals}, counts=t_counts,
+                capacity=out_cap_t, mesh=self.mesh,
+                counts_host=self._last_counts_host))
+        self._table_plan = False
+
         def build(slot, out_cap):
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(in_names, col_arrays))
@@ -3362,6 +3490,19 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     m = kernels.valid_mask(cols[_SOVF].shape[0], count)
                     sovf = jnp.any(jnp.where(m, cols[_SOVF], 0) != 0)
                     res += (sovf.reshape(1).astype(jnp.int32),)
+                if learn_range:
+                    # Observed key range of the OUTPUT (same min/max as
+                    # the input keys), riding the counts fetch for free:
+                    # feeds the table plan's hint for the next warm run.
+                    mo = kernels.valid_mask(cols[KEY].shape[0], count)
+                    res += (
+                        jnp.min(jnp.where(
+                            mo, cols[KEY],
+                            jnp.iinfo(jnp.int32).max)).reshape(1),
+                        jnp.max(jnp.where(
+                            mo, cols[KEY],
+                            jnp.iinfo(jnp.int32).min)).reshape(1),
+                    )
                 return res + tuple(
                     cols[nm] for nm in names
                 ) + (overflow.reshape(1),)
@@ -3369,12 +3510,13 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             key = ("rbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide, elide_sorted,
                    self.exchange_mode, self._op or _fp(self._func),
-                   track_sovf, plan, sort_impl)
+                   track_sovf, learn_range, plan, sort_impl)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
                     self.mesh, prog_fn, 1 + len(in_names),
-                    (_SPEC,) * (2 + track_sovf + len(names)),
+                    (_SPEC,) * (2 + track_sovf + 2 * learn_range
+                                + len(names)),
                 ),
             )
             return prog, (blk.counts, *[blk.cols[nm] for nm in in_names])
@@ -3384,17 +3526,36 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         # counts are already host-known, else the parent's capacity —
         # never a fetch. Slot is unused by the passthrough.
         self._elided = elide
-        if track_sovf:
-            # sovf rides the (counts, overflow) transfer; deferred
-            # launches re-check it at settlement via validate.
-            self._fetch_extra_outs = 1
+        # sovf / learned-key-range ride the (counts, overflow) transfer;
+        # deferred launches re-check sovf at settlement via validate.
+        extra_n = (1 if track_sovf else 0) + (2 if learn_range else 0)
+        self._fetch_extra_outs = extra_n
         validate = ((lambda head: not bool(np.any(np.asarray(head[1]))))
                     if track_sovf else None)
+
+        def bank_range(lo_arr, hi_arr):
+            # Per-shard sentinels (empty shards report int32 max/min)
+            # fall out of the global min/max.
+            kmin_o = int(np.asarray(lo_arr).min())
+            kmax_o = int(np.asarray(hi_arr).max())
+            if kmin_o <= kmax_o:
+                hk = self._hint_key()
+                range_hints.pop(hk, None)
+                range_hints[hk] = (kmin_o, kmax_o)
+                while len(range_hints) > 4096:
+                    range_hints.pop(next(iter(range_hints)))
+
+        # Deferred launches bank the range at settlement commit —
+        # without this, an evicted range hint under a live capacity hint
+        # would pay for the two extra outputs forever while the table
+        # plan never re-activates.
+        on_success = ((lambda head: bank_range(head[-2], head[-1]))
+                      if learn_range else None)
         if elide:
             outs, out_cap = self._run_exchange(
                 build, lambda: blk.counts_np,
                 fixed_caps=(0, _elide_out_cap(blk)),
-                validate=validate,
+                validate=validate, on_success=on_success,
             )
         else:
             outs, out_cap = self._run_exchange(
@@ -3402,17 +3563,17 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 make_hists=lambda: ([self._hash_histogram(blk, chain)],
                                     None),
                 hint_key=self._hint_key(),
-                validate=validate,
+                validate=validate, on_success=on_success,
             )
-        if track_sovf:
-            counts, col_arrays = outs[0], outs[2:]
-            extra = self._last_extra_host
-            if extra and np.any(np.asarray(extra[0])):
-                # Blocking path saw the flag inline (the deferred path
-                # reaches here via _settle_pending's repair rerun).
-                return self._host_exact_fold()
-        else:
-            counts, col_arrays = outs[0], outs[1:]
+        counts, col_arrays = outs[0], outs[1 + extra_n:]
+        extra = self._last_extra_host
+        if track_sovf and extra and np.any(np.asarray(extra[0])):
+            # Blocking path saw the flag inline (the deferred path
+            # reaches here via _settle_pending's repair rerun).
+            return self._host_exact_fold()
+        if learn_range and extra is not None and len(extra) >= 2:
+            # Blocking path: bank inline (deferred banks via on_success).
+            bank_range(extra[-2], extra[-1])
         return self._attach_pending(Block(
             cols=dict(zip(names, col_arrays)), counts=counts,
             capacity=out_cap, mesh=self.mesh,
