@@ -448,6 +448,12 @@ def main():
         _phase(f"device warmup ({n_rows:,} rows)")
         warm = device_pipeline(ctx, n_rows, n_keys)
         assert warm == n_keys
+        # Second warmup: speculative plans (the dense-key table reduce)
+        # only activate on the run AFTER their key range was learned, so
+        # one warmup would leave that plan's compile inside rep 1.
+        # (Not inside an assert: python -O must not strip the warmup.)
+        warm2 = device_pipeline(ctx, n_rows, n_keys)
+        assert warm2 == n_keys
         # Median of up to 3 measured reps (deadline-guarded): single-run
         # legs on the 1-core sandbox carry ~±15% noise (round-5 leg
         # attribution, docs/BENCH_NOTES.md) — enough to fake or mask a
