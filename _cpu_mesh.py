@@ -12,7 +12,19 @@ import). Keep this module import-light; it must be safe to import first.
 import os
 import re
 
-COMPILE_CACHE_DIR = "/tmp/vega_tpu_xla_cache"
+# Round-5 forensics: a full-suite SIGSEGV first pointed at the
+# persistent cache's reader, but reproduced with the cache disabled —
+# the crash is in XLA:CPU's compiler itself (backend_compile_and_load)
+# under late-suite process state, and is contained by running the big
+# compile+export sweep in a subprocess (test_tpu_lowering's isolated
+# wrapper). The cache is therefore ON by default (set
+# VEGA_XLA_PERSISTENT_CACHE=0 to disable), but in a PER-BACKEND,
+# versioned dir: contexts compiling under different target configs (the
+# axon TPU bench path) must never share a dir with the CPU mesh — the
+# cpu_aot_loader machine-feature-mismatch warnings come from exactly
+# that kind of sharing.
+COMPILE_CACHE_DIR = "/tmp/vega_tpu_xla_cache_cpu_v2"
+PERSISTENT_CACHE = os.environ.get("VEGA_XLA_PERSISTENT_CACHE", "1") == "1"
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
@@ -38,8 +50,10 @@ def force_cpu_mesh(n_devices: int, assert_count: bool = True) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if PERSISTENT_CACHE:  # per-backend dir; see the module note
+        jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
 
     if assert_count:
         assert jax.default_backend() == "cpu", (

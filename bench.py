@@ -367,10 +367,26 @@ def main():
 
     import jax as _jax
 
-    # Persistent compile cache on every backend: a flaky-tunnel TPU run
-    # that wedges after compiling still seeds the next attempt.
-    _jax.config.update("jax_compilation_cache_dir", "/tmp/vega_tpu_xla_cache")
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Persistent compile cache: a flaky-tunnel TPU run that wedges after
+    # compiling still seeds the next attempt. Dir selection and the
+    # VEGA_XLA_PERSISTENT_CACHE kill switch are shared with _cpu_mesh
+    # (see its module note): contexts compiling under different target
+    # configs must never share a dir — CPU legs (fallback child, or an
+    # explicitly CPU run) use the mesh dir, axon-tunnel runs their own.
+    import _cpu_mesh as _cm
+
+    if _cm.PERSISTENT_CACHE:
+        if on_fallback or os.environ.get("JAX_PLATFORMS") == "cpu":
+            cache_dir = _cm.COMPILE_CACHE_DIR
+        elif os.environ.get("PALLAS_AXON_POOL_IPS"):
+            cache_dir = "/tmp/vega_tpu_xla_cache_axon_v2"
+        else:
+            plat = os.environ.get("JAX_PLATFORMS",
+                                  "device").replace(",", "_")
+            cache_dir = f"/tmp/vega_tpu_xla_cache_{plat}_v2"
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.5)
 
     import vega_tpu as v
 

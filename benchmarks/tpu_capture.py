@@ -51,7 +51,10 @@ def main() -> int:
     say("phase 0: importing jax / device init")
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/vega_tpu_xla_cache")
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/vega_tpu_xla_cache_axon_v2")  # per-backend
+    # dir (see _cpu_mesh.COMPILE_CACHE_DIR note): the legacy shared dir
+    # holds machine-feature-mismatched mixed-backend entries
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     import jax.numpy as jnp
 
