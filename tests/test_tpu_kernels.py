@@ -397,3 +397,50 @@ def test_bucket_key_sort_radix_parity():
                     np.asarray(a_cols[nm])[:count],
                     np.asarray(b_cols[nm])[:count],
                     err_msg=f"{keyset} {impl} {nm}")
+
+
+def test_packed_sort_perm_matches_argsort():
+    """The single-operand packed permutation (round 5) is bit-identical
+    to a stable argsort for int32 (INT32_MIN/MAX included), float32, and
+    wide int64 keys, ascending and descending, with ghost rows sinking
+    last — the same oracle the radix path answers to."""
+    from vega_tpu.tpu import block as block_lib
+
+    rng = np.random.RandomState(11)
+    n, count = 5_000, 4_321
+
+    def run(words, descending):
+        return np.asarray(kernels.packed_sort_perm(
+            [jnp.asarray(w) for w in words], jnp.int32(count), descending))
+
+    ints = rng.randint(-2**31, 2**31 - 1, size=n).astype(np.int32)
+    ints[: n // 4] = rng.randint(-50, 50, size=n // 4)  # dup stability
+    ints[0], ints[1] = np.int32(-2**31), np.int32(2**31 - 1)  # edges
+    u = kernels._orderable_u32(jnp.asarray(ints), False)
+    for desc in (False, True):
+        got = run([u], desc)
+        order = np.argsort(
+            ints[:count] if not desc else -ints[:count].astype(np.int64),
+            kind="stable")
+        np.testing.assert_array_equal(got[:count], order)
+        # invalid rows keep their relative order at the end (stable)
+        assert got[count:].tolist() == list(range(count, n))
+
+    fl = (rng.randn(n) * 100).astype(np.float32)
+    uf = kernels._orderable_u32(jnp.asarray(fl), True)
+    got = run([uf], False)
+    np.testing.assert_array_equal(got[:count],
+                                  np.argsort(fl[:count], kind="stable"))
+
+    big = rng.randint(-2**62, 2**62, size=n).astype(np.int64)
+    hi, lo = block_lib.encode_i64(big)
+    wl = kernels._orderable_u32(jnp.asarray(lo), False)
+    wh = kernels._orderable_u32(jnp.asarray(hi), False)
+    got = run([wl, wh], False)
+    np.testing.assert_array_equal(got[:count],
+                                  np.argsort(big[:count], kind="stable"))
+
+    # empty-valid edge: every row is a ghost, order is the identity
+    got_all_ghost = np.asarray(kernels.packed_sort_perm(
+        [u], jnp.int32(0), False))
+    assert got_all_ghost.tolist() == list(range(n))
