@@ -5,4 +5,13 @@
 # sort_partition. CPU-mesh proxy result (docs/BENCH_NOTES.md round 4):
 # sort_partition ~20% faster end-to-end; sorts dominate the stages.
 cd /root/repo
-VEGA_PLAN_AB_TPU=1 exec python benchmarks/plan_ab.py 20000000
+# The watcher signals THIS shell on timeout; forward it to the whole
+# process group so a mid-leg kill cannot orphan a python holding the
+# scarce chip into the next window.
+trap 'kill 0' TERM INT
+echo "=== table plan (speculative dense-key reduce) ==="
+VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_TABLE_PLAN=on \
+  timeout -k 10 900 python benchmarks/plan_ab.py 20000000
+echo "=== exchange plans (table off) ==="
+VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_TABLE_PLAN=off \
+  exec python benchmarks/plan_ab.py 20000000

@@ -6,6 +6,10 @@
 # network, the current TPU default). Decides what "auto" resolves to on
 # TPU for int32/float32/wide keys.
 cd /root/repo
+# The watcher signals THIS shell on timeout; forward it to the whole
+# process group so a mid-leg kill cannot orphan a python holding the
+# scarce chip into the next window.
+trap 'kill 0' TERM INT
 echo "=== radix (8-bit) impl ==="
 VEGA_PLAN_AB_TPU=1 VEGA_TPU_DENSE_SORT_IMPL=radix \
   timeout -k 10 900 python benchmarks/plan_ab.py 20000000

@@ -206,3 +206,39 @@ def test_hw_radix_sort_parity(hw_ctx):
         assert got == exp
     finally:
         Env.get().conf.dense_sort_impl = old
+
+
+def test_hw_table_plan_parity(hw_ctx):
+    """The speculative dense-key table plan (round 5: scatter table +
+    psum + hash-mask compact) computes the exact answer ON CHIP with
+    dense_table_plan='on' — TPU scatters and the psum collective behave
+    differently from the CPU mesh, and the headline bench will not flip
+    to this plan on TPU until this passes plus the 02_plan_ab table leg
+    measures a win."""
+    from vega_tpu.env import Env
+
+    old = Env.get().conf.dense_table_plan
+    Env.get().conf.dense_table_plan = "on"
+    try:
+        def build():
+            return (hw_ctx.dense_range(150_000)
+                    .map(lambda x: (x % 700, x))
+                    .reduce_by_key(op="add"))
+
+        r1 = build()
+        exp = dict(r1.collect())  # cold: learns the range
+        r2 = build()
+        got = dict(r2.collect())  # warm: table plan on chip
+        assert r2._table_plan is True
+        oracle = {}
+        for x in range(150_000):
+            oracle[x % 700] = oracle.get(x % 700, 0) + x
+        assert got == oracle == exp
+        assert r2.hash_placed and r2.key_sorted
+        # stale-range repair fires on hardware too
+        hints = hw_ctx.__dict__["_dense_key_range_hints"]
+        r3 = build()
+        hints[r3._hint_key()] = (0, 9)
+        assert dict(r3.collect()) == oracle
+    finally:
+        Env.get().conf.dense_table_plan = old

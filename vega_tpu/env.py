@@ -114,6 +114,15 @@ class Configuration:
     # queued on-chip A/B (benchmarks/tpu_jobs/03_radix_ab.sh, which
     # also measures packed) decides.
     dense_sort_impl: str = "auto"
+    # Speculative dense-key table plan for warm named reduces (scatter
+    # table + psum + hash-mask compact; dense_rdd.py). "auto" (default)
+    # activates it on CPU only — measured 3-4x on the bench reduce there
+    # — and keeps TPU on the standard exchange until the queued on-chip
+    # A/B (benchmarks/tpu_jobs/02_plan_ab.sh table leg) decides: the
+    # only hardware number ever captured ran the exchange path, and the
+    # headline bench must not gamble on an unmeasured plan. "on"/"off"
+    # force it per run (the A/B job sets "on").
+    dense_table_plan: str = "auto"
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -123,7 +132,8 @@ class Configuration:
         if env.get(pref + "DEPLOYMENT_MODE"):
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
-                     "DENSE_RBK_PLAN", "DENSE_SORT_IMPL", "HOSTS_FILE"):
+                     "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
+                     "DENSE_TABLE_PLAN", "HOSTS_FILE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

@@ -3273,20 +3273,15 @@ class _ReduceByKeyRDD(_ExchangeRDD):
             block_lib.wide_value_pairs(names))
         from vega_tpu.env import Env as _Env
 
-        plan = getattr(_Env.get().conf, "dense_rbk_plan", "auto")
-        if plan not in ("auto", "fused_sort", "sort_partition"):
-            # A typo'd plan silently running fused_sort would corrupt an
-            # A/B (a scarce tunnel-window job measuring fused vs fused).
-            raise VegaError(
-                f"dense_rbk_plan must be 'auto', 'fused_sort' or "
-                f"'sort_partition', got {plan!r}")
-        if plan == "auto":
-            # Per-backend resolution from measured evidence (env.py
-            # dense_rbk_plan note; docs/BENCH_NOTES.md round 5). Safe to
-            # ask the backend here: resolution happens at materialize
-            # time, inside device work.
-            plan = ("sort_partition" if jax.default_backend() == "cpu"
-                    else "fused_sort")
+        # Per-backend resolution from measured evidence (env.py notes;
+        # docs/BENCH_NOTES.md round 5). A typo'd value raising (rather
+        # than silently running the default) keeps A/Bs honest — a
+        # scarce tunnel-window job must never measure fused vs fused.
+        plan = kernels.resolve_backend_mode(
+            "dense_rbk_plan",
+            getattr(_Env.get().conf, "dense_rbk_plan", "auto"),
+            ("auto", "fused_sort", "sort_partition"),
+            "sort_partition", "fused_sort")
 
         # ---- speculative dense-key TABLE plan (round 5) --------------
         # When a prior run of this lineage+sizes OBSERVED a small key
@@ -3306,8 +3301,18 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         schema_d = dict(self._schema())
         vname = (self._value_names[0]
                  if len(self._value_names) == 1 else None)
+        # CPU-only until the on-chip A/B decides (env.py note).
+        table_mode = kernels.resolve_backend_mode(
+            "dense_table_plan",
+            getattr(_Env.get().conf, "dense_table_plan", "auto"),
+            ("auto", "on", "off"), "on", "off")
+        # Learning is gated on the mode too: with the plan off, the
+        # extra kmin/kmax outputs and their fetch would be pure dead
+        # work on every eligible reduce (cache-safe: learn_range is in
+        # the program-cache key).
         learn_range = (
-            self._op in ("add", "min", "max") and vname is not None
+            table_mode == "on"
+            and self._op in ("add", "min", "max") and vname is not None
             and not track_sovf and KEY_LO not in schema_d
             and jnp.dtype(schema_d[vname]) in (jnp.dtype(jnp.int32),
                                                jnp.dtype(jnp.float32)))

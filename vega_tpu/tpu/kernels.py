@@ -329,6 +329,26 @@ def _radix_supported(key: jax.Array) -> bool:
     return key.dtype in (jnp.dtype(jnp.int32), jnp.dtype(jnp.float32))
 
 
+def resolve_backend_mode(name: str, value: str, allowed: tuple,
+                         cpu_choice: str, other_choice: str) -> str:
+    """Shared resolver for the per-backend 'auto' config knobs
+    (dense_sort_impl, dense_rbk_plan, dense_table_plan): validate the
+    string, then resolve 'auto' from the measured evidence — one choice
+    on CPU, the conservative choice elsewhere until the queued on-chip
+    A/Bs decide (env.py notes). Safe to ask the backend here: resolution
+    happens at trace/materialize time, inside device work."""
+    from vega_tpu.errors import VegaError
+
+    if value not in allowed:
+        raise VegaError(
+            f"{name} must be one of {', '.join(repr(a) for a in allowed)};"
+            f" got {value!r}")
+    if value == "auto":
+        return (cpu_choice if jax.default_backend() == "cpu"
+                else other_choice)
+    return value
+
+
 def resolve_sort_impl() -> str:
     """Configuration.dense_sort_impl, validated and with 'auto' resolved
     per backend (packed on CPU — measured 3.8x on the dominant sort at
@@ -337,16 +357,11 @@ def resolve_sort_impl() -> str:
     program-cache keys. Lives here (not dense_rdd) so kernel-internal
     sort choices honor the same setting."""
     from vega_tpu.env import Env
-    from vega_tpu.errors import VegaError
 
-    impl = getattr(Env.get().conf, "dense_sort_impl", "auto")
-    if impl not in ("auto", "xla", "packed", "radix", "radix4"):
-        raise VegaError(
-            "dense_sort_impl must be 'auto', 'xla', 'packed', 'radix' "
-            f"(8-bit digits) or 'radix4' (4-bit digits), got {impl!r}")
-    if impl == "auto":
-        impl = "packed" if jax.default_backend() == "cpu" else "xla"
-    return impl
+    return resolve_backend_mode(
+        "dense_sort_impl",
+        getattr(Env.get().conf, "dense_sort_impl", "auto"),
+        ("auto", "xla", "packed", "radix", "radix4"), "packed", "xla")
 
 
 def packed_sort_perm(words, count: jax.Array,
