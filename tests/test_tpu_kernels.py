@@ -440,6 +440,18 @@ def test_packed_sort_perm_matches_argsort():
     np.testing.assert_array_equal(got[:count],
                                   np.argsort(big[:count], kind="stable"))
 
+    # CONSTANT hi word (wide ids in a narrow band — the runtime
+    # constant-word skip's target shape): the cond's skip branch must
+    # produce the same stable order the full pass would.
+    band = (2**40 + rng.randint(0, 1_000, size=n)).astype(np.int64)
+    bhi, blo = block_lib.encode_i64(band)
+    assert np.unique(np.asarray(bhi)[:count]).size == 1  # skip fires
+    got = run([kernels._orderable_u32(jnp.asarray(blo), False),
+               kernels._orderable_u32(jnp.asarray(bhi), False)], False)
+    np.testing.assert_array_equal(got[:count],
+                                  np.argsort(band[:count], kind="stable"))
+    assert got[count:].tolist() == list(range(count, n))
+
     # empty-valid edge: every row is a ghost, order is the identity
     got_all_ghost = np.asarray(kernels.packed_sort_perm(
         [u], jnp.int32(0), False))
