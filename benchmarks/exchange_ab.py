@@ -13,10 +13,13 @@ device (the real mesh's per-shard work). Run on TPU for BENCH_NOTES.
 Usage: python benchmarks/exchange_ab.py [rows] [n_keys] [n_shards]
 """
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
