@@ -550,6 +550,9 @@ def main():
         if backend == "tpu":
             # v5e HBM bandwidth ~819 GB/s.
             detail["hbm_utilization_lower_bound"] = round(gbps_lb / 819, 3)
+        # Tiered-store occupancy + spill/promote counters: attributes any
+        # RSS/HBM movement to spill traffic (0 spills == fully resident).
+        detail["storage"] = ctx.storage_status()
         _leg_history_compare_and_append(detail)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "

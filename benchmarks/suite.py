@@ -224,16 +224,64 @@ def config5_sort_take(ctx, scale, bank=None):
     return n, host_s, dev_s
 
 
+def config6_spill_roundtrip(ctx, scale, bank=None):
+    """Tiered-store spill leg: a MEMORY_AND_DISK-persisted host RDD ~4x
+    the memory cap. "host_s" = cold build (compute + spill), "device_s" =
+    warm re-action median of 3 (every memory miss served from the
+    DiskStore, ZERO recomputes — asserted), so device_vs_host reads as
+    the spilled-read speedup over recompute. Medians of 3 per the
+    docs/BENCH_LEG_HISTORY.jsonl convention (single runs on this 1-core
+    sandbox carry ~±15% noise)."""
+    from vega_tpu.env import Env
+    from vega_tpu.store import StorageLevel
+
+    n = max(20_000, int(200_000 * scale))
+    computes = []
+
+    def work(x):
+        computes.append(None)
+        return (x * 2654435761) % 1_000_003
+
+    rdd = ctx.parallelize(range(n), 8).map(work).persist(
+        StorageLevel.MEMORY_AND_DISK)
+    mem = Env.get().cache.memory
+    old_cap = mem._capacity
+    # cap at ~1/4 of the dataset's accounted size so most partitions spill
+    mem.set_capacity(max(16_384, (n * 28) // 4))
+    try:
+        exp_sum, cold_s = _timed(lambda: sum(rdd.collect()))
+        n_cold = len(computes)
+        assert n_cold == n, "cold action must compute every row once"
+        status = Env.get().cache.status()
+        assert status["spill_count"] > 0, "cap below data size must spill"
+        warm = []
+        for _ in range(3):
+            got, t = _timed(lambda: sum(rdd.collect()))
+            assert got == exp_sum
+            warm.append(t)
+        assert len(computes) == n_cold, \
+            "warm actions must be recompute-free (disk hits)"
+        warm_s = sorted(warm)[1]
+        if bank:
+            bank(n, warm_s)
+        return n, cold_s, warm_s
+    finally:
+        mem.set_capacity(old_cap)
+        rdd.unpersist()
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
     3: ("parquet reduce_by_key count", config3_parquet_count),
     4: ("cogroup + cartesian", config4_cogroup_cartesian),
     5: ("sort_by_key + take_ordered i64", config5_sort_take),
+    6: ("cache spill round-trip (recompute vs spilled read)",
+        config6_spill_roundtrip),
 }
 
 
-def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5), emit=print):
+def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
     """Run the matrix against an existing Context, emitting one JSON line
     per config as it completes — plus a partial "device leg done" line the
     moment each device measurement lands, BEFORE the slow 1-core host leg
@@ -272,7 +320,7 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5), emit=print):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
-    ap.add_argument("--configs", type=str, default="1,2,3,4,5")
+    ap.add_argument("--configs", type=str, default="1,2,3,4,5,6")
     args = ap.parse_args()
 
     # Same tunnel-wedge protection bench.py carries: standalone runs in

@@ -2007,3 +2007,76 @@ def test_table_plan_warm_reduce_and_repair(dctx):
     r4 = build()
     assert dict(r4.collect()) == exp
     assert r4._table_plan is True
+
+
+def test_dense_spilled_block_parity(dctx):
+    """Tiered-store acceptance: a persisted (MEMORY_AND_DISK) dense node
+    whose block was demoted to disk under HBM pressure promotes back
+    placement-identically — no lineage recompute (asserted by poisoning
+    _materialize), results bit-identical to the host oracle, and the
+    hash_placed claim of the reduce output stays true for downstream
+    elision."""
+    from vega_tpu.env import Env
+    from vega_tpu.store import StorageLevel
+    from vega_tpu.tpu import dense_rdd as dr
+
+    n, k = 20_000, 100
+    r = (dctx.dense_range(n).map(lambda x: (x % k, x))
+         .reduce_by_key(op="add").persist(StorageLevel.MEMORY_AND_DISK))
+    before = dict(r.collect())
+    assert r._block is not None
+
+    # force a demotion sweep at zero budget
+    old = Env.get().conf.dense_hbm_budget
+    Env.get().conf.dense_hbm_budget = 0
+    try:
+        dr._lifetime_evict(dctx)
+    finally:
+        Env.get().conf.dense_hbm_budget = old
+    assert r._block is None, "budget sweep should evict the block"
+    status = Env.get().cache.status()
+    assert status["spilled_bytes"] > 0
+
+    # recompute is forbidden: the next access must PROMOTE from disk
+    r._materialize = lambda: (_ for _ in ()).throw(
+        AssertionError("promoted access must not recompute lineage"))
+    after = dict(r.collect())
+    assert r._block is not None
+    assert Env.get().cache.status()["promote_count"] > 0
+
+    # host-tier parity oracle
+    exp = host_expected_reduce_by_key(
+        [(i % k, i) for i in range(n)], lambda a, b: a + b)
+    assert before == exp
+    assert after == exp
+
+    # placement survives the round trip: a downstream keyed op over the
+    # promoted block still elides its exchange (hash_placed invariant)
+    assert r.hash_placed
+    del r.__dict__["_materialize"]
+    r2 = r.reduce_by_key(op="add")
+    assert dict(r2.collect()) == exp
+
+    # unpersist drops the disk snapshot too
+    r.unpersist()
+    assert not Env.get().cache.contains_raw(dr._dense_spill_key(r))
+
+
+def test_dense_unspilled_eviction_still_recomputes(dctx):
+    """Without a disk-tier storage level, eviction keeps the original
+    recompute-over-spill behavior (and writes nothing to disk)."""
+    from vega_tpu.env import Env
+    from vega_tpu.store import StorageLevel
+    from vega_tpu.tpu import dense_rdd as dr
+
+    r = dctx.dense_range(10_000).map(lambda x: x * 3)
+    total = r.sum()
+    old = Env.get().conf.dense_hbm_budget
+    Env.get().conf.dense_hbm_budget = 0
+    try:
+        dr._lifetime_evict(dctx)
+    finally:
+        Env.get().conf.dense_hbm_budget = old
+    assert r._block is None
+    assert not Env.get().cache.contains_raw(dr._dense_spill_key(r))
+    assert r.sum() == total  # recompute-from-lineage transparency

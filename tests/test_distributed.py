@@ -97,3 +97,39 @@ def test_dense_rdd_crosses_process_boundary(dist_ctx):
     cg = dict(dense.cogroup(host_side).collect())
     assert sorted(cg[2][0]) == [x for x in range(1_000) if x % 7 == 2]
     assert cg[2][1] == ["h2"]
+
+
+def test_disk_resident_shuffle_bucket_served(dist_ctx):
+    """Tiered shuffle store across processes: spill every executor's
+    in-memory buckets to the disk tier, then (a) fetch one bucket directly
+    through the shuffle server and (b) re-read the whole shuffle — both
+    must serve disk-resident buckets transparently (the reference pinned
+    every bucket in RAM forever; its disk path was vestigial)."""
+    from vega_tpu.distributed.shuffle_server import (
+        check_status, fetch_remote, request_spill)
+    from vega_tpu.env import Env
+
+    pairs = dist_ctx.parallelize([(i % 4, i) for i in range(40)], 4)
+    shuffled = pairs.reduce_by_key(lambda a, b: a + b, 4)
+    exp = {k: sum(i for i in range(40) if i % 4 == k) for k in range(4)}
+    assert dict(shuffled.collect()) == exp
+
+    uris = Env.get().map_output_tracker.get_server_uris(shuffled.shuffle_id)
+    spilled = 0
+    for uri in set(uris):
+        reply = request_spill(uri)
+        assert reply is not None, f"spill request to {uri} failed"
+        spilled += reply["spilled"]
+    assert spilled > 0, "map outputs should have been RAM-resident"
+    statuses = [check_status(u) for u in set(uris)]
+    assert all(s is not None for s in statuses)
+    assert all(s["mem_entries"] == 0 for s in statuses)
+    assert sum(s["disk_entries"] for s in statuses) >= spilled
+
+    # direct cross-process fetch of a disk-resident bucket (checksummed
+    # read on the serving side)
+    data = fetch_remote(uris[0], shuffled.shuffle_id, 0, 0)
+    assert data, "disk-resident bucket must serve bytes"
+
+    # and a full re-read of the shuffle: every bucket now comes off disk
+    assert dict(shuffled.collect()) == exp

@@ -148,6 +148,110 @@ def test_pending_speculative_block_not_evicted(dctx, small_budget):
     assert got == exp
 
 
+def test_cache_accounting_under_concurrency():
+    """Eviction/unpersist races must keep the host cache's byte accounting
+    exact: under concurrent put/get/remove_datum at a tiny capacity,
+    used_bytes always equals the sum of live entries and never goes
+    negative (satellite of the tiered-store PR; extends the lifetime
+    coverage to the host tier's cache)."""
+    import random
+    import threading
+
+    from vega_tpu.cache import BoundedMemoryCache, KeySpace
+
+    cache = BoundedMemoryCache(capacity_bytes=8_000)
+    stop = threading.Event()
+    failures = []
+
+    def worker(seed):
+        rng = random.Random(seed)
+        payloads = [list(range(rng.randint(10, 80))) for _ in range(8)]
+        for _ in range(400):
+            datum = rng.randint(0, 3)
+            part = rng.randint(0, 4)
+            op = rng.random()
+            if op < 0.5:
+                cache.put(KeySpace.RDD, datum, part, rng.choice(payloads))
+            elif op < 0.8:
+                cache.get(KeySpace.RDD, datum, part)
+            else:
+                cache.remove_datum(KeySpace.RDD, datum)
+            if cache.used_bytes < 0:
+                failures.append("used_bytes went negative")
+
+    def checker():
+        while not stop.is_set():
+            used = cache.used_bytes
+            if used < 0:
+                failures.append(f"negative used_bytes {used}")
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(6)]
+    check = threading.Thread(target=checker)
+    check.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    check.join()
+    assert not failures, failures[:3]
+    # quiescent exactness: accounting equals the live entries' sizes
+    with cache._lock:
+        live_sum = sum(size for _, size in cache._entries.values())
+        assert cache._used == live_sum
+    assert cache.used_bytes >= 0
+
+
+def test_tiered_cache_concurrent_demote_promote(tmp_path):
+    """Same race surface with the disk tier attached: concurrent demotions
+    (eviction hook) and promotions must not corrupt either tier's
+    accounting."""
+    import random
+    import threading
+
+    from vega_tpu.cache import BoundedMemoryCache, KeySpace
+    from vega_tpu.store import DiskStore, StorageLevel, TieredCache
+
+    cache = TieredCache(BoundedMemoryCache(8_000),
+                        DiskStore(str(tmp_path / "spill")))
+    for d in range(3):
+        cache.set_level(KeySpace.RDD, d, StorageLevel.MEMORY_AND_DISK)
+
+    def worker(seed):
+        rng = random.Random(seed)
+        for _ in range(250):
+            datum = rng.randint(0, 2)
+            part = rng.randint(0, 3)
+            op = rng.random()
+            if op < 0.5:
+                cache.put(KeySpace.RDD, datum, part,
+                          list(range(rng.randint(10, 80))))
+            elif op < 0.85:
+                cache.get(KeySpace.RDD, datum, part)
+            else:
+                cache.remove_datum(KeySpace.RDD, datum)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert cache.used_bytes >= 0
+    assert cache.disk_used_bytes >= 0
+    with cache.memory._lock:
+        assert cache.memory._used == sum(
+            size for _, size in cache.memory._entries.values())
+    # one file per indexed disk block, and every indexed block still
+    # round-trips its checksum (no torn writes)
+    import os
+
+    root = cache.disk.root
+    files = os.listdir(root) if os.path.isdir(root) else []
+    assert len(files) == len(cache.disk)
+    for key in cache.disk.keys():
+        assert cache.disk.get(key) is not None
+
+
 def test_accounting_prunes_dead_pipelines(dctx):
     """Dropping the last user reference to a pipeline frees its tracked
     blocks: cached fused programs keep only detached transform state

@@ -33,6 +33,17 @@ import pytest
 
 import vega_tpu as v
 
+# jaxlib < 0.5's CPU backend cannot execute multi-process computations at
+# all ("Multiprocess computations aren't implemented on the CPU backend"),
+# so the two-process CPU-mesh tests are a capability of newer toolchains;
+# the ssh-shim/launch-path tests below don't need collectives and always
+# run.
+import jax as _jax
+
+needs_multiproc_cpu = pytest.mark.skipif(
+    not hasattr(_jax, "shard_map"),
+    reason="two-process CPU-mesh collectives need jaxlib >= 0.5")
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -424,6 +435,7 @@ def _run_two_process(tmp_path, script_body, timeout_s=420):
     return outs
 
 
+@needs_multiproc_cpu
 def test_multihost_dense_reduce_join_spmd(tmp_path):
     """Framework-level multi-host dense execution (round-3 verdict item
     2): a Context on each of two processes joins one jax.distributed
@@ -438,6 +450,7 @@ def test_multihost_dense_reduce_join_spmd(tmp_path):
         assert "MULTIHOST_DENSE_OK" in out
 
 
+@needs_multiproc_cpu
 def test_multihost_dense_lifetime_eviction(tmp_path):
     """Dense block lifetime across processes: LRU eviction decisions are
     replicated (same driver program -> same order and byte totals), so
@@ -450,6 +463,7 @@ def test_multihost_dense_lifetime_eviction(tmp_path):
         assert "MULTIHOST_LIFETIME_OK" in out
 
 
+@needs_multiproc_cpu
 def test_multihost_dense_wider_surface(tmp_path):
     """Round-4 verdict item 7: the rest of the dense surface over a real
     2-process global mesh — cogroup, sort_by_key at larger scale, a
@@ -463,6 +477,7 @@ def test_multihost_dense_wider_surface(tmp_path):
         assert "MULTIHOST_COVERAGE_OK" in out
 
 
+@needs_multiproc_cpu
 def test_multihost_dense_peer_loss_fails_crisply(tmp_path):
     """Round-4 verdict item 6: a process dying mid-pipeline must leave
     the survivor with a crisp, BOUNDED failure — the jax.distributed
@@ -512,6 +527,7 @@ def test_multihost_dense_peer_loss_fails_crisply(tmp_path):
     assert crisp, f"no crisp peer-loss error in stderr:\n{err0[-800:]}"
 
 
+@needs_multiproc_cpu
 def test_jax_distributed_two_process_smoke(tmp_path):
     """tpu/mesh.init_multihost glues two processes into one global device
     set and a cross-process collective produces the right answer."""

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import vega_tpu as v
+from vega_tpu.tpu import compat
 from vega_tpu.tpu import kernels
 from vega_tpu.tpu.pallas_kernels import hash_bucket_pallas
 
@@ -251,7 +252,7 @@ def test_partition_pos_pallas_lowers_for_tpu():
 
     bucket = jnp.zeros(4096, jnp.int32)
     starts = jnp.zeros(9, jnp.int32)
-    exp = jax.export.export(
+    exp = compat.jax_export(
         jax.jit(lambda b, s: partition_pos_pallas(b, 9, s)),
         platforms=["tpu"],
     )(bucket, starts)

@@ -25,6 +25,7 @@ from vega_tpu.partial.bounded_double import BoundedDouble
 from vega_tpu.partial.partial_result import PartialResult
 from vega_tpu.partitioner import HashPartitioner, Partitioner, RangePartitioner
 from vega_tpu.rdd.base import RDD
+from vega_tpu.store import StorageLevel
 
 __version__ = "0.1.0"
 
@@ -61,6 +62,7 @@ __all__ = [
     "RangePartitioner",
     "RDD",
     "ShuffleError",
+    "StorageLevel",
     "TaskError",
     "VegaError",
 ]

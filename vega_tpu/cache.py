@@ -12,7 +12,7 @@ import enum
 import sys
 import threading
 from collections import OrderedDict
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 
 class KeySpace(enum.Enum):
@@ -25,8 +25,26 @@ class KeySpace(enum.Enum):
 Key = Tuple[KeySpace, int, int]  # (space, datum_id, partition)
 
 
+def _elem_sizeof(elem: Any) -> int:
+    """Size of one container element (no per-container overhead floor)."""
+    import numpy as np
+
+    if isinstance(elem, np.ndarray):
+        return elem.nbytes
+    if isinstance(elem, (list, tuple, dict)):
+        return _sizeof(elem)
+    return max(sys.getsizeof(elem), 16)
+
+
 def _sizeof(value: Any) -> int:
-    """Approximate byte size of a cached partition."""
+    """Approximate byte size of a cached partition.
+
+    Lists/tuples are sized from an evenly-spaced sample of min(len, 16)
+    elements, not element 0 alone: partitions are routinely heterogeneous
+    (ints mixed with arrays/strings) or ragged (element sizes varying by
+    orders of magnitude), and a single-element extrapolation under- or
+    over-accounts those wildly — bad accounting either thrashes the LRU or
+    lets the cache blow past its capacity."""
     try:
         import numpy as np
 
@@ -36,10 +54,15 @@ def _sizeof(value: Any) -> int:
             n = len(value)
             if n == 0:
                 return 64
-            sample = value[0]
-            if isinstance(sample, np.ndarray):
-                return sum(a.nbytes for a in value)
-            return 64 + n * max(sys.getsizeof(sample), 16)
+            k = min(n, 16)
+            sample = [value[(i * n) // k] for i in range(k)]
+            if all(isinstance(s, np.ndarray) for s in sample):
+                try:
+                    return sum(a.nbytes for a in value)  # exact, cheap
+                except AttributeError:
+                    pass  # heterogeneous tail: fall through to sampling
+            per = sum(_elem_sizeof(s) for s in sample) / k
+            return 64 + int(n * per)
         if isinstance(value, dict):
             return 64 + sum(
                 _sizeof(k) + _sizeof(v) for k, v in list(value.items())[:100]
@@ -56,6 +79,10 @@ class BoundedMemoryCache:
         self._used = 0
         self._lock = threading.Lock()
         self.evictions = 0
+        # Eviction hook (key, value, size), set by TieredCache (store/) to
+        # demote evicted entries to disk instead of losing them. Called
+        # OUTSIDE the lock: the hook may re-enter the cache.
+        self.on_evict: Optional[Callable[[Key, Any, int], None]] = None
 
     def put(self, space: KeySpace, datum_id: int, partition: int, value: Any) -> bool:
         """Insert; returns False if the single value exceeds capacity
@@ -64,17 +91,46 @@ class BoundedMemoryCache:
         if size > self._capacity:
             return False
         key = (space, datum_id, partition)
+        evicted: List[Tuple[Key, Any, int]] = []
         with self._lock:
             if key in self._entries:
                 _, old = self._entries.pop(key)
                 self._used -= old
             while self._used + size > self._capacity and self._entries:
-                _, (_, evicted_size) = self._entries.popitem(last=False)
+                ekey, (evalue, evicted_size) = self._entries.popitem(last=False)
                 self._used -= evicted_size
                 self.evictions += 1
+                evicted.append((ekey, evalue, evicted_size))
             self._entries[key] = (value, size)
             self._used += size
+        self._notify_evicted(evicted)
         return True
+
+    def set_capacity(self, capacity_bytes: int) -> None:
+        """Retarget the capacity (benchmark/test knob); shrinking evicts
+        (LRU-first, demotion hook honored) until under the new cap."""
+        evicted: List[Tuple[Key, Any, int]] = []
+        with self._lock:
+            self._capacity = capacity_bytes
+            while self._used > self._capacity and self._entries:
+                ekey, (evalue, evicted_size) = self._entries.popitem(last=False)
+                self._used -= evicted_size
+                self.evictions += 1
+                evicted.append((ekey, evalue, evicted_size))
+        self._notify_evicted(evicted)
+
+    def _notify_evicted(self, evicted: List[Tuple[Key, Any, int]]) -> None:
+        hook = self.on_evict
+        if hook is None:
+            return
+        for ekey, evalue, esize in evicted:
+            try:
+                hook(ekey, evalue, esize)
+            except Exception:  # noqa: BLE001 — demotion failure ≡ plain drop
+                import logging
+
+                logging.getLogger("vega_tpu").exception(
+                    "cache eviction hook failed; entry dropped")
 
     def get(self, space: KeySpace, datum_id: int, partition: int) -> Optional[Any]:
         key = (space, datum_id, partition)
@@ -88,6 +144,14 @@ class BoundedMemoryCache:
     def contains(self, space: KeySpace, datum_id: int, partition: int) -> bool:
         with self._lock:
             return (space, datum_id, partition) in self._entries
+
+    def remove(self, space: KeySpace, datum_id: int, partition: int) -> None:
+        """Drop one entry (no eviction hook — an explicit removal is not a
+        demotion)."""
+        with self._lock:
+            entry = self._entries.pop((space, datum_id, partition), None)
+            if entry is not None:
+                self._used -= entry[1]
 
     def remove_datum(self, space: KeySpace, datum_id: int) -> None:
         """Drop every partition of one RDD/broadcast (unpersist)."""

@@ -76,7 +76,8 @@ def get_or_compute(rdd, split, task_context=None):
         if cached is not None:
             return iter(cached)
         data = list(rdd.compute(split, task_context))
-        env.cache.put(KeySpace.RDD, rdd.rdd_id, split.index, data)
+        env.cache.put(KeySpace.RDD, rdd.rdd_id, split.index, data,
+                      level=getattr(rdd, "storage_level", None))
         tracker = env.cache_tracker
         if tracker is not None:
             host = env.executor_id or "local"

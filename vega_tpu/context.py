@@ -101,6 +101,12 @@ class Context:
             self.metrics = MetricsListener()
             self.bus.add_listener(self.metrics)
             self.bus.start()
+            # Storage tiering observability: the tiered cache and shuffle
+            # store post BlockSpilled/BlockPromoted onto the scheduler
+            # event bus (executors have no bus; they keep counters that
+            # surface through the shuffle server's `status`).
+            env.cache.event_sink = self.bus.post
+            env.shuffle_store.event_sink = self.bus.post
 
             if mode is DeploymentMode.LOCAL:
                 self._backend = LocalBackend()
@@ -315,6 +321,16 @@ class Context:
             log.warning("event bus flush timed out; metrics may lag")
         return self.metrics.summary()
 
+    def storage_status(self) -> dict:
+        """Tier occupancy + spill/promote counters of this process's block
+        stores (cache + shuffle). bench.py embeds this in its detail so
+        HBM/RSS numbers can attribute spill cost."""
+        env = Env.get()
+        return {
+            "cache": env.cache.status(),
+            "shuffle": env.shuffle_store.status(),
+        }
+
     def stop(self) -> None:
         """Reference: context.rs:131-144 (drop/cleanup)."""
         global _active_context
@@ -323,8 +339,8 @@ class Context:
         self._stopped = True
         self.scheduler.stop()
         env = Env.get()
-        env.shuffle_store.clear()
-        env.cache.clear()
+        env.shuffle_store.close()  # clears both tiers + removes spill dir
+        env.cache.close()
         from vega_tpu.env import detach_session_logger
 
         detach_session_logger(self._log_handler, self.conf.log_cleanup)

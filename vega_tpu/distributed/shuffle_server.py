@@ -41,7 +41,14 @@ class _Handler(socketserver.BaseRequestHandler):
                         protocol.send_msg(sock, "ok", None)
                         protocol.send_bytes(sock, data)
                 elif msg_type == "status":
-                    protocol.send_msg(sock, "ok", {"entries": len(store)})
+                    # Tier occupancy + spill counters (store.status());
+                    # "entries" keeps the original healthcheck contract.
+                    protocol.send_msg(sock, "ok", store.status())
+                elif msg_type == "spill":
+                    # Memory-pressure relief: push every RAM bucket to the
+                    # disk tier; subsequent gets serve from disk.
+                    protocol.send_msg(sock, "ok",
+                                      {"spilled": store.spill_all()})
                 else:
                     protocol.send_msg(sock, "error", f"unknown {msg_type}")
                     return
@@ -120,9 +127,19 @@ def fetch_remote(uri: str, shuffle_id: int, map_id: int, reduce_id: int) -> byte
 
 
 def check_status(uri: str, timeout: float = 5.0) -> Optional[dict]:
-    """Healthcheck (reference: shuffle_manager.rs /status)."""
+    """Healthcheck (reference: shuffle_manager.rs /status); now reports
+    tier occupancy (mem/disk entries + bytes) and spill counters."""
     try:
         host, port = protocol.parse_uri(uri)
         return protocol.request(host, port, "status", timeout=timeout)
+    except NetworkError:
+        return None
+
+
+def request_spill(uri: str, timeout: float = 10.0) -> Optional[dict]:
+    """Ask a shuffle server to push its in-memory buckets to disk."""
+    try:
+        host, port = protocol.parse_uri(uri)
+        return protocol.request(host, port, "spill", timeout=timeout)
     except NetworkError:
         return None

@@ -96,12 +96,11 @@ class Worker:
         env = Env.reset(conf, is_driver=False)
         env.executor_id = self.executor_id
 
-        from vega_tpu.shuffle.store import ShuffleStore
-
         tracker = RemoteTrackerClient(driver_uri)
         env.map_output_tracker = tracker
         env.cache_tracker = tracker
-        env.shuffle_store = ShuffleStore(spill_dir=env.work_dir())
+        # env.shuffle_store is the tiered store Env built (per-executor
+        # spill dir under this process's session, conf-driven budgets).
         env.shuffle_server = ShuffleServer(env.shuffle_store, host)
 
         self.tracker = tracker
@@ -153,6 +152,10 @@ class Worker:
         env = Env.get()
         if env.shuffle_server is not None:
             env.shuffle_server.stop()
+        # Remove this executor's spill directories (DiskStore cleanup-on-
+        # shutdown contract): disk blocks are serve-state, not durable.
+        env.shuffle_store.close()
+        env.cache.close()
         from vega_tpu.env import detach_session_logger
 
         detach_session_logger(self._log_handler, env.conf.log_cleanup)

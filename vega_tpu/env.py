@@ -55,6 +55,16 @@ class Configuration:
     # Cache capacity in bytes for BoundedMemoryCache (reference hardcodes
     # 2000MB at cache.rs:29; we make it configurable and actually evict).
     cache_capacity_bytes: int = 2_000 * 1024 * 1024
+    # Tiered block store (vega_tpu/store): spill directory root. None ->
+    # <local_dir>/session-<id>/spill, i.e. rooted at VEGA_TPU_LOCAL_DIR —
+    # per-process (so per-executor) and removed on shutdown.
+    spill_dir: Optional[str] = None
+    # Shuffle store memory budget: total in-RAM bucket bytes before the
+    # oldest buckets spill to disk (the reference pins every bucket in
+    # process memory forever — env.rs:19; large shuffles simply OOM'd).
+    shuffle_memory_budget: int = 1 << 30
+    # Individual buckets larger than this go straight to disk.
+    shuffle_spill_threshold: int = 64 * 1024 * 1024
     # Scheduler timeouts (reference: distributed_scheduler.rs:87-88).
     resubmit_timeout_s: float = 2.0
     poll_timeout_s: float = 0.05
@@ -133,12 +143,13 @@ class Configuration:
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
                      "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
-                     "DENSE_TABLE_PLAN", "HOSTS_FILE"):
+                     "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",
                      "CACHE_CAPACITY_BYTES", "MAX_FAILURES",
-                     "DENSE_HBM_BUDGET"):
+                     "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
+                     "SHUFFLE_SPILL_THRESHOLD"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
@@ -210,12 +221,29 @@ class Env:
     def __init__(self, conf: Optional[Configuration] = None, is_driver: bool = True):
         from vega_tpu.cache import BoundedMemoryCache
         from vega_tpu.shuffle.store import ShuffleStore
+        from vega_tpu.store import DiskStore, TieredCache
 
         self.conf = conf or Configuration.from_environ()
         self.is_driver = is_driver
         self.session_id = uuid.uuid4().hex[:12]
-        self.shuffle_store = ShuffleStore()
-        self.cache = BoundedMemoryCache(self.conf.cache_capacity_bytes)
+        # Spill root (paths only — DiskStore mkdirs lazily on first write,
+        # so constructing an Env touches no filesystem). Always suffixed
+        # with the per-process session id, INCLUDING under an explicit
+        # VEGA_TPU_SPILL_DIR: driver and executors share that env var, and
+        # a bare shared root would let one process's shutdown rmtree
+        # delete every other live executor's disk-resident blocks.
+        base = self.conf.spill_dir or os.path.join(self.conf.local_dir,
+                                                   "spill")
+        spill_root = os.path.join(base, f"session-{self.session_id}")
+        self.shuffle_store = ShuffleStore(
+            spill_dir=os.path.join(spill_root, "shuffle"),
+            spill_threshold=self.conf.shuffle_spill_threshold,
+            memory_budget=self.conf.shuffle_memory_budget,
+        )
+        self.cache = TieredCache(
+            BoundedMemoryCache(self.conf.cache_capacity_bytes),
+            DiskStore(os.path.join(spill_root, "cache")),
+        )
         self.map_output_tracker = None  # set by Context/Executor at startup
         self.cache_tracker = None
         self.shuffle_server = None  # distributed mode only

@@ -49,6 +49,7 @@ class RDD(PairOpsMixin):
         self._deps: List[Dependency] = deps or []
         self._partitioner = partitioner
         self.should_cache = False  # reference: rdd.rs:57 (unfinished there; real here)
+        self.storage_level = None  # set by persist(); None -> MEMORY_ONLY
         self._pinned = False
         self._checkpoint_dir: Optional[str] = None
         self._checkpointed_rdd = None
@@ -116,11 +117,21 @@ class RDD(PairOpsMixin):
     # ------------------------------------------------------------- persistence
     def cache(self):
         """Mark for in-memory caching (finishes what the reference left
-        half-built, SURVEY.md §2.6)."""
-        self.should_cache = True
-        return self
+        half-built, SURVEY.md §2.6). Equivalent to persist() at the
+        MEMORY_ONLY level — eviction drops and lineage recomputes."""
+        return self.persist()
 
-    persist = cache
+    def persist(self, level=None):
+        """Mark for caching at a StorageLevel (vega_tpu/store):
+        MEMORY_ONLY (default, == .cache()), MEMORY_AND_DISK (eviction
+        demotes partitions to the DiskStore and get() promotes them back —
+        a disk hit is a cache hit, not a recompute), or DISK_ONLY.
+        Accepts the enum or its name ('memory_and_disk')."""
+        from vega_tpu.store import StorageLevel
+
+        self.should_cache = True
+        self.storage_level = StorageLevel.coerce(level)
+        return self
 
     def unpersist(self):
         from vega_tpu.cache import KeySpace

@@ -64,6 +64,26 @@ class TaskEnd(Event):
     executor: str = "local"
 
 
+@dataclasses.dataclass
+class BlockSpilled(Event):
+    """A block left RAM for the disk tier (store/ TieredCache demotion,
+    ShuffleStore memory-pressure spill, or a dense-tier block demotion)."""
+
+    store: str = "cache"  # "cache" | "shuffle" | "dense"
+    key: str = ""
+    nbytes: int = 0
+
+
+@dataclasses.dataclass
+class BlockPromoted(Event):
+    """A disk-resident block was read back (a disk hit — served without
+    recompute; cache promotions also re-enter the memory tier)."""
+
+    store: str = "cache"
+    key: str = ""
+    nbytes: int = 0
+
+
 class Listener:
     def on_event(self, event: Event) -> None:
         raise NotImplementedError
@@ -148,6 +168,12 @@ class MetricsListener(Listener):
         self.task_count = 0
         self.task_failures = 0
         self.total_task_time_s = 0.0
+        # Storage tiering counters, per store kind ("cache"/"shuffle"/
+        # "dense"): bench.py and storage_status() attribute spill cost.
+        self.spilled_bytes: Dict[str, int] = {}
+        self.promoted_bytes: Dict[str, int] = {}
+        self.spill_count = 0
+        self.promote_count = 0
         self._lock = threading.Lock()
 
     def on_event(self, event: Event) -> None:
@@ -174,6 +200,14 @@ class MetricsListener(Listener):
                 self.total_task_time_s += event.duration_s
                 if not event.success:
                     self.task_failures += 1
+            elif isinstance(event, BlockSpilled):
+                self.spill_count += 1
+                self.spilled_bytes[event.store] = (
+                    self.spilled_bytes.get(event.store, 0) + event.nbytes)
+            elif isinstance(event, BlockPromoted):
+                self.promote_count += 1
+                self.promoted_bytes[event.store] = (
+                    self.promoted_bytes.get(event.store, 0) + event.nbytes)
 
     def summary(self) -> Dict[str, Any]:
         with self._lock:
@@ -183,4 +217,8 @@ class MetricsListener(Listener):
                 "tasks": self.task_count,
                 "task_failures": self.task_failures,
                 "total_task_time_s": round(self.total_task_time_s, 6),
+                "spills": self.spill_count,
+                "promotes": self.promote_count,
+                "spilled_bytes": dict(self.spilled_bytes),
+                "promoted_bytes": dict(self.promoted_bytes),
             }

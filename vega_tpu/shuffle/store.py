@@ -1,97 +1,219 @@
-"""In-process shuffle output store.
+"""Tiered shuffle output store: RAM first, disk under pressure.
 
 Reference: the global SHUFFLE_CACHE DashMap keyed
 (shuffle_id, map_id, reduce_id) -> serialized bucket bytes (src/env.rs:19,27;
 written by src/dependency.rs:212-223; served over HTTP by
-src/shuffle/shuffle_manager.rs:169-251).
+src/shuffle/shuffle_manager.rs:169-251). Every bucket is pinned in process
+memory forever there (the on-disk path exists but is vestigial —
+shuffle_manager.rs:62-78 creates dirs it never uses), so a large shuffle
+simply OOMs.
 
-vega_tpu keeps the same keying. In local mode reads hit this dict directly; in
-distributed mode each executor's ShuffleServer (distributed/shuffle_server.py)
-serves GETs out of it, and large buckets spill to the session work dir instead
-of pinning process memory (the reference's on-disk path exists but is
-vestigial — shuffle_manager.rs:62-78 creates dirs it never uses; we actually
-spill).
+vega_tpu keeps the same keying but tiers the storage (the Exoshuffle
+insight from PAPERS.md — shuffle storage as a pluggable, spill-capable
+subsystem decoupled from the scheduler):
+  - buckets larger than `spill_threshold` go straight to disk;
+  - when total in-RAM bytes exceed `memory_budget`, the oldest buckets
+    spill (FIFO — map outputs are written once and read roughly in stage
+    order, so age is the best cheap proxy for coldness);
+  - reads check RAM then disk, so local reads AND the distributed
+    ShuffleServer (distributed/shuffle_server.py) serve buckets from
+    either tier transparently. Disk reads are checksummed (store/disk.py):
+    a corrupt bucket reads as missing, which raises FetchFailed upstream
+    and triggers map-stage recompute — never wrong data.
 """
 
 from __future__ import annotations
 
-import os
+import logging
 import threading
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+from vega_tpu.store.disk import DiskStore
+
+log = logging.getLogger("vega_tpu")
 
 Key = Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
 
 # Buckets larger than this spill to disk (bytes).
 SPILL_THRESHOLD = 64 * 1024 * 1024
+# Total in-memory bucket bytes before oldest-first spill.
+MEMORY_BUDGET = 1 << 30
+
+
+def _disk_key(shuffle_id: int, map_id: int, reduce_id: int) -> str:
+    return f"shuffle-{shuffle_id}-{map_id}-{reduce_id}"
 
 
 class ShuffleStore:
     def __init__(self, spill_dir: Optional[str] = None,
-                 spill_threshold: int = SPILL_THRESHOLD):
-        self._mem: Dict[Key, bytes] = {}
-        self._disk: Dict[Key, str] = {}
+                 spill_threshold: int = SPILL_THRESHOLD,
+                 memory_budget: int = MEMORY_BUDGET):
+        self._mem: "OrderedDict[Key, bytes]" = OrderedDict()
+        self._mem_bytes = 0
         self._lock = threading.Lock()
-        self._spill_dir = spill_dir
+        self._disk = DiskStore(spill_dir) if spill_dir else None
         self._spill_threshold = spill_threshold
+        self._memory_budget = memory_budget
+        self.spill_count = 0
+        self.spilled_bytes = 0
+        # Set by the Context to LiveListenerBus.post (driver-side store);
+        # executor stores keep counters only (visible via `status`).
+        self.event_sink = None
 
     def put(self, shuffle_id: int, map_id: int, reduce_id: int, data: bytes) -> None:
         key = (shuffle_id, map_id, reduce_id)
-        if self._spill_dir and len(data) > self._spill_threshold:
-            os.makedirs(self._spill_dir, exist_ok=True)
-            path = os.path.join(
-                self._spill_dir, f"shuffle-{shuffle_id}-{map_id}-{reduce_id}.bin"
-            )
-            with open(path, "wb") as f:
-                f.write(data)
-            with self._lock:
-                self._disk[key] = path
-                self._mem.pop(key, None)
-        else:
-            with self._lock:
-                self._mem[key] = data
-                self._disk.pop(key, None)
+        if self._disk is not None and len(data) > self._spill_threshold:
+            if self._spill(key, data):
+                with self._lock:
+                    old = self._mem.pop(key, None)
+                    if old is not None:
+                        self._mem_bytes -= len(old)
+                return
+            # Disk refused (ENOSPC, ...): hold the bucket in RAM rather
+            # than failing a map task whose output exists.
+        if self._disk is not None:
+            # A rewrite (stage retry) makes any earlier disk copy stale.
+            # Removed BEFORE the memory insert: after it, a concurrent
+            # spill (budget enforcement or a `spill` request) may already
+            # have demoted this fresh bucket, and removing then would
+            # delete the only copy.
+            self._disk.remove(_disk_key(*key))
+        with self._lock:
+            old = self._mem.pop(key, None)
+            if old is not None:
+                self._mem_bytes -= len(old)
+            self._mem[key] = data
+            self._mem_bytes += len(data)
+        if self._disk is not None:
+            self._enforce_budget()
 
     def get(self, shuffle_id: int, map_id: int, reduce_id: int) -> Optional[bytes]:
         key = (shuffle_id, map_id, reduce_id)
         with self._lock:
             data = self._mem.get(key)
-            path = self._disk.get(key)
         if data is not None:
             return data
-        if path is not None:
-            with open(path, "rb") as f:
-                return f.read()
+        if self._disk is not None:
+            return self._disk.get(_disk_key(*key))
         return None
 
     def contains(self, shuffle_id: int, map_id: int, reduce_id: int) -> bool:
         key = (shuffle_id, map_id, reduce_id)
         with self._lock:
-            return key in self._mem or key in self._disk
+            if key in self._mem:
+                return True
+        return self._disk is not None and self._disk.contains(_disk_key(*key))
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         """Drop all outputs of a shuffle (stage retry / job cleanup)."""
         with self._lock:
             for key in [k for k in self._mem if k[0] == shuffle_id]:
-                del self._mem[key]
-            doomed = [k for k in self._disk if k[0] == shuffle_id]
-            paths = [self._disk.pop(k) for k in doomed]
-        for path in paths:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+                self._mem_bytes -= len(self._mem.pop(key))
+        if self._disk is not None:
+            self._disk.remove_prefix(f"shuffle-{shuffle_id}-")
+
+    def spill_all(self) -> int:
+        """Force every in-memory bucket to disk (memory-pressure relief;
+        also the test hook proving disk-resident buckets serve). Returns
+        the number of buckets spilled."""
+        if self._disk is None:
+            return 0
+        n = 0
+        while self._spill_oldest():
+            n += 1
+        return n
 
     def clear(self) -> None:
         with self._lock:
-            paths = list(self._disk.values())
             self._mem.clear()
+            self._mem_bytes = 0
+        if self._disk is not None:
             self._disk.clear()
-        for path in paths:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+
+    def close(self) -> None:
+        """Worker/driver shutdown: drop everything and remove the spill
+        directory."""
+        self.clear()
+        if self._disk is not None:
+            self._disk.close()
+
+    def status(self) -> Dict[str, Any]:
+        """Tier occupancy + spill counters (served by the shuffle server's
+        `status` healthcheck; bench.py attributes spill cost from it)."""
+        with self._lock:
+            mem_entries = len(self._mem)
+            mem_bytes = self._mem_bytes
+        disk = self._disk
+        return {
+            "entries": mem_entries + (len(disk) if disk else 0),
+            "mem_entries": mem_entries,
+            "mem_bytes": mem_bytes,
+            "disk_entries": len(disk) if disk else 0,
+            "disk_bytes": disk.used_bytes if disk else 0,
+            "spill_count": self.spill_count,
+            "spilled_bytes": self.spilled_bytes,
+        }
 
     def __len__(self):
         with self._lock:
-            return len(self._mem) + len(self._disk)
+            n = len(self._mem)
+        return n + (len(self._disk) if self._disk else 0)
+
+    # -------------------------------------------------------------- internal
+    def _enforce_budget(self) -> None:
+        """Oldest-first spill until in-RAM bytes fit the budget. At least
+        one bucket always stays resident — spilling the bucket being
+        written would churn for nothing."""
+        while True:
+            with self._lock:
+                if self._mem_bytes <= self._memory_budget or len(self._mem) <= 1:
+                    return
+            if not self._spill_oldest():
+                return
+
+    def _spill_oldest(self) -> bool:
+        """Demote the oldest RAM bucket: written to disk BEFORE it leaves
+        memory, so a concurrent read always finds it in one tier (a pop-
+        then-write window would answer 'missing' for data that was never
+        lost — a spurious FetchFailed). If a concurrent put replaced the
+        bucket mid-write, the memory copy wins (gets prefer RAM; the next
+        demotion overwrites the stale disk bytes). Returns False when
+        memory is empty or the disk refused the write (the bucket then
+        stays resident — shuffle data must never be dropped)."""
+        with self._lock:
+            if not self._mem:
+                return False
+            key = next(iter(self._mem))
+            data = self._mem[key]
+        if not self._spill(key, data):
+            return False
+        with self._lock:
+            if self._mem.get(key) is data:  # unchanged since the write
+                del self._mem[key]
+                self._mem_bytes -= len(data)
+        return True
+
+    def _spill(self, key: Key, data: bytes) -> bool:
+        """Best-effort disk write; False means the bucket must stay (or
+        go) RAM-resident — a full spill disk must degrade to memory
+        pressure, never fail the task that produced the data."""
+        try:
+            self._disk.put(_disk_key(*key), data)
+        except OSError:
+            log.warning("shuffle spill of %s failed; bucket stays in RAM",
+                        _disk_key(*key), exc_info=True)
+            return False
+        with self._lock:
+            self.spill_count += 1
+            self.spilled_bytes += len(data)
+        sink = self.event_sink
+        if sink is not None:
+            try:
+                from vega_tpu.scheduler.events import BlockSpilled
+
+                sink(BlockSpilled(store="shuffle", key=_disk_key(*key),
+                                  nbytes=len(data)))
+            except Exception:  # noqa: BLE001 — observability must not break IO
+                log.debug("shuffle spill event emit failed", exc_info=True)
+        return True

@@ -1,0 +1,14 @@
+"""Tiered block store: StorageLevel + DiskStore + TieredCache.
+
+The storage subsystem standing between the bounded in-memory caches
+(vega_tpu/cache.py, shuffle/store.py) and larger-than-RAM workloads:
+eviction demotes to a per-process spill directory instead of discarding,
+reads promote back, and every byte moved is accounted and observable on
+the scheduler event bus. See docs/USER_GUIDE.md "Storage levels & spill".
+"""
+
+from vega_tpu.store.disk import DiskStore
+from vega_tpu.store.level import StorageLevel
+from vega_tpu.store.tiered import TieredCache
+
+__all__ = ["DiskStore", "StorageLevel", "TieredCache"]

@@ -1,0 +1,57 @@
+"""Storage levels for persisted data.
+
+Reference: the Rust reference has NO storage-level concept — its
+BoundedMemoryCache is memory-only and eviction is `todo!()` (cache.rs:68-76,
+SURVEY.md §5), so evicted data is simply lost to lineage recompute. This is
+the Spark StorageLevel surface reduced to the three points that matter for
+a tiered block store; replication/serialization flags are out of scope (the
+distributed tier recovers via lineage + shuffle re-registration instead).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class StorageLevel(enum.Enum):
+    """Where a persisted partition may live.
+
+    - MEMORY_ONLY: bounded memory cache; eviction drops (lineage recompute
+      on next access). The `.cache()` default — behavior identical to the
+      pre-tiered engine.
+    - MEMORY_AND_DISK: memory first; LRU eviction *demotes* to the local
+      DiskStore instead of dropping, and a later get() promotes back — a
+      disk hit is a cache hit, not a recompute.
+    - DISK_ONLY: never occupies memory cache; written to disk at put time.
+    """
+
+    MEMORY_ONLY = "memory_only"
+    MEMORY_AND_DISK = "memory_and_disk"
+    DISK_ONLY = "disk_only"
+
+    @property
+    def use_memory(self) -> bool:
+        return self is not StorageLevel.DISK_ONLY
+
+    @property
+    def use_disk(self) -> bool:
+        return self is not StorageLevel.MEMORY_ONLY
+
+    @classmethod
+    def coerce(cls, value) -> "StorageLevel":
+        """Accept a StorageLevel, its name ('MEMORY_AND_DISK', any case),
+        or its value ('memory_and_disk'); None means MEMORY_ONLY."""
+        if value is None:
+            return cls.MEMORY_ONLY
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            try:
+                return cls(value.lower())
+            except ValueError:
+                pass
+            try:
+                return cls[value.upper()]
+            except KeyError:
+                pass
+        raise ValueError(f"not a StorageLevel: {value!r}")

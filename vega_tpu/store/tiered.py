@@ -1,0 +1,242 @@
+"""Tiered block cache: bounded memory backed by a disk spill tier.
+
+The BlockManager the reference never built (its cache eviction is
+`todo!()`, cache.rs:68-76; SURVEY.md §5): BoundedMemoryCache keeps its real
+LRU, but under a TieredCache eviction *demotes* a partition to the
+DiskStore instead of dropping it, and a later get() *promotes* it back —
+a disk hit is a cache hit, not a lineage recompute. Which tier a datum may
+occupy is its StorageLevel, registered per (key space, datum id) by
+persist()/put().
+
+Spill and promote traffic is observable: byte counters here, and (when a
+Context wires `event_sink` to the listener bus) BlockSpilled/BlockPromoted
+events on the scheduler event bus.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from vega_tpu.cache import BoundedMemoryCache, KeySpace
+from vega_tpu.store.disk import DiskStore
+from vega_tpu.store.level import StorageLevel
+
+log = logging.getLogger("vega_tpu")
+
+
+def _disk_key(space: KeySpace, datum_id: int, partition: int) -> str:
+    return f"cache-{space.name.lower()}-{datum_id}-{partition}"
+
+
+class TieredCache:
+    """Drop-in for BoundedMemoryCache (same put/get/contains/remove_datum/
+    used_bytes/clear surface — Env.cache consumers don't change) plus the
+    disk tier, level registry, and spill/promote accounting."""
+
+    def __init__(self, memory: BoundedMemoryCache, disk: DiskStore):
+        self.memory = memory
+        self.disk = disk
+        memory.on_evict = self._on_memory_evict
+        self._levels: Dict[Tuple[KeySpace, int], StorageLevel] = {}
+        self._lock = threading.Lock()
+        self.spill_count = 0
+        self.spilled_bytes = 0
+        self.promote_count = 0
+        self.promoted_bytes = 0
+        # Set by the Context to LiveListenerBus.post; None outside a
+        # driver (executors keep counters only).
+        self.event_sink = None
+        self._oversize_logged = False
+
+    # ---------------------------------------------------------------- levels
+    def set_level(self, space: KeySpace, datum_id: int, level) -> None:
+        level = StorageLevel.coerce(level)
+        with self._lock:
+            self._levels[(space, datum_id)] = level
+
+    def level_for(self, space: KeySpace, datum_id: int) -> StorageLevel:
+        with self._lock:
+            return self._levels.get((space, datum_id),
+                                    StorageLevel.MEMORY_ONLY)
+
+    # ------------------------------------------------------------- cache api
+    def put(self, space: KeySpace, datum_id: int, partition: int, value: Any,
+            level=None) -> bool:
+        """Insert under the datum's storage level. Unlike the bare memory
+        cache, this never silently holds nothing: a value the memory tier
+        rejects as oversize is routed straight to disk (DISK_ONLY for that
+        block) so it is still served without recompute."""
+        if level is not None:
+            self.set_level(space, datum_id, level)
+        lvl = self.level_for(space, datum_id)
+        if not lvl.use_memory:
+            # DISK_ONLY: a stale memory copy (level changed after an
+            # earlier put) must not shadow the fresh disk value.
+            self.memory.remove(space, datum_id, partition)
+            return self._spill_value(space, datum_id, partition, value)
+        # Fresh authoritative value: a stale disk copy from an earlier
+        # demotion must not resurface on a later miss. Removed BEFORE the
+        # memory insert — after it, a concurrent eviction may already have
+        # re-demoted this very entry, and removing then would delete live
+        # data (observed as a lost partition under task-thread concurrency).
+        self.disk.remove(_disk_key(space, datum_id, partition))
+        if self.memory.put(space, datum_id, partition, value):
+            return True
+        # Oversize for the memory tier (reference returned False and the
+        # caller held nothing — cache.rs:50-66): route to the disk tier.
+        # The oversize rejection left any OLD memory entry in place, so it
+        # must go too — it would shadow the fresh disk value on get().
+        if not self._oversize_logged:
+            self._oversize_logged = True
+            log.warning(
+                "cache: value larger than the memory capacity — storing to "
+                "disk (DISK_ONLY for this block); further oversize values "
+                "spill silently")
+        self.memory.remove(space, datum_id, partition)
+        return self._spill_value(space, datum_id, partition, value)
+
+    def get(self, space: KeySpace, datum_id: int, partition: int
+            ) -> Optional[Any]:
+        value = self.memory.get(space, datum_id, partition)
+        if value is not None:
+            return value
+        data = self.disk.get(_disk_key(space, datum_id, partition))
+        if data is None:
+            return None
+        value = pickle.loads(data)
+        lvl = self.level_for(space, datum_id)
+        if lvl.use_memory:
+            # Promote back to memory (may demote colder entries in turn).
+            # An oversize rejection is fine — the disk copy stays
+            # authoritative and keeps serving.
+            self.memory.put(space, datum_id, partition, value)
+        with self._lock:
+            self.promote_count += 1
+            self.promoted_bytes += len(data)
+        self._emit("BlockPromoted", "cache",
+                   _disk_key(space, datum_id, partition), len(data))
+        return value
+
+    def contains(self, space: KeySpace, datum_id: int, partition: int) -> bool:
+        return (self.memory.contains(space, datum_id, partition)
+                or self.disk.contains(_disk_key(space, datum_id, partition)))
+
+    def remove_datum(self, space: KeySpace, datum_id: int) -> None:
+        self.memory.remove_datum(space, datum_id)
+        self.disk.remove_prefix(f"cache-{space.name.lower()}-{datum_id}-")
+        with self._lock:
+            self._levels.pop((space, datum_id), None)
+
+    @property
+    def used_bytes(self) -> int:
+        return self.memory.used_bytes
+
+    @property
+    def disk_used_bytes(self) -> int:
+        return self.disk.used_bytes
+
+    @property
+    def evictions(self) -> int:
+        return self.memory.evictions
+
+    def clear(self) -> None:
+        self.memory.clear()
+        self.disk.clear()
+        with self._lock:
+            self._levels.clear()
+
+    def close(self) -> None:
+        """Shutdown: clear both tiers and remove the spill directory."""
+        self.memory.clear()
+        with self._lock:
+            self._levels.clear()
+        self.disk.close()
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "mem_bytes": self.memory.used_bytes,
+            "disk_bytes": self.disk.used_bytes,
+            "disk_entries": len(self.disk),
+            "evictions": self.memory.evictions,
+            "spill_count": self.spill_count,
+            "spilled_bytes": self.spilled_bytes,
+            "promote_count": self.promote_count,
+            "promoted_bytes": self.promoted_bytes,
+            "disk_read_errors": self.disk.read_errors,
+        }
+
+    # ------------------------------------------------- raw (external) blocks
+    # The dense tier demotes whole device blocks through the same disk
+    # store and the same counters/events, but owns its own (numpy)
+    # encoding — these bypass the memory tier and pickle.
+    def spill_raw(self, key: str, data: bytes, store: str = "dense") -> int:
+        n = self.disk.put(key, data)
+        with self._lock:
+            self.spill_count += 1
+            self.spilled_bytes += n
+        self._emit("BlockSpilled", store, key, n)
+        return n
+
+    def read_raw(self, key: str, store: str = "dense") -> Optional[bytes]:
+        data = self.disk.get(key)
+        if data is None:
+            return None
+        with self._lock:
+            self.promote_count += 1
+            self.promoted_bytes += len(data)
+        self._emit("BlockPromoted", store, key, len(data))
+        return data
+
+    def contains_raw(self, key: str) -> bool:
+        return self.disk.contains(key)
+
+    def remove_raw(self, key: str) -> int:
+        return self.disk.remove(key)
+
+    # -------------------------------------------------------------- internal
+    def _on_memory_evict(self, key, value, size) -> None:
+        """BoundedMemoryCache eviction hook (called outside its lock):
+        demote to disk when the datum's level has a disk tier, else the
+        eviction is a plain drop exactly as before."""
+        space, datum_id, partition = key
+        if not self.level_for(space, datum_id).use_disk:
+            return
+        dkey = _disk_key(space, datum_id, partition)
+        if self.disk.contains(dkey):
+            return  # immutable partition already demoted once
+        self._spill_value(space, datum_id, partition, value)
+
+    def _spill_value(self, space, datum_id, partition, value) -> bool:
+        """Best-effort, like every tier write: a failed disk write (ENOSPC
+        is the normal case for a spill tier) means the block is simply not
+        cached — the caller's task must not fail over it; lineage
+        recomputes on the next miss, exactly as the memory-only cache
+        behaved."""
+        dkey = _disk_key(space, datum_id, partition)
+        try:
+            data = pickle.dumps(value, protocol=5)
+            n = self.disk.put(dkey, data)
+        except Exception:  # noqa: BLE001 — degrade to uncached, not failure
+            log.warning("cache spill of %s failed; block not cached",
+                        dkey, exc_info=True)
+            return False
+        with self._lock:
+            self.spill_count += 1
+            self.spilled_bytes += n
+        self._emit("BlockSpilled", "cache", dkey, n)
+        return True
+
+    def _emit(self, kind: str, store: str, key: str, nbytes: int) -> None:
+        sink = self.event_sink
+        if sink is None:
+            return
+        try:
+            from vega_tpu.scheduler import events
+
+            cls = getattr(events, kind)
+            sink(cls(store=store, key=key, nbytes=nbytes))
+        except Exception:  # noqa: BLE001 — observability must not break IO
+            log.debug("storage event emit failed", exc_info=True)

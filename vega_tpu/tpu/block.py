@@ -197,9 +197,18 @@ class Block:
             sliced = {name: np.asarray(col)[lo:lo + c]
                       for name, col in self.host_cols().items()}
         else:
-            sliced = jax.device_get(
-                {name: col[lo:lo + c] for name, col in self.cols.items()}
-            )  # one transfer for all columns
+            # Serialized: per-split host consumption runs on scheduler
+            # task threads, and concurrent device slicing + device_get
+            # from two threads deadlocks XLA:CPU's runtime on old jaxlibs
+            # under --xla_force_host_platform_device_count on a 1-core
+            # box (observed: one thread wedged dispatching the gather,
+            # another inside device_get, 0% CPU). One lock here costs
+            # nothing — the path is host-bound anyway — and removes the
+            # interleaving entirely.
+            with _host_cache_lock:
+                sliced = jax.device_get(
+                    {name: col[lo:lo + c] for name, col in self.cols.items()}
+                )  # one transfer for all columns
         return _decode_key_cols(
             {name: np.asarray(col) for name, col in sliced.items()}
         )
@@ -389,8 +398,10 @@ def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:
         base = start + jax.lax.axis_index(mesh_lib.SHARD_AXIS) * per
         return base + jax.lax.iota(dtype, cap)
 
+    from vega_tpu.tpu import compat
+
     build_sharded = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             build, mesh=mesh, in_specs=(),
             out_specs=P(mesh_lib.SHARD_AXIS),
         )

@@ -76,12 +76,13 @@ def _canonical_value_layout(schema) -> bool:
 
 def _shard_program(mesh, fn, in_specs, out_specs):
     """jit(shard_map(fn))."""
+    from vega_tpu.tpu import compat
+
     if isinstance(in_specs, int):
         in_specs = (_SPEC,) * in_specs
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
         )
     )
 
@@ -290,12 +291,103 @@ def _lifetime_evict(ctx, keep: Optional[int] = None) -> None:
             # every process, so ref() cannot be dead on one process and
             # pending-alive on another.
             continue
+        level = getattr(rdd, "_storage_level", None)
+        if level is not None and level.use_disk:
+            # persist(MEMORY_AND_DISK / DISK_ONLY): demote the block to
+            # the disk tier instead of dropping it — the next access
+            # promotes (reload + reshard) rather than recomputing
+            # lineage. Accounting below is identical either way (the
+            # entry leaves the LRU with the same registered bytes), so
+            # the FIFO/registered-byte invariants are untouched.
+            _demote_block_to_disk(rdd, blk)
         total -= reg_bytes if multiproc else blk.nbytes
         rdd._block = None
         rdd.__dict__.pop("_pickle_state_memo", None)
         lru.pop(key, None)
         log.debug("dense lifetime: evicted block of rdd %s (%d bytes)",
                   rdd.rdd_id, blk.nbytes)
+
+
+def _dense_spill_key(rdd) -> str:
+    return f"dense-{rdd.rdd_id}"
+
+
+def _demote_block_to_disk(rdd, blk) -> bool:
+    """Write an evicted node's block to the disk tier (store/ DiskStore,
+    via the TieredCache raw-block API so spill bytes are counted and a
+    BlockSpilled event reaches the bus) as a host-numpy snapshot in the
+    SAME shard layout splits() uses for host interop: concatenated
+    [n_shards * capacity] columns + per-shard counts + capacity. Promotion
+    reproduces device placement bit-identically, so a reloaded node's
+    hash_placed/key_sorted claims stay true.
+
+    Multi-process meshes skip demotion (drop-and-recompute, as before):
+    gathering host columns dispatches a collective, and eviction can run
+    on host-tier task threads whose interleaving is not replicated across
+    processes — the same reason splits() pre-gathers on the driver
+    thread. A failed spill degrades to recompute, never to bad data."""
+    import io
+
+    from vega_tpu.env import Env
+
+    first = next(iter(blk.cols.values()), None)
+    if isinstance(first, jax.Array) and not first.is_fully_addressable:
+        return False
+    cache = Env.get().cache
+    if not hasattr(cache, "spill_raw"):  # bare memory cache (unit tests)
+        return False
+    key = _dense_spill_key(rdd)
+    if cache.contains_raw(key):
+        return True  # blocks are immutable per rdd_id: one demotion is enough
+    try:
+        buf = io.BytesIO()
+        arrays = {f"col:{n}": np.asarray(c)
+                  for n, c in blk.host_cols().items()}
+        np.savez(buf, counts=blk.counts_np,
+                 capacity=np.int64(blk.capacity), **arrays)
+        cache.spill_raw(key, buf.getvalue(), store="dense")
+        return True
+    except Exception:  # noqa: BLE001 — spill failure means recompute, not loss
+        log.exception("dense block spill failed; node will recompute")
+        return False
+
+
+def _load_spilled_block(rdd) -> "Optional[Block]":
+    """Promote a demoted node's block back onto the device mesh (checksummed
+    read through the disk tier; a corrupt or mesh-mismatched snapshot is a
+    miss and the node recomputes from lineage)."""
+    import io
+
+    from vega_tpu.env import Env
+
+    level = getattr(rdd, "_storage_level", None)
+    if level is None or not level.use_disk:
+        return None
+    cache = Env.get().cache
+    if not hasattr(cache, "read_raw"):
+        return None
+    data = cache.read_raw(_dense_spill_key(rdd), store="dense")
+    if data is None:
+        return None
+    try:
+        with np.load(io.BytesIO(data)) as z:
+            counts = np.asarray(z["counts"])
+            capacity = int(z["capacity"])
+            cols = {n[len("col:"):]: np.asarray(z[n])
+                    for n in z.files if n.startswith("col:")}
+    except Exception:  # noqa: BLE001
+        log.warning("dense spill snapshot unreadable; recomputing",
+                    exc_info=True)
+        cache.remove_raw(_dense_spill_key(rdd))
+        return None
+    if len(counts) != rdd.mesh.size:
+        return None  # mesh changed since the spill: recompute
+    spec = mesh_lib.shard_spec(rdd.mesh)
+    return Block(
+        cols={n: mesh_lib.host_put(c, spec) for n, c in cols.items()},
+        counts=mesh_lib.host_put(counts, spec),
+        capacity=capacity, mesh=rdd.mesh, counts_host=counts,
+    )
 
 
 # Attributes a detached clone must NOT carry: lineage links, the Context,
@@ -483,7 +575,12 @@ class DenseRDD(RDD):
         repairs them too) — everything else must use block()."""
         blk = self._block
         if blk is None:
-            blk = self._materialize()
+            # A demoted (persist-to-disk) block promotes from the spill
+            # tier — a disk hit, not a lineage recompute; anything else
+            # rematerializes from lineage.
+            blk = _load_spilled_block(self)
+            if blk is None:
+                blk = self._materialize()
             self._block = blk
             # Only lineage-recomputable nodes enter the eviction LRU:
             # sources set _block in __init__ and never take this path.
@@ -493,6 +590,22 @@ class DenseRDD(RDD):
         else:
             _lifetime_touch(self)
         return blk
+
+    def persist(self, level=None) -> "DenseRDD":
+        """Storage level for this node's materialized device block. Dense
+        nodes are materialized-once already (block() memoizes — the
+        finished .cache()); MEMORY_AND_DISK / DISK_ONLY additionally make
+        HBM-budget eviction *demote* the block to the disk tier as a
+        host-numpy snapshot instead of dropping it, and the next access
+        *promote* it (reload + reshard, placement-identical) instead of
+        recomputing lineage. Device data must be HBM-resident to compute,
+        so for dense nodes DISK_ONLY behaves like MEMORY_AND_DISK. Does
+        NOT engage the host-tier row cache (should_cache): dense
+        partitions live as blocks, not row lists."""
+        from vega_tpu.store import StorageLevel
+
+        self._storage_level = StorageLevel.coerce(level)
+        return self
 
     def unpersist(self) -> "DenseRDD":
         """Release this node's materialized device block (the analogue of
@@ -508,6 +621,11 @@ class DenseRDD(RDD):
             self.__dict__.pop("_pickle_state_memo", None)
             _lifetime_forget(self)
         self.__dict__.pop("_host_stage_block", None)
+        from vega_tpu.env import Env
+
+        cache = Env.get().cache
+        if hasattr(cache, "remove_raw"):  # drop any demoted disk snapshot
+            cache.remove_raw(_dense_spill_key(self))
         return self
 
     def _counts_fp(self):

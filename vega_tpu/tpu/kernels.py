@@ -389,7 +389,9 @@ def packed_sort_perm(words, count: jax.Array,
         raise ValueError("packed_sort_perm: capacity must fit 31 bits")
     mask = valid_mask(capacity, count)
     order = None
-    with jax.enable_x64():
+    from vega_tpu.tpu import compat
+
+    with compat.enable_x64():
         idx0 = lax.iota(jnp.int64, capacity)
         for wi, w in enumerate(words):  # LSD -> MSD: one stable pass/word
             if descending:
@@ -399,11 +401,20 @@ def packed_sort_perm(words, count: jax.Array,
             def one_pass(w=w, order=order):
                 wp = (w if order is None
                       else jnp.take(w, order, axis=0))
-                packed = (lax.convert_element_type(wp, jnp.int64)
-                          << jnp.int64(31)) | idx0
+                # Dtype-explicit lax ops: scalar int64 literals (jnp.int64(31))
+                # canonicalize to int32 tensors on jax < 0.5 even inside the
+                # enable_x64 scope, which fails stablehlo verification for
+                # shift_left — broadcast + convert is identical HLO on
+                # current jax and correct on both.
+                wp64 = lax.convert_element_type(wp, jnp.int64)
+                shift = lax.convert_element_type(
+                    jnp.full(wp.shape, 31, jnp.int32), jnp.int64)
+                lowmask = lax.convert_element_type(
+                    jnp.full(wp.shape, 0x7FFFFFFF, jnp.int32), jnp.int64)
+                packed = lax.bitwise_or(lax.shift_left(wp64, shift), idx0)
                 sw = lax.sort(packed)
                 pos = lax.convert_element_type(
-                    sw & jnp.int64(0x7FFFFFFF), jnp.int32)
+                    lax.bitwise_and(sw, lowmask), jnp.int32)
                 return (pos if order is None
                         else jnp.take(order, pos, axis=0))
 
