@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Chaos tier: the full fault-injection suite (vega_tpu/faults.py driving
+# worker SIGKILLs, wedged executors, dropped fetches, corrupted spill
+# files) INCLUDING the slow kill-loops that tier-1 excludes. Run on demand;
+# not part of the tier-1 timing budget (scripts/t1.sh).
+set -o pipefail
+cd "$(dirname "$0")/.."
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+  -p no:cacheprovider -p no:xdist -p no:randomly "$@"

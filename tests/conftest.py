@@ -30,6 +30,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs real TPU hardware (run via the tpu_jobs "
         "queue with VEGA_TPU_HW_TESTS=1)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection test (vega_tpu/faults.py) — "
+        "kills/wedges workers, drops fetches, corrupts buckets; run the "
+        "full set via scripts/chaos.sh")
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from the tier-1 "
+        "timing budget (scripts/t1.sh runs -m 'not slow')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,339 @@
+"""Chaos suite: fault-injection tests over the distributed plane.
+
+Every test here drives a REAL failure through vega_tpu/faults.py — worker
+SIGKILL mid-job, wedged-but-alive executors, dropped shuffle-fetch
+connections, corrupted spill files — and asserts the recovery machinery
+(liveness reaper, worker respawn, in-place fetch retry, FetchFailed/
+resubmit) produces results identical to a fault-free run. The reference
+built these paths and never exercised them (SURVEY.md §5); an unexercised
+recovery path is a bug with latency.
+
+Marked `chaos`; the slow kill-loop variants are additionally `slow` (out
+of the tier-1 timing budget). Run everything via scripts/chaos.sh.
+"""
+
+import os
+import time
+
+import pytest
+
+import vega_tpu as v
+from vega_tpu import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    """The driver-process injector caches env vars at first use; rebuild it
+    around every test so monkeypatched VEGA_TPU_FAULT_* take effect and
+    never leak into later modules."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _chaos_context(**overrides):
+    """Distributed context with fault-tolerance knobs tightened so reap /
+    respawn / retry all land within a few seconds on the test box."""
+    kw = dict(
+        num_workers=2,
+        heartbeat_interval_s=0.2,
+        executor_liveness_timeout_s=1.5,
+        executor_reap_interval_s=0.3,
+        executor_restart_backoff_s=0.1,
+        executor_max_restarts=2,
+        resubmit_timeout_s=0.2,
+        fetch_retries=4,
+        fetch_retry_interval_s=0.05,
+    )
+    kw.update(overrides)
+    return v.Context("distributed", **kw)
+
+
+def _reduce_job(ctx):
+    pairs = ctx.parallelize([(i % 5, i) for i in range(200)], 8)
+    return sorted(pairs.reduce_by_key(lambda a, b: a + b, 4).collect())
+
+
+def _wait_metric(ctx, key, minimum, timeout_s=20.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if ctx.metrics_summary().get(key, 0) >= minimum:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_sigkill_worker_mid_job_results_identical(monkeypatch, tmp_path):
+    """Acceptance: SIGKILL one of 2 workers mid-job (via faults.py); the
+    job completes with results identical to a fault-free run, the reaper
+    emits ExecutorLost, and the slot respawns (ExecutorRestarted)."""
+    ctx = _chaos_context()
+    try:
+        expected = _reduce_job(ctx)  # fault-free run, same topology
+    finally:
+        ctx.stop()
+
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_KILL_AFTER_TASKS", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context()
+    try:
+        assert _reduce_job(ctx) == expected
+        kills = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "kill_worker"]
+        assert kills, "the injected SIGKILL never fired"
+        summary = ctx.metrics_summary()
+        assert summary["executors_lost"] >= 1
+        # Respawn is asynchronous (reap sweep + backoff): wait for it, then
+        # prove the respawned slot actually takes work again.
+        assert _wait_metric(ctx, "executors_restarted", 1), \
+            "killed worker slot was never respawned"
+        assert _reduce_job(ctx) == expected
+    finally:
+        ctx.stop()
+
+
+def test_wedged_worker_is_reaped_and_tasks_redispatched(monkeypatch, tmp_path):
+    """Acceptance: a stale-heartbeat executor (wedged — alive but neither
+    heartbeating nor progressing) is reaped within the configured timeout;
+    its in-flight tasks fail over to the survivor mid-job."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_SUPPRESS_HEARTBEATS", "1")
+    monkeypatch.setenv("VEGA_TPU_FAULT_HANG_TASKS", "1")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(executor_max_restarts=0)
+    try:
+        t0 = time.time()
+        total = sum(
+            ctx.parallelize(list(range(80)), 4).map(lambda x: x + 1).collect()
+        )
+        elapsed = time.time() - t0
+        assert total == sum(range(1, 81))
+        # Recovery must be reaper-speed (liveness 1.5s + sweep 0.3s), not
+        # some unbounded socket timeout.
+        assert elapsed < 30.0, f"re-dispatch took {elapsed:.1f}s"
+        assert ctx.metrics_summary()["executors_lost"] >= 1
+        hangs = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "hang_task"]
+        assert hangs, "no task was ever dispatched to the wedged worker"
+        # Survivor keeps serving fresh work on a shrunken fleet.
+        assert ctx.parallelize(list(range(20)), 4).count() == 20
+    finally:
+        ctx.stop()
+
+
+def test_dropped_fetch_recovers_in_place_no_resubmission(monkeypatch, tmp_path):
+    """Acceptance: a dropped connection at the fetch layer recovers via
+    bounded in-place retry — NO stage resubmission, NO executor loss on
+    the event bus — while an actually-dead executor (other tests) goes
+    through the resubmit path."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_FETCH_DROP_N", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context()
+    try:
+        assert _reduce_job(ctx) == _expected_reduce()
+        drops = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "fetch_drop"]
+        assert drops, "no fetch connection was ever dropped"
+        summary = ctx.metrics_summary()
+        assert summary["stages_resubmitted"] == 0, \
+            "transient drop must not escalate to a stage resubmission"
+        assert summary["executors_lost"] == 0
+    finally:
+        ctx.stop()
+
+
+def test_corrupt_disk_bucket_reads_as_missing_then_stage_retry(
+        monkeypatch, tmp_path):
+    """Satellite: flip bytes in a spilled shuffle file on an executor; the
+    checksummed read surfaces it as missing -> FetchFailed -> map-stage
+    retry -> correct results, cross-process (store.py promises this;
+    this proves it)."""
+    stats_dir = str(tmp_path / "stats")
+    # Every bucket spills straight to disk on the workers...
+    monkeypatch.setenv("VEGA_TPU_SHUFFLE_SPILL_THRESHOLD", "1")
+    # ...and the first spilled bucket per worker gets its bytes flipped.
+    monkeypatch.setenv("VEGA_TPU_FAULT_CORRUPT_SPILL_N", "1")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context()
+    try:
+        pairs = ctx.parallelize([(i % 4, i) for i in range(40)], 4)
+        shuffled = pairs.reduce_by_key(lambda a, b: a + b, 4)
+        exp = {k: sum(i for i in range(40) if i % 4 == k) for k in range(4)}
+        assert dict(shuffled.collect()) == exp
+
+        corruptions = [s for s in faults.read_stats(stats_dir)
+                       if s["fault"] == "corrupt_spill"]
+        assert corruptions, "no spilled bucket was ever corrupted"
+        assert ctx.metrics_summary()["stages_resubmitted"] >= 1
+
+        # The serving side counted the checksum failure (caught, not served).
+        from vega_tpu.distributed.shuffle_server import check_status
+        from vega_tpu.env import Env
+
+        uris = Env.get().map_output_tracker.get_server_uris(
+            shuffled.shuffle_id)
+        statuses = [check_status(u) for u in set(uris)]
+        assert sum(s["read_errors"] for s in statuses if s) >= 1
+    finally:
+        ctx.stop()
+
+
+def test_corrupt_spill_recovery_local_mode():
+    """Fast in-process variant of the corrupt-bucket path: local mode, same
+    checksum -> miss -> FetchFailed -> recompute contract."""
+    faults.configure(corrupt_spill_n=1)
+    ctx = v.Context("local", num_workers=4, shuffle_spill_threshold=1,
+                    resubmit_timeout_s=0.2)
+    try:
+        pairs = ctx.parallelize([(i % 3, 1) for i in range(90)], 4)
+        assert dict(pairs.reduce_by_key(lambda a, b: a + b, 3).collect()) == \
+            {0: 30, 1: 30, 2: 30}
+        assert ctx.storage_status()["shuffle"]["read_errors"] >= 1
+        assert ctx.metrics_summary()["stages_resubmitted"] >= 1
+    finally:
+        ctx.stop()
+
+
+def test_total_executor_loss_waits_for_respawn(monkeypatch, tmp_path):
+    """Losing EVERY executor at once must not abort the job in the
+    milliseconds before a respawn lands: dispatch waits out the restart
+    budget instead of burning max_failures against an empty fleet."""
+    hosts = tmp_path / "hosts.conf"
+    hosts.write_text("master = 127.0.0.1\nslaves = 127.0.0.1\n")  # fleet of 1
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_KILL_AFTER_TASKS", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(hosts_file=str(hosts))
+    try:
+        got = sorted(
+            ctx.parallelize(list(range(40)), 4).map(lambda x: x * 2).collect()
+        )
+        assert got == [x * 2 for x in range(40)]
+        kills = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "kill_worker"]
+        assert kills, "the injected SIGKILL never fired"
+        summary = ctx.metrics_summary()
+        assert summary["executors_lost"] >= 1
+        assert summary["executors_restarted"] >= 1
+    finally:
+        ctx.stop()
+
+
+@pytest.mark.slow
+def test_kill_loop_every_incarnation_dies(monkeypatch, tmp_path):
+    """Slow kill-loop: the chaos executor dies after every 3 tasks in EVERY
+    incarnation (respawns included) until its restart cap binds; repeated
+    jobs keep completing correctly on whatever fleet survives."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_KILL_AFTER_TASKS", "3")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_ALL_INCARNATIONS", "1")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(executor_max_restarts=2)
+    try:
+        expected = _expected_reduce()
+        for _ in range(3):
+            assert _reduce_job(ctx) == expected
+        kills = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "kill_worker"]
+        assert kills
+        assert ctx.metrics_summary()["executors_lost"] >= 1
+    finally:
+        ctx.stop()
+
+
+def _expected_reduce():
+    exp = {}
+    for i in range(200):
+        exp[i % 5] = exp.get(i % 5, 0) + i
+    return sorted(exp.items())
+
+
+# --------------------------------------------------------------------------
+# Unit-level companions (no worker processes): tracker-client reconnect and
+# the reaper's bulk map-output invalidation.
+
+
+def test_remote_tracker_client_survives_broken_cached_socket():
+    """Satellite: a dead per-thread cached socket must not fail tracker
+    calls permanently while the driver is healthy — reconnect + retry once."""
+    from vega_tpu.cache_tracker import CacheTracker
+    from vega_tpu.distributed.driver_service import (
+        DriverService, RemoteTrackerClient)
+    from vega_tpu.map_output_tracker import MapOutputTracker
+
+    svc = DriverService(MapOutputTracker(), CacheTracker())
+    try:
+        client = RemoteTrackerClient(svc.uri)
+        assert client.generation == 0
+        # Break the cached connection under the client's feet.
+        client._local.sock.close()
+        assert client.generation == 0  # reconnects transparently
+        client.register_worker({"executor_id": "x", "host": "h",
+                                "task_uri": "h:1", "shuffle_uri": "h:2",
+                                "pid": 0})
+        client._local.sock.close()
+        client.heartbeat("x")  # idempotent retry after reconnect
+        assert "x" in svc.live_workers(max_age=5.0)
+    finally:
+        svc.stop()
+
+
+def test_resolve_timeout_escalates_as_fetch_failed(ctx):
+    """A reduce task whose location resolve times out (outputs invalidated
+    by the reaper, nothing recomputed yet) must fail with the TYPED
+    FetchFailedError — that is what makes the scheduler resubmit the
+    producing stage. A generic error would retry the reduce task against
+    the same empty registry until max_failures aborts the job."""
+    from vega_tpu.env import Env
+    from vega_tpu.errors import FetchFailedError, MapOutputError
+    from vega_tpu.shuffle.fetcher import ShuffleFetcher
+
+    env = Env.get()
+    original = env.map_output_tracker
+
+    class StuckTracker:
+        def get_server_uris(self, shuffle_id, timeout=60.0):
+            raise MapOutputError("timed out waiting for map outputs")
+
+    env.map_output_tracker = StuckTracker()
+    try:
+        with pytest.raises(FetchFailedError) as excinfo:
+            ShuffleFetcher.fetch_blobs(7, 0)
+        assert excinfo.value.shuffle_id == 7
+        assert excinfo.value.map_id is None  # whole-shuffle invalidation
+    finally:
+        env.map_output_tracker = original
+
+
+def test_unregister_server_outputs_bulk_invalidation():
+    """Reaper contract: one sweep nulls every output on the lost server and
+    bumps the generation exactly once."""
+    from vega_tpu.map_output_tracker import MapOutputTracker
+
+    t = MapOutputTracker()
+    t.register_shuffle(0, 3)
+    t.register_map_outputs(0, ["a:1", "b:1", "a:1"])
+    t.register_shuffle(1, 2)
+    t.register_map_outputs(1, ["b:1", "a:1"])
+    gen = t.generation
+    assert t.unregister_server_outputs("a:1") == 3
+    assert t.generation == gen + 1
+    assert not t.has_outputs(0)
+    assert not t.has_outputs(1)
+    # survivors untouched
+    assert t._outputs[0][1] == "b:1"
+    assert t.unregister_server_outputs("nope:9") == 0
+    assert t.generation == gen + 1  # no spurious bump

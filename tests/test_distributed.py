@@ -79,6 +79,18 @@ def test_executor_loss_recovery(dist_ctx):
     assert dist_ctx.parallelize(list(range(20)), 4).map(lambda x: x + 1).count() == 20
 
 
+def test_chatty_worker_stdout_does_not_wedge(dist_ctx):
+    """Worker stdout is drained after VEGA_WORKER_READY: a task that
+    print()s past the ~64 KB pipe buffer must not block mid-task (the
+    silent wedge the drain thread exists to prevent)."""
+    def noisy(x):
+        print("x" * 1024)  # ~200 KB total across the job
+        return x
+
+    got = dist_ctx.parallelize(list(range(200)), 4).map(noisy).collect()
+    assert sorted(got) == list(range(200))
+
+
 def test_dense_rdd_crosses_process_boundary(dist_ctx):
     """A dense RDD consumed by distributed host-tier tasks ships as host
     numpy (jax arrays/meshes are process-local): mixing tiers works in

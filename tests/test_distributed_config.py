@@ -76,6 +76,34 @@ def test_context_active_recovery_handle():
     c.stop()
 
 
+def test_fault_tolerance_knobs_from_environ():
+    """The reaper/respawn/retry knobs are conf-driven with env-var
+    overrides (no hardcoded constants in the recovery paths)."""
+    from vega_tpu.env import Configuration
+
+    cfg = Configuration.from_environ({
+        "VEGA_TPU_HEARTBEAT_INTERVAL_S": "0.5",
+        "VEGA_TPU_EXECUTOR_LIVENESS_TIMEOUT_S": "7.5",
+        "VEGA_TPU_EXECUTOR_REAP_INTERVAL_S": "1.25",
+        "VEGA_TPU_EXECUTOR_MAX_RESTARTS": "9",
+        "VEGA_TPU_EXECUTOR_RESTART_BACKOFF_S": "0.75",
+        "VEGA_TPU_EXECUTOR_BLACKLIST_THRESHOLD": "11",
+        "VEGA_TPU_FETCH_RETRIES": "6",
+        "VEGA_TPU_FETCH_RETRY_INTERVAL_S": "0.125",
+    })
+    assert cfg.heartbeat_interval_s == 0.5
+    assert cfg.executor_liveness_timeout_s == 7.5
+    assert cfg.executor_reap_interval_s == 1.25
+    assert cfg.executor_max_restarts == 9
+    assert cfg.executor_restart_backoff_s == 0.75
+    assert cfg.executor_blacklist_threshold == 11
+    assert cfg.fetch_retries == 6
+    assert cfg.fetch_retry_interval_s == 0.125
+    # defaults stay sane: heartbeats well under the liveness bound
+    default = Configuration()
+    assert default.heartbeat_interval_s * 3 <= default.executor_liveness_timeout_s
+
+
 def test_failed_context_init_releases_slot(tmp_path, monkeypatch):
     """A Context whose backend init fails must not keep the active slot."""
     monkeypatch.setenv("PATH", str(tmp_path))  # no ssh binary

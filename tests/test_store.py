@@ -168,6 +168,45 @@ def test_storage_level_coerce():
     assert StorageLevel.MEMORY_AND_DISK.use_disk
 
 
+def test_concurrent_get_during_demotion_never_misses(tmp_path):
+    """Eviction demotes to disk BEFORE the entry leaves memory: a get()
+    landing mid-demotion must find the partition in ONE of the tiers,
+    never observe a double miss (which upstream becomes a recompute of a
+    partition that was never lost). Deterministic: the disk write is gated
+    open while the victim is probed. Regression: a pop-then-demote window
+    flaked test_spill_roundtrip_zero_recompute under full-suite load."""
+    import threading
+
+    write_started = threading.Event()
+    release_write = threading.Event()
+
+    class GatedDisk(DiskStore):
+        def put(self, key, data):
+            if key == "cache-rdd-1-0" and not release_write.is_set():
+                write_started.set()
+                release_write.wait(5.0)
+            return super().put(key, data)
+
+    cache = TieredCache(BoundedMemoryCache(30_000),
+                        GatedDisk(str(tmp_path / "spill")))
+    cache.set_level(KeySpace.RDD, 1, StorageLevel.MEMORY_AND_DISK)
+    big = list(range(500))  # ~14KB by _sizeof: two fit, a third evicts
+    cache.put(KeySpace.RDD, 1, 0, big)
+    cache.put(KeySpace.RDD, 1, 1, big)
+
+    # Evict partition 0 (the LRU) on a helper thread; its demotion write
+    # parks on the gate with the eviction mid-flight.
+    evictor = threading.Thread(
+        target=cache.put, args=(KeySpace.RDD, 1, 2, big))
+    evictor.start()
+    assert write_started.wait(5.0), "demotion never reached the disk tier"
+    got_mid_demotion = cache.get(KeySpace.RDD, 1, 0)
+    release_write.set()
+    evictor.join()
+    assert got_mid_demotion == big, "partition 0 vanished mid-demotion"
+    assert cache.get(KeySpace.RDD, 1, 0) == big  # both tiers settled
+
+
 # --------------------------------------------------- end-to-end (acceptance)
 def test_spill_roundtrip_zero_recompute():
     """With the memory cap below dataset size, a MEMORY_AND_DISK-persisted

@@ -81,7 +81,12 @@ class BoundedMemoryCache:
         self.evictions = 0
         # Eviction hook (key, value, size), set by TieredCache (store/) to
         # demote evicted entries to disk instead of losing them. Called
-        # OUTSIDE the lock: the hook may re-enter the cache.
+        # OUTSIDE the lock, and — crucially — while the victim is STILL
+        # readable from memory: the entry only leaves after the hook
+        # returns, so a concurrent get() always finds the partition in one
+        # tier (a pop-then-demote window would read as a double miss and
+        # recompute a partition that was never lost — the same spurious-
+        # miss race ShuffleStore._spill_oldest documents).
         self.on_evict: Optional[Callable[[Key, Any, int], None]] = None
 
     def put(self, space: KeySpace, datum_id: int, partition: int, value: Any) -> bool:
@@ -91,46 +96,63 @@ class BoundedMemoryCache:
         if size > self._capacity:
             return False
         key = (space, datum_id, partition)
-        evicted: List[Tuple[Key, Any, int]] = []
         with self._lock:
             if key in self._entries:
                 _, old = self._entries.pop(key)
                 self._used -= old
-            while self._used + size > self._capacity and self._entries:
-                ekey, (evalue, evicted_size) = self._entries.popitem(last=False)
-                self._used -= evicted_size
-                self.evictions += 1
-                evicted.append((ekey, evalue, evicted_size))
             self._entries[key] = (value, size)
             self._used += size
-        self._notify_evicted(evicted)
+            victims = self._peek_victims(exclude=key)
+        self._evict(victims)
         return True
 
     def set_capacity(self, capacity_bytes: int) -> None:
         """Retarget the capacity (benchmark/test knob); shrinking evicts
         (LRU-first, demotion hook honored) until under the new cap."""
-        evicted: List[Tuple[Key, Any, int]] = []
         with self._lock:
             self._capacity = capacity_bytes
-            while self._used > self._capacity and self._entries:
-                ekey, (evalue, evicted_size) = self._entries.popitem(last=False)
-                self._used -= evicted_size
-                self.evictions += 1
-                evicted.append((ekey, evalue, evicted_size))
-        self._notify_evicted(evicted)
+            victims = self._peek_victims()
+        self._evict(victims)
 
-    def _notify_evicted(self, evicted: List[Tuple[Key, Any, int]]) -> None:
+    def _peek_victims(self, exclude: Optional[Key] = None
+                      ) -> List[Tuple[Key, Any, int]]:
+        """LRU-first victims bringing used bytes under capacity. Caller
+        holds the lock. Victims are only PEEKED — they stay readable until
+        _evict demotes then removes them."""
+        over = self._used - self._capacity
+        victims: List[Tuple[Key, Any, int]] = []
+        if over <= 0:
+            return victims
+        for ekey, (evalue, esize) in self._entries.items():
+            if ekey == exclude:
+                continue
+            victims.append((ekey, evalue, esize))
+            over -= esize
+            if over <= 0:
+                break
+        return victims
+
+    def _evict(self, victims: List[Tuple[Key, Any, int]]) -> None:
+        """Demote (hook) THEN remove, per victim. The removal is identity-
+        guarded: if a fresh put replaced the entry while the hook ran, the
+        new value wins and stays (concurrent evictions of the same victim
+        are likewise idempotent — only the actual remover accounts it)."""
         hook = self.on_evict
-        if hook is None:
-            return
-        for ekey, evalue, esize in evicted:
-            try:
-                hook(ekey, evalue, esize)
-            except Exception:  # noqa: BLE001 — demotion failure ≡ plain drop
-                import logging
+        for ekey, evalue, esize in victims:
+            if hook is not None:
+                try:
+                    hook(ekey, evalue, esize)
+                except Exception:  # noqa: BLE001 — demotion failure ≡ plain drop
+                    import logging
 
-                logging.getLogger("vega_tpu").exception(
-                    "cache eviction hook failed; entry dropped")
+                    logging.getLogger("vega_tpu").exception(
+                        "cache eviction hook failed; entry dropped")
+            with self._lock:
+                entry = self._entries.get(ekey)
+                if entry is not None and entry[0] is evalue:
+                    del self._entries[ekey]
+                    self._used -= entry[1]
+                    self.evictions += 1
 
     def get(self, space: KeySpace, datum_id: int, partition: int) -> Optional[Any]:
         key = (space, datum_id, partition)

@@ -5,11 +5,21 @@ connection to an executor, writes the framed task, and awaits the result on
 the same socket (:382-445), choosing executors round-robin with a pinned-host
 seek (:447-469), retrying connects 5x with backoff (:434-441).
 
-vega_tpu keeps that dispatch shape, and adds what the reference lacks
-(SURVEY.md §5 failure detection): executor-loss detection (a dead socket
-marks the executor lost, its in-flight tasks are re-dispatched elsewhere,
-and the scheduler's fetch-failure path cleans up its map outputs) instead of
-'retry 5x then panic'.
+vega_tpu keeps that dispatch shape, and adds the executor fault tolerance
+the reference lacks (SURVEY.md §5 failure detection — its executor loss is
+'retry connect 5x then panic'):
+
+  * a dead socket marks the executor lost and re-dispatches its task;
+  * a **liveness reaper** thread sweeps worker heartbeats
+    (DriverService.workers last_seen): a wedged-but-alive executor is
+    declared lost within executor_liveness_timeout_s — its map outputs are
+    unregistered (tracker generation bump, so reducers refetch), its
+    in-flight dispatch sockets are torn down (the blocked dispatch threads
+    fail over to survivors), and ExecutorLost reaches the scheduler bus;
+  * **worker respawn**: dead local/ssh workers are relaunched with capped
+    restarts and exponential backoff (ExecutorRestarted on the bus), and
+    per-executor dispatch-failure counts blacklist repeat offenders from
+    _pick_executor.
 
 Deployment: local workers are spawned as subprocesses (the docker-compose
 testing-cluster analogue, reference docker/testing_cluster.sh); remote hosts
@@ -28,13 +38,14 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from vega_tpu import serialization
 from vega_tpu.distributed import protocol
 from vega_tpu.distributed.driver_service import DriverService
 from vega_tpu.env import Env
 from vega_tpu.errors import NetworkError, TaskError
+from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.dag import TaskBackend
 from vega_tpu.scheduler.task import Task, TaskEndEvent
 
@@ -43,37 +54,51 @@ log = logging.getLogger("vega_tpu")
 
 class _Executor:
     def __init__(self, executor_id: str, task_uri: str, host: str,
-                 process: Optional[subprocess.Popen] = None):
+                 process: Optional[subprocess.Popen] = None,
+                 restarts: int = 0):
         self.executor_id = executor_id
         self.task_uri = task_uri
         self.host = host
         self.process = process
+        self.restarts = restarts  # respawn incarnation of this slot
         self.alive = True
+        self.reaped = False      # declared lost; never resurrects
+        self.respawning = False  # a replacement launch is in flight
+        self.failures = 0        # dispatch/transport failures (blacklist)
+        self.lost_at = 0.0       # when the reaper declared it lost
+        self.sockets: Set[socket.socket] = set()  # in-flight dispatches
 
 
 class DistributedBackend(TaskBackend):
     def __init__(self, conf, num_executors: Optional[int] = None,
                  hosts: Optional[List[str]] = None):
         env = Env.get()
-        self.service = DriverService(env.map_output_tracker, env.cache_tracker)
+        self.service = DriverService(
+            env.map_output_tracker, env.cache_tracker,
+            liveness_timeout_s=conf.executor_liveness_timeout_s,
+        )
         env.shuffle_server = None  # driver serves no shuffle data
         self.conf = conf
         self._executors: Dict[str, _Executor] = {}
         self._rr = itertools.count(0)
         self._lock = threading.Lock()
         self._stopped = False
+        self._stop_event = threading.Event()
+        # The scheduler (or any observer) plugs in here: bus.post for
+        # ExecutorLost/ExecutorRestarted, plus structured callbacks so the
+        # DAG scheduler can scrub Stage.output_locs on loss.
+        self.event_sink: Optional[Callable] = None
+        self._executor_lost_listeners: List[Callable] = []
         if hosts is None:
             # Cluster membership from a hosts file ONLY when explicitly
             # configured (conf.hosts_file / VEGA_TPU_HOSTS_FILE) — a stray
             # ~/hosts.conf must not silently override num_executors.
-            import os as _os
-
             explicit = getattr(conf, "hosts_file", None) or \
-                _os.environ.get("VEGA_TPU_HOSTS_FILE")
+                os.environ.get("VEGA_TPU_HOSTS_FILE")
             if explicit:
                 from vega_tpu.hosts import Hosts
 
-                if not _os.path.exists(explicit):
+                if not os.path.exists(explicit):
                     raise NetworkError(
                         f"configured hosts file does not exist: {explicit}"
                     )
@@ -81,90 +106,140 @@ class DistributedBackend(TaskBackend):
         n = num_executors or getattr(conf, "num_executors", None) or 2
         local_hosts = hosts or ["127.0.0.1"] * n
         self._spawn_workers(local_hosts)
+        self._reaper = threading.Thread(
+            target=self._reaper_loop, name="executor-reaper", daemon=True
+        )
+        self._reaper.start()
 
     # ------------------------------------------------------------- lifecycle
-    def _spawn_workers(self, hosts: List[str]) -> None:
-        procs = []
-        for i, host in enumerate(hosts):
-            executor_id = f"exec-{i}"
-            if host in ("127.0.0.1", "localhost"):
-                cmd = [
-                    sys.executable, "-m", "vega_tpu.distributed.worker",
-                    "--driver", self.service.uri,
-                    "--executor-id", executor_id,
-                    "--log-level", str(self.conf.log_level),
-                ]
-                # Workers are host-tier compute: keep them off the TPU.
-                # Propagate the driver's logging/workdir config so session
-                # logs land (and are cleaned) consistently across the fleet.
-                worker_env = dict(
-                    os.environ, JAX_PLATFORMS="cpu",
-                    VEGA_TPU_DEPLOYMENT_MODE="distributed",
-                    VEGA_TPU_LOG_LEVEL=str(self.conf.log_level),
-                    VEGA_TPU_LOG_CLEANUP="true" if self.conf.log_cleanup else "false",
-                    VEGA_TPU_LOCAL_DIR=self.conf.local_dir,
-                )
-                worker_env.pop("PALLAS_AXON_POOL_IPS", None)
-                proc = subprocess.Popen(
-                    cmd, env=worker_env, stdout=subprocess.PIPE,
-                    stderr=subprocess.DEVNULL, text=True,
-                )
-            else:
-                # ssh launch (reference: context.rs:237-288) — assumes the
-                # package is importable on the remote host.
-                cmd = [
-                    "ssh", host, sys.executable, "-m",
-                    "vega_tpu.distributed.worker",
-                    "--driver", self.service.uri,
-                    "--executor-id", executor_id,
-                    "--host", host,
-                    "--log-level", str(self.conf.log_level),
-                ]
-                proc = subprocess.Popen(
-                    cmd, stdout=subprocess.PIPE,
-                    stderr=subprocess.DEVNULL, text=True,
-                )
-            procs.append((executor_id, host, proc))
+    def add_executor_lost_listener(self, callback: Callable) -> None:
+        """callback(executor_id, host, shuffle_uri, reason) — fired once per
+        lost executor, from the reaper thread."""
+        self._executor_lost_listeners.append(callback)
 
-        # Readiness with a real deadline: readline() blocks indefinitely, so
-        # read on a helper thread and join with the remaining time budget —
-        # a silent-but-alive worker (hung import, ssh prompt) fails loudly
-        # instead of hanging the driver.
-        deadline = time.time() + 30.0
+    def _launch(self, executor_id: str, host: str,
+                incarnation: int = 0) -> subprocess.Popen:
+        if host in ("127.0.0.1", "localhost"):
+            cmd = [
+                sys.executable, "-m", "vega_tpu.distributed.worker",
+                "--driver", self.service.uri,
+                "--executor-id", executor_id,
+                "--log-level", str(self.conf.log_level),
+            ]
+            # Workers are host-tier compute: keep them off the TPU.
+            # Propagate the driver's logging/workdir config plus the
+            # fault-tolerance knobs (fetch retry, heartbeat cadence) so
+            # Context(...)-level overrides reach the fleet, not just
+            # env-var-configured runs.
+            worker_env = dict(
+                os.environ, JAX_PLATFORMS="cpu",
+                VEGA_TPU_DEPLOYMENT_MODE="distributed",
+                VEGA_TPU_LOG_LEVEL=str(self.conf.log_level),
+                VEGA_TPU_LOG_CLEANUP="true" if self.conf.log_cleanup else "false",
+                VEGA_TPU_LOCAL_DIR=self.conf.local_dir,
+                VEGA_TPU_HEARTBEAT_INTERVAL_S=str(self.conf.heartbeat_interval_s),
+                VEGA_TPU_FETCH_RETRIES=str(self.conf.fetch_retries),
+                VEGA_TPU_FETCH_RETRY_INTERVAL_S=str(self.conf.fetch_retry_interval_s),
+                # Respawned incarnations disarm one-shot fault injections
+                # (faults.py): a chaos-killed slot comes back healthy.
+                VEGA_TPU_FAULT_INCARNATION=str(incarnation),
+            )
+            worker_env.pop("PALLAS_AXON_POOL_IPS", None)
+            return subprocess.Popen(
+                cmd, env=worker_env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True,
+            )
+        # ssh launch (reference: context.rs:237-288) — assumes the
+        # package is importable on the remote host. Popen env only reaches
+        # the local ssh client, so the fault-tolerance knobs ride the
+        # remote command line (`env K=V ...`) — a remote worker heartbeating
+        # at a default slower than the driver's liveness bound would be
+        # reaped while healthy.
+        cmd = [
+            "ssh", host, "env",
+            "VEGA_TPU_DEPLOYMENT_MODE=distributed",
+            f"VEGA_TPU_HEARTBEAT_INTERVAL_S={self.conf.heartbeat_interval_s}",
+            f"VEGA_TPU_FETCH_RETRIES={self.conf.fetch_retries}",
+            f"VEGA_TPU_FETCH_RETRY_INTERVAL_S={self.conf.fetch_retry_interval_s}",
+            f"VEGA_TPU_FAULT_INCARNATION={incarnation}",
+            sys.executable, "-m",
+            "vega_tpu.distributed.worker",
+            "--driver", self.service.uri,
+            "--executor-id", executor_id,
+            "--host", host,
+            "--log-level", str(self.conf.log_level),
+        ]
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True,
+        )
 
-        def wait_ready(executor_id, proc):
-            box: Dict[str, str] = {}
+    @staticmethod
+    def _wait_ready(executor_id: str, proc: subprocess.Popen,
+                    deadline: float) -> str:
+        """Readiness with a real deadline: readline() blocks indefinitely,
+        so read on a helper thread and join with the remaining time budget —
+        a silent-but-alive worker (hung import, ssh prompt) fails loudly
+        instead of hanging the driver."""
+        box: Dict[str, str] = {}
 
-            def reader():
+        def reader():
+            while True:
+                line = proc.stdout.readline() if proc.stdout else ""
+                if not line:
+                    return
+                if line.startswith("VEGA_WORKER_READY"):
+                    box["line"] = line
+                    return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        t.join(max(0.1, deadline - time.time()))
+        if "line" not in box:
+            if proc.poll() is not None:
+                raise NetworkError(
+                    f"worker {executor_id} exited during startup"
+                )
+            proc.kill()
+            raise NetworkError(f"worker {executor_id} never became ready")
+        return box["line"]
+
+    @staticmethod
+    def _drain_stdout(executor_id: str, proc: subprocess.Popen) -> None:
+        """Keep reading the worker's stdout after READY. The PIPE buffer is
+        ~64 KB: a chatty worker (user print()s in tasks) would otherwise
+        block on a full pipe mid-task — a silent wedge."""
+        def drain():
+            try:
                 while True:
                     line = proc.stdout.readline() if proc.stdout else ""
                     if not line:
                         return
-                    if line.startswith("VEGA_WORKER_READY"):
-                        box["line"] = line
-                        return
+                    log.debug("[%s stdout] %s", executor_id, line.rstrip())
+            except (OSError, ValueError):
+                pass
 
-            t = threading.Thread(target=reader, daemon=True)
-            t.start()
-            t.join(max(0.1, deadline - time.time()))
-            if "line" not in box:
-                if proc.poll() is not None:
-                    raise NetworkError(
-                        f"worker {executor_id} exited during startup"
-                    )
-                proc.kill()
-                raise NetworkError(f"worker {executor_id} never became ready")
-            return box["line"]
+        threading.Thread(target=drain, daemon=True,
+                         name=f"drain-{executor_id}").start()
 
+    def _spawn_workers(self, hosts: List[str]) -> None:
+        procs = []
+        for i, host in enumerate(hosts):
+            executor_id = f"exec-{i}"
+            procs.append((executor_id, host, self._launch(executor_id, host)))
+
+        deadline = time.time() + 30.0
         for executor_id, host, proc in procs:
-            line = wait_ready(executor_id, proc)
+            line = self._wait_ready(executor_id, proc, deadline)
             _tag, wid, task_uri = line.split()
             with self._lock:
                 self._executors[wid] = _Executor(wid, task_uri, host, proc)
+            self._drain_stdout(wid, proc)
         log.info("distributed backend up: %d executors", len(self._executors))
 
     def stop(self) -> None:
         self._stopped = True
+        self._stop_event.set()
         with self._lock:
             executors = list(self._executors.values())
         for ex in executors:
@@ -180,7 +255,165 @@ class DistributedBackend(TaskBackend):
                     ex.process.wait(timeout=5.0)
                 except subprocess.TimeoutExpired:
                     ex.process.kill()
+        if self._reaper.is_alive():
+            self._reaper.join(timeout=2.0)
         self.service.stop()
+
+    # --------------------------------------------------------------- liveness
+    def _reaper_loop(self) -> None:
+        """Driver-side liveness sweep: workers heartbeat into
+        DriverService.workers; this thread is the thing that finally READS
+        last_seen (the reference stored it and never looked)."""
+        while not self._stop_event.wait(self.conf.executor_reap_interval_s):
+            try:
+                self._sweep()
+            except Exception:  # noqa: BLE001 — the reaper must survive
+                log.exception("liveness sweep failed")
+
+    def _sweep(self) -> None:
+        live = self.service.live_workers()
+        with self._lock:
+            suspects = [ex for ex in self._executors.values() if not ex.reaped]
+        for ex in suspects:
+            if ex.process is not None and ex.process.poll() is not None:
+                self._mark_lost(ex, "process exited")
+            elif ex.executor_id in self.service.workers \
+                    and ex.executor_id not in live:
+                self._mark_lost(ex, "heartbeat timeout")
+        if not self._stopped:
+            self._maybe_respawn()
+
+    def _mark_lost(self, ex: _Executor, reason: str) -> None:
+        with self._lock:
+            if ex.reaped:
+                return
+            ex.reaped = True
+            ex.alive = False
+            ex.lost_at = time.time()
+            inflight = list(ex.sockets)
+        log.warning("executor %s lost (%s); failing over its in-flight "
+                    "tasks", ex.executor_id, reason)
+        info = self.service.workers.get(ex.executor_id) or {}
+        shuffle_uri = info.get("shuffle_uri")
+        # A wedged-but-alive local worker holds its port and its half of
+        # every open socket: kill it so the slot can respawn cleanly.
+        if ex.process is not None and ex.process.poll() is None:
+            ex.process.kill()
+        # For ssh slots that Popen is only the LOCAL ssh client — the
+        # remote worker survives it and would collide with a respawned
+        # incarnation under the same executor_id. Best-effort remote kill
+        # by the pid the worker registered, off-thread (the reaper must
+        # not block on a dead host's ssh timeout).
+        if ex.host not in ("127.0.0.1", "localhost") and info.get("pid"):
+            def remote_kill(host=ex.host, pid=info["pid"]):
+                try:
+                    subprocess.run(["ssh", host, "kill", "-9", str(pid)],
+                                   timeout=15.0,
+                                   stdout=subprocess.DEVNULL,
+                                   stderr=subprocess.DEVNULL)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            threading.Thread(target=remote_kill, daemon=True,
+                             name=f"remote-kill-{ex.executor_id}").start()
+        # Unblock dispatch threads parked in recv() on this executor; their
+        # NetworkError path re-dispatches to survivors.
+        for sock in inflight:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        # Invalidate its map outputs: generation bump -> reducers refetch;
+        # the DAG scheduler listener scrubs Stage.output_locs so the holes
+        # are recomputed on resubmission.
+        tracker = self.service.map_output_tracker
+        removed = 0
+        if shuffle_uri and hasattr(tracker, "unregister_server_outputs"):
+            removed = tracker.unregister_server_outputs(shuffle_uri)
+        if removed:
+            log.info("unregistered %d map outputs of lost executor %s",
+                     removed, ex.executor_id)
+        for callback in list(self._executor_lost_listeners):
+            try:
+                callback(ex.executor_id, ex.host, shuffle_uri, reason)
+            except Exception:  # noqa: BLE001 — observers must not kill the reaper
+                log.exception("executor-lost listener raised")
+        sink = self.event_sink
+        if sink is not None:
+            sink(ev.ExecutorLost(executor_id=ex.executor_id, host=ex.host,
+                                 reason=reason))
+
+    # ---------------------------------------------------------------- respawn
+    def _respawn_possible(self) -> bool:
+        """Any dead slot with restart budget left (or a respawn already in
+        flight)? Dispatchers with zero live executors wait on this instead
+        of burning max_failures in milliseconds while a worker boots. A
+        slot the dispatcher marked dead but the reaper has not swept yet
+        (reaped=False) counts too — the sweep that will respawn it is at
+        most executor_reap_interval_s away."""
+        with self._lock:
+            return any(not ex.alive and ex.process is not None
+                       and (ex.respawning
+                            or ex.restarts < self.conf.executor_max_restarts)
+                       for ex in self._executors.values())
+
+    def _maybe_respawn(self) -> None:
+        with self._lock:
+            dead = [ex for ex in self._executors.values()
+                    if ex.reaped and ex.process is not None
+                    and not ex.respawning]
+        for ex in dead:
+            if self._stop_event.is_set():
+                return
+            if ex.restarts >= self.conf.executor_max_restarts:
+                continue
+            backoff = self.conf.executor_restart_backoff_s * (2 ** ex.restarts)
+            if time.time() - ex.lost_at < backoff:
+                continue
+            with self._lock:
+                if ex.respawning:
+                    continue
+                ex.respawning = True
+            # Off the reaper thread: a replacement that hangs before READY
+            # would otherwise suspend liveness detection for every OTHER
+            # executor for up to the 30s readiness deadline.
+            threading.Thread(target=self._respawn, args=(ex,), daemon=True,
+                             name=f"respawn-{ex.executor_id}").start()
+
+    def _respawn(self, ex: _Executor) -> None:
+        if self._stop_event.is_set():
+            ex.respawning = False
+            return
+        attempt = ex.restarts + 1
+        log.warning("respawning executor %s (restart %d/%d)",
+                    ex.executor_id, attempt, self.conf.executor_max_restarts)
+        try:
+            proc = self._launch(ex.executor_id, ex.host, incarnation=attempt)
+            line = self._wait_ready(ex.executor_id, proc, time.time() + 30.0)
+            _tag, wid, task_uri = line.split()
+        except (NetworkError, ValueError) as e:
+            log.warning("respawn of %s failed: %s", ex.executor_id, e)
+            # Count the failed attempt so backoff keeps growing and the
+            # restart cap still binds.
+            ex.restarts = attempt
+            ex.lost_at = time.time()
+            ex.respawning = False
+            return
+        fresh = _Executor(wid, task_uri, ex.host, proc, restarts=attempt)
+        with self._lock:
+            if self._stopped:
+                # stop() raced us while we waited for readiness: the fleet
+                # it snapshotted is already down — don't leak a live worker
+                # past the Context's lifetime.
+                proc.kill()
+                ex.respawning = False
+                return
+            self._executors[wid] = fresh
+            ex.respawning = False
+        self._drain_stdout(wid, proc)
+        sink = self.event_sink
+        if sink is not None:
+            sink(ev.ExecutorRestarted(executor_id=wid, host=ex.host,
+                                      attempt=attempt))
 
     # -------------------------------------------------------------- dispatch
     @property
@@ -191,11 +424,16 @@ class DistributedBackend(TaskBackend):
 
     def _pick_executor(self, task: Task) -> _Executor:
         """Round-robin + pinned-host seek
-        (reference: distributed_scheduler.rs:447-469)."""
+        (reference: distributed_scheduler.rs:447-469), skipping blacklisted
+        repeat offenders while any clean executor is alive."""
         with self._lock:
             alive = [e for e in self._executors.values() if e.alive]
             if not alive:
                 raise NetworkError("no live executors")
+            threshold = self.conf.executor_blacklist_threshold
+            clean = [e for e in alive if e.failures < threshold]
+            if clean:
+                alive = clean  # blacklist is advisory: better flaky than none
             if task.pinned and task.preferred_locs:
                 for e in alive:
                     if e.host in task.preferred_locs or \
@@ -220,29 +458,72 @@ class DistributedBackend(TaskBackend):
 
         def _dispatch_loop():
             attempts = 0
+            # Total momentary loss (every executor dead at once) must not
+            # burn max_failures in milliseconds while a respawn that WOULD
+            # recover the fleet is still booting: wait out the restart
+            # budget before declaring the task undispatchable.
+            no_executor_deadline = None
             while True:
                 try:
                     executor = self._pick_executor(task)
                 except NetworkError as e:
+                    if not self._stopped and self._respawn_possible():
+                        if no_executor_deadline is None:
+                            conf = self.conf
+                            budget = sum(
+                                conf.executor_restart_backoff_s * (2 ** k)
+                                for k in range(conf.executor_max_restarts)
+                            ) + 35.0  # + readiness deadline headroom
+                            no_executor_deadline = time.time() + budget
+                        if time.time() < no_executor_deadline:
+                            time.sleep(0.25)
+                            continue
                     callback(TaskEndEvent(task=task, success=False, error=e))
                     return
+                no_executor_deadline = None
                 try:
                     host, port = protocol.parse_uri(executor.task_uri)
                     with protocol.connect(host, port) as sock:
-                        protocol.send_msg(sock, "task", payload)
-                        # The result wait is unbounded: tasks may legitimately
-                        # run for hours. Executor death is detected by the OS
-                        # (socket reset; keepalive covers remote hosts), not
-                        # by an arbitrary IO timeout.
-                        sock.settimeout(None)
-                        sock.setsockopt(socket.SOL_SOCKET,
-                                        socket.SO_KEEPALIVE, 1)
-                        reply_type, _ = protocol.recv_msg(sock)
-                        if reply_type != "result":
-                            raise NetworkError(f"bad reply {reply_type}")
-                        status, *rest = serialization.loads(
-                            protocol.recv_bytes(sock)
-                        )
+                        # Register with the executor so the liveness reaper
+                        # can shut this socket down and unblock us if the
+                        # executor wedges (alive but silent) mid-task. The
+                        # reaped check and the add share one lock acquisition
+                        # with _mark_lost's snapshot: a socket is either in
+                        # the snapshot (shut down by the reaper) or refused
+                        # here — never silently parked on a dead executor.
+                        with self._lock:
+                            if executor.reaped:
+                                raise NetworkError(
+                                    f"executor {executor.executor_id} was "
+                                    "reaped while connecting"
+                                )
+                            executor.sockets.add(sock)
+                        try:
+                            protocol.send_msg(sock, "task", payload)
+                            # The result wait is unbounded: tasks may
+                            # legitimately run for hours. Executor death is
+                            # detected by the OS (socket reset; keepalive
+                            # covers remote hosts) or by the reaper — not
+                            # by an arbitrary IO timeout.
+                            sock.settimeout(None)
+                            sock.setsockopt(socket.SOL_SOCKET,
+                                            socket.SO_KEEPALIVE, 1)
+                            reply_type, _ = protocol.recv_msg(sock)
+                            if reply_type != "result":
+                                raise NetworkError(f"bad reply {reply_type}")
+                            status, *rest = serialization.loads(
+                                protocol.recv_bytes(sock)
+                            )
+                        finally:
+                            with self._lock:
+                                executor.sockets.discard(sock)
+                    # Transport round-trip succeeded (whatever the task's
+                    # own outcome): the executor is healthy — clear its
+                    # blacklist count so only CONSECUTIVE transport
+                    # failures blacklist it, not a lifetime's worth of
+                    # recovered blips.
+                    with self._lock:
+                        executor.failures = 0
                     if status == "success":
                         result, duration = rest
                         callback(TaskEndEvent(task=task, success=True,
@@ -262,8 +543,12 @@ class DistributedBackend(TaskBackend):
                     log.warning("executor %s unreachable (%s); re-dispatching",
                                 executor.executor_id, e)
                     with self._lock:
-                        executor.alive = executor.process is not None and \
-                            executor.process.poll() is None
+                        executor.failures += 1
+                        if executor.reaped:
+                            executor.alive = False  # never resurrect
+                        else:
+                            executor.alive = executor.process is not None and \
+                                executor.process.poll() is None
                     if attempts >= 3 + len(self._executors):
                         callback(TaskEndEvent(task=task, success=False, error=e))
                         return

@@ -15,7 +15,7 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from vega_tpu.cache_tracker import CacheTracker
 from vega_tpu.distributed import protocol
@@ -48,9 +48,13 @@ class DriverService:
 
     def __init__(self, map_output_tracker: MapOutputTracker,
                  cache_tracker: CacheTracker,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 liveness_timeout_s: float = 30.0):
         self.map_output_tracker = map_output_tracker
         self.cache_tracker = cache_tracker
+        # Default staleness bound for live_workers(): wired from
+        # Configuration.executor_liveness_timeout_s by the backend.
+        self.liveness_timeout_s = liveness_timeout_s
         self.workers: Dict[str, dict] = {}  # executor_id -> info
         self._lock = threading.Lock()
         self._server = socketserver.ThreadingTCPServer(
@@ -99,7 +103,9 @@ class DriverService:
             return self.cache_tracker.get_cache_locs(rdd_id, partition)
         raise ValueError(f"unknown message type: {msg_type}")
 
-    def live_workers(self, max_age: float = 30.0) -> Dict[str, dict]:
+    def live_workers(self, max_age: Optional[float] = None) -> Dict[str, dict]:
+        if max_age is None:
+            max_age = self.liveness_timeout_s
         now = time.time()
         with self._lock:
             return {
@@ -129,13 +135,23 @@ class RemoteTrackerClient:
         return sock
 
     def _call(self, msg_type: str, payload=None):
-        try:
-            sock = self._sock()
-            protocol.send_msg(sock, msg_type, payload)
-            reply_type, reply = protocol.recv_msg(sock)
-        except NetworkError:
-            self._local.sock = None
-            raise
+        # A broken cached socket (driver restarted its listener thread, an
+        # idle connection reaped by the OS, a half-closed pipe) must not
+        # fail the call permanently while the driver itself is healthy:
+        # reconnect and retry ONCE. Safe to repeat — every tracker message
+        # is idempotent (registration/heartbeat upserts, queries).
+        for attempt in (0, 1):
+            try:
+                sock = self._sock()
+                protocol.send_msg(sock, msg_type, payload)
+                reply_type, reply = protocol.recv_msg(sock)
+                break
+            except NetworkError:
+                self._local.sock = None
+                if attempt:
+                    raise
+                log.debug("tracker call %s failed on cached socket; "
+                          "reconnecting", msg_type)
         if reply_type == "error":
             raise NetworkError(f"driver error for {msg_type}: {reply}")
         return reply

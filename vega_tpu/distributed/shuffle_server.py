@@ -16,8 +16,10 @@ import logging
 import socket
 import socketserver
 import threading
+import time
 from typing import Optional
 
+from vega_tpu import faults
 from vega_tpu.distributed import protocol
 from vega_tpu.errors import FetchFailedError, NetworkError
 
@@ -33,6 +35,11 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 msg_type, payload = protocol.recv_msg(sock)
                 if msg_type == "get":
+                    if faults.get().serve_fetch():
+                        # Injected transient fault: drop the connection
+                        # without replying — the client sees a dead socket
+                        # and must recover via in-place retry.
+                        return
                     shuffle_id, map_id, reduce_id = payload
                     data = store.get(shuffle_id, map_id, reduce_id)
                     if data is None:
@@ -108,22 +115,42 @@ def _drop_connection(uri: str) -> None:
 
 
 def fetch_remote(uri: str, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
-    """Fetch one bucket; raises FetchFailedError so the DAG scheduler can
-    run its recovery path (unlike the reference, where a failed fetch
-    panics the event loop — SURVEY.md §5)."""
+    """Fetch one bucket; transient socket failures are retried in place
+    (conf-driven attempts with linear backoff) before escalating to
+    FetchFailedError — one dropped connection must not cost a whole stage
+    resubmission. A server answering "missing" escalates immediately: the
+    data is genuinely gone and only the map-stage recovery path (unlike
+    the reference, where a failed fetch panics the event loop —
+    SURVEY.md §5) can bring it back."""
+    from vega_tpu.env import Env
+
+    conf = Env.get().conf
+    attempts = max(1, int(getattr(conf, "fetch_retries", 3)))
+    interval = float(getattr(conf, "fetch_retry_interval_s", 0.2))
     key = (shuffle_id, map_id, reduce_id)
-    try:
-        sock = _pooled_connection(uri)
-        protocol.send_msg(sock, "get", key)
-        reply_type, _ = protocol.recv_msg(sock)
-        if reply_type == "missing":
+    last_error: Optional[NetworkError] = None
+    for attempt in range(attempts):
+        try:
+            sock = _pooled_connection(uri)
+            protocol.send_msg(sock, "get", key)
+            reply_type, _ = protocol.recv_msg(sock)
+            if reply_type == "missing":
+                _drop_connection(uri)
+                raise FetchFailedError(uri, shuffle_id, map_id, reduce_id,
+                                       "server has no such bucket")
+            return protocol.recv_bytes(sock)
+        except NetworkError as e:
             _drop_connection(uri)
-            raise FetchFailedError(uri, shuffle_id, map_id, reduce_id,
-                                   "server has no such bucket")
-        return protocol.recv_bytes(sock)
-    except NetworkError as e:
-        _drop_connection(uri)
-        raise FetchFailedError(uri, shuffle_id, map_id, reduce_id, str(e)) from e
+            last_error = e
+            if attempt + 1 < attempts:
+                log.warning("transient fetch failure from %s (attempt %d/%d):"
+                            " %s; retrying in place", uri, attempt + 1,
+                            attempts, e)
+                time.sleep(interval * (attempt + 1))
+    raise FetchFailedError(
+        uri, shuffle_id, map_id, reduce_id,
+        f"fetch failed after {attempts} attempts: {last_error}",
+    ) from last_error
 
 
 def check_status(uri: str, timeout: float = 5.0) -> Optional[dict]:

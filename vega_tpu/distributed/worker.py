@@ -28,7 +28,7 @@ import sys
 import threading
 import time
 import traceback
-from vega_tpu import serialization
+from vega_tpu import faults, serialization
 from vega_tpu.distributed import protocol
 from vega_tpu.distributed.driver_service import RemoteTrackerClient
 from vega_tpu.distributed.shuffle_server import ShuffleServer
@@ -65,8 +65,13 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         # starve the very map task that unblocks it.
         t0 = time.time()
         try:
+            faults.get().maybe_hang_task()  # chaos: wedged-but-alive worker
             task = serialization.loads(payload)
             result = task.run()
+            # Chaos kill point: AFTER the task computed (shuffle buckets
+            # may be registered locally) but BEFORE the driver hears back —
+            # the loss mode that exercises re-dispatch + output recovery.
+            faults.get().maybe_kill_worker()
             reply = serialization.dumps(("success", result, time.time() - t0))
             protocol.send_msg(sock, "result", None)
             protocol.send_bytes(sock, reply)
@@ -133,11 +138,15 @@ class Worker:
     def request_shutdown(self) -> None:
         self._shutdown.set()
 
-    def serve_forever(self, heartbeat_s: float = 5.0) -> None:
+    def serve_forever(self, heartbeat_s: float | None = None) -> None:
+        if heartbeat_s is None:
+            heartbeat_s = Env.get().conf.heartbeat_interval_s
         threading.Thread(
             target=self._server.serve_forever, name="task-server", daemon=True
         ).start()
         while not self._shutdown.wait(heartbeat_s):
+            if faults.get().suppress_heartbeat():
+                continue  # chaos: alive but silent — the reaper's problem
             try:
                 self.tracker.heartbeat(self.executor_id)
             except NetworkError:

@@ -71,6 +71,32 @@ class Configuration:
     # Max task retries before failing the job (reference plumbs max_failures
     # but never enforces it, local_scheduler.rs:29,57 — we enforce it).
     max_failures: int = 4
+    # --- executor fault tolerance (distributed mode) ---
+    # Worker -> driver heartbeat period. Must be well under
+    # executor_liveness_timeout_s or healthy workers get reaped.
+    heartbeat_interval_s: float = 2.0
+    # A registered executor whose last heartbeat is older than this is
+    # declared lost: its map outputs are unregistered (generation bump),
+    # its in-flight dispatches are failed over, and ExecutorLost is
+    # emitted. Detects wedged-but-alive workers, not just dead sockets.
+    executor_liveness_timeout_s: float = 30.0
+    # Reaper sweep period (driver-side liveness thread).
+    executor_reap_interval_s: float = 5.0
+    # Dead local/ssh workers are respawned up to this many times per slot
+    # with exponential backoff; 0 disables respawn.
+    executor_max_restarts: int = 3
+    # Base respawn delay; attempt k waits backoff * 2**k.
+    executor_restart_backoff_s: float = 1.0
+    # Executors accumulating this many dispatch failures are skipped by
+    # _pick_executor while any non-blacklisted executor is alive (repeat
+    # offenders stop eating task attempts).
+    executor_blacklist_threshold: int = 5
+    # Transient shuffle-fetch retry: a dropped connection is retried in
+    # place this many times (with linear backoff fetch_retry_interval_s)
+    # before escalating to FetchFailedError and a stage resubmission.
+    # A server answering "missing" escalates immediately (not transient).
+    fetch_retries: int = 3
+    fetch_retry_interval_s: float = 0.2
     # Dense-tier shuffle collective: "all_to_all" (one fused collective,
     # [n_shards x slot] peak buffer) or "ring" (n-1 ppermute steps, one-slot
     # peak buffer — for big blocks on big meshes). See tpu/ring.py.
@@ -149,7 +175,8 @@ class Configuration:
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",
                      "CACHE_CAPACITY_BYTES", "MAX_FAILURES",
                      "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
-                     "SHUFFLE_SPILL_THRESHOLD"):
+                     "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
+                     "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
@@ -157,7 +184,10 @@ class Configuration:
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
         for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",
-                     "SPECULATION_MULTIPLIER", "SPECULATION_MIN_S"):
+                     "SPECULATION_MULTIPLIER", "SPECULATION_MIN_S",
+                     "HEARTBEAT_INTERVAL_S", "EXECUTOR_LIVENESS_TIMEOUT_S",
+                     "EXECUTOR_REAP_INTERVAL_S", "EXECUTOR_RESTART_BACKOFF_S",
+                     "FETCH_RETRY_INTERVAL_S"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), float(env[pref + name]))
         return cfg

@@ -1,0 +1,260 @@
+"""Fault-injection harness: env/conf-driven failure points for chaos tests.
+
+The reference built failure-detection scaffolding but never exercised it
+(SURVEY.md §5: executor loss is "retry connect 5x then panic"; FetchFailed
+is never emitted). vega_tpu's recovery paths are only trustworthy if they
+are *driven*, so this module provides deterministic injection points that
+the distributed plane consults at its natural failure seams:
+
+  - worker.py      -> maybe_kill_worker() (SIGKILL self after N tasks),
+                      maybe_hang_task() (wedge: alive but not progressing),
+                      suppress_heartbeat() (wedge: alive but silent)
+  - shuffle_server -> serve_fetch() (drop the connection / delay the reply
+                      for the first N bucket gets — a transient network
+                      fault the fetch-retry path must absorb)
+  - shuffle/store  -> corrupt_spilled(disk, key) (flip payload bytes in a
+                      spilled bucket file — the checksummed read must turn
+                      it into a miss, never wrong data)
+
+Configuration is via VEGA_TPU_FAULT_* environment variables so injections
+propagate into spawned executor subprocesses (DistributedBackend copies
+os.environ), plus a programmatic configure() for same-process (local-mode)
+tests:
+
+  VEGA_TPU_FAULT_EXECUTOR            only this executor id is affected
+                                     (empty -> every process)
+  VEGA_TPU_FAULT_KILL_AFTER_TASKS    SIGKILL self after N completed tasks
+  VEGA_TPU_FAULT_HANG_TASKS          1 -> task handlers sleep forever
+  VEGA_TPU_FAULT_SUPPRESS_HEARTBEATS 1 -> stop heartbeating (stay alive)
+  VEGA_TPU_FAULT_FETCH_DROP_N        drop the first N shuffle-bucket gets
+  VEGA_TPU_FAULT_FETCH_DELAY_S       delay every served get by S seconds
+  VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
+  VEGA_TPU_FAULT_STATS_DIR           append one JSON line per injected
+                                     fault to <dir>/faults-<pid>.jsonl so
+                                     cross-process tests can assert the
+                                     fault actually fired
+  VEGA_TPU_FAULT_INCARNATION         set by the backend on respawned
+                                     workers; faults are disarmed for
+                                     incarnation > 0 (so a respawned
+                                     worker is healthy) unless
+                                     VEGA_TPU_FAULT_ALL_INCARNATIONS=1
+
+Injection decisions are counter-based (first N), never random: chaos tests
+must be deterministic on a 1-core sandbox.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("vega_tpu")
+
+
+class FaultInjector:
+    def __init__(self, environ=None):
+        env = os.environ if environ is None else environ
+        pref = "VEGA_TPU_FAULT_"
+
+        def _int(name: str, default: int = 0) -> int:
+            raw = env.get(pref + name, "")
+            try:
+                return int(raw) if raw else default
+            except ValueError:
+                return default
+
+        def _float(name: str, default: float = 0.0) -> float:
+            raw = env.get(pref + name, "")
+            try:
+                return float(raw) if raw else default
+            except ValueError:
+                return default
+
+        def _flag(name: str) -> bool:
+            return env.get(pref + name, "").lower() in ("1", "true")
+
+        incarnation = _int("INCARNATION", 0)
+        armed = incarnation == 0 or _flag("ALL_INCARNATIONS")
+
+        self.executor_filter: Optional[str] = env.get(pref + "EXECUTOR") or None
+        self.kill_after_tasks = _int("KILL_AFTER_TASKS") if armed else 0
+        self.hang_tasks = armed and _flag("HANG_TASKS")
+        self.suppress_heartbeats = armed and _flag("SUPPRESS_HEARTBEATS")
+        self.fetch_drop_n = _int("FETCH_DROP_N") if armed else 0
+        self.fetch_delay_s = _float("FETCH_DELAY_S") if armed else 0.0
+        self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
+        self.stats_dir = env.get(pref + "STATS_DIR") or None
+
+        self._tasks_done = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- targeting
+    @property
+    def active(self) -> bool:
+        """Cheap gate for the hot paths: anything armed at all?"""
+        return bool(
+            self.kill_after_tasks or self.hang_tasks
+            or self.suppress_heartbeats or self.fetch_drop_n
+            or self.fetch_delay_s or self.corrupt_spill_n
+        )
+
+    def _targets_me(self) -> bool:
+        """Executor filter is evaluated per hook call: Env.executor_id is
+        set after process bootstrap, possibly after this injector exists."""
+        if self.executor_filter is None:
+            return True
+        from vega_tpu.env import Env
+
+        return Env.get().executor_id == self.executor_filter
+
+    # ----------------------------------------------------------------- hooks
+    def maybe_hang_task(self) -> None:
+        """worker.py, before running a task: simulate a wedged-but-alive
+        executor (the process responds to nothing but never dies)."""
+        if not (self.active and self.hang_tasks and self._targets_me()):
+            return
+        self._record("hang_task")
+        log.warning("FAULT: hanging task handler (wedged executor)")
+        while True:
+            time.sleep(3600.0)
+
+    def maybe_kill_worker(self) -> None:
+        """worker.py, after a task computes but BEFORE its result is sent:
+        the most brutal loss point — the driver sees the socket die with
+        the task unacknowledged."""
+        if not (self.active and self.kill_after_tasks and self._targets_me()):
+            return
+        with self._lock:
+            self._tasks_done += 1
+            due = self._tasks_done >= self.kill_after_tasks
+        if due:
+            self._record("kill_worker")
+            log.warning("FAULT: SIGKILL self after %d tasks", self._tasks_done)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def suppress_heartbeat(self) -> bool:
+        """worker.py heartbeat loop: True -> skip this beat (stay alive)."""
+        if not (self.active and self.suppress_heartbeats and self._targets_me()):
+            return False
+        self._record("suppress_heartbeat")
+        return True
+
+    def serve_fetch(self) -> bool:
+        """shuffle_server.py, on each bucket get: True -> the server must
+        drop the connection without replying (transient network fault).
+        Applies the configured delay first."""
+        if not (self.active and self._targets_me()):
+            return False
+        if self.fetch_delay_s:
+            self._record("fetch_delay")
+            time.sleep(self.fetch_delay_s)
+        with self._lock:
+            if self.fetch_drop_n <= 0:
+                return False
+            self.fetch_drop_n -= 1
+        self._record("fetch_drop")
+        log.warning("FAULT: dropping shuffle fetch connection")
+        return True
+
+    def corrupt_spilled(self, disk_store, key: str) -> None:
+        """shuffle/store.py, after a bucket spills: flip payload bytes in
+        the on-disk file. The checksummed read must surface this as a
+        miss -> FetchFailed -> stage retry, never as wrong data."""
+        if not (self.active and self.corrupt_spill_n and self._targets_me()):
+            return
+        with self._lock:
+            if self.corrupt_spill_n <= 0:
+                return
+            self.corrupt_spill_n -= 1
+        path = disk_store.path_of(key)
+        if path is None:
+            return
+        try:
+            with open(path, "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                last = f.read(1)
+                f.seek(-1, os.SEEK_END)
+                f.write(bytes([last[0] ^ 0xFF]))
+        except OSError:
+            log.warning("FAULT: corrupt_spilled(%s) could not write", key)
+            return
+        self._record("corrupt_spill", key=key)
+        log.warning("FAULT: corrupted spilled bucket %s", key)
+
+    # ------------------------------------------------------------- recording
+    def _record(self, kind: str, **extra) -> None:
+        """Best-effort evidence trail: cross-process tests assert the fault
+        actually fired by reading these lines (a chaos test that injects
+        nothing proves nothing)."""
+        if self.stats_dir is None:
+            return
+        try:
+            os.makedirs(self.stats_dir, exist_ok=True)
+            line = json.dumps(dict(fault=kind, pid=os.getpid(),
+                                   time=time.time(), **extra))
+            with open(os.path.join(self.stats_dir,
+                                   f"faults-{os.getpid()}.jsonl"), "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+
+
+_injector: Optional[FaultInjector] = None
+_injector_lock = threading.Lock()
+
+
+def get() -> FaultInjector:
+    """Process-local injector, built lazily from the environment."""
+    global _injector
+    if _injector is None:
+        with _injector_lock:
+            if _injector is None:
+                _injector = FaultInjector()
+    return _injector
+
+
+def configure(**fields) -> FaultInjector:
+    """Same-process (local-mode) test hook: build a fresh injector from the
+    current environment, then override attributes directly."""
+    global _injector
+    with _injector_lock:
+        inj = FaultInjector()
+        for name, value in fields.items():
+            if not hasattr(inj, name):
+                raise AttributeError(f"unknown fault field: {name}")
+            setattr(inj, name, value)
+        _injector = inj
+    return inj
+
+
+def reset() -> None:
+    """Drop the cached injector (tests: env vars changed since first use)."""
+    global _injector
+    with _injector_lock:
+        _injector = None
+
+
+def read_stats(stats_dir: str):
+    """All recorded fault lines across every process (chaos-test assert)."""
+    out = []
+    try:
+        names = os.listdir(stats_dir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not name.startswith("faults-"):
+            continue
+        try:
+            with open(os.path.join(stats_dir, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except (OSError, ValueError):
+            continue
+    return out

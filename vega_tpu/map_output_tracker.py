@@ -54,6 +54,23 @@ class MapOutputTracker:
             self._generation += 1
             self._cond.notify_all()
 
+    def unregister_server_outputs(self, uri: str) -> int:
+        """Executor loss: null every map output served by `uri` across all
+        shuffles in one sweep, bumping the generation ONCE so reducers
+        refetch (the reaper's bulk edition of unregister_map_output).
+        Returns the number of outputs invalidated."""
+        removed = 0
+        with self._cond:
+            for locs in self._outputs.values():
+                for i, u in enumerate(locs):
+                    if u == uri:
+                        locs[i] = None
+                        removed += 1
+            if removed:
+                self._generation += 1
+                self._cond.notify_all()
+        return removed
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             self._outputs.pop(shuffle_id, None)

@@ -91,11 +91,25 @@ class DAGScheduler:
         self.bus = bus or ev.LiveListenerBus()
         self._next_stage_id = itertools.count(0)
         self._shuffle_to_map_stage: Dict[int, Stage] = {}
+        # Fault-tolerant backends (distributed/backend.py) surface executor
+        # loss: scrub the lost server's locations from every cached map
+        # stage so resubmission recomputes exactly the lost partitions, and
+        # give the backend the bus so ExecutorLost/ExecutorRestarted events
+        # are observable alongside scheduler events.
+        if hasattr(backend, "add_executor_lost_listener"):
+            backend.add_executor_lost_listener(self._on_executor_lost)
+        if getattr(backend, "event_sink", False) is None:
+            backend.event_sink = self.bus.post
         # One job at a time, like the reference's scheduler_lock
         # (distributed_scheduler.rs:183-187). Jobs from multiple driver
         # threads serialize here. Reentrant: materializing a checkpoint
         # (_do_checkpoint) legitimately nests a job inside job setup.
         self._job_lock = threading.RLock()
+        # The in-flight job, visible to the reaper callback: executor loss
+        # must proactively fail the affected stages of a RUNNING job (see
+        # _on_executor_lost) — recovery cannot depend on a reducer
+        # happening to observe a FetchFailed.
+        self._active_job: Optional[_Job] = None
 
     # ------------------------------------------------------------- public API
     def run_job(self, rdd, func, partitions: Optional[List[int]] = None) -> list:
@@ -393,6 +407,7 @@ class DAGScheduler:
                 ) from err
 
         try:
+            self._active_job = job
             submit_stage(final_stage)
             while job.num_finished < len(partitions):
                 try:
@@ -426,8 +441,46 @@ class DAGScheduler:
             self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=False,
                                     duration_s=time.time() - t_start))
             raise
+        finally:
+            self._active_job = None
 
     # ------------------------------------------------------------- internals
+    def _on_executor_lost(self, executor_id: str, host: str,
+                          shuffle_uri: Optional[str], reason: str) -> None:
+        """Reaper callback (reaper thread): drop the lost executor's server
+        from every cached map stage's output_locs. The tracker side was
+        already invalidated by the backend (generation bump); without this
+        scrub, submit_missing_tasks would see the stale location and skip
+        recomputing exactly the partitions that died. List replacement is
+        atomic under the GIL, so racing the event loop is safe.
+
+        Stages of the RUNNING job that lost outputs are additionally marked
+        failed so the event loop resubmits them proactively. Without this,
+        recovery would hinge on some reduce task observing a FetchFailed —
+        but if the loss lands between map registration and the reducers'
+        location resolve, every reducer parks inside get_server_uris on the
+        nulled entries and no fetch ever fails: the job would stall until
+        resolve timeouts exhaust max_failures."""
+        if not shuffle_uri:
+            return
+        lost_stages = []
+        for stage in list(self._shuffle_to_map_stage.values()):
+            before = stage.num_available_outputs
+            stage.remove_outputs_on_server(shuffle_uri)
+            if stage.num_available_outputs < before:
+                lost_stages.append(stage)
+        job = self._active_job
+        if job is None or not lost_stages:
+            return
+        for stage in lost_stages:
+            # Only stages this job actually touched (pending_tasks keeps a
+            # per-job record); foreign shuffles recover lazily on their
+            # next submission instead of being recomputed now.
+            if stage.id in job.pending_tasks or stage in job.waiting:
+                job.running.discard(stage)
+                job.failed.add(stage)
+                job.last_fetch_failure = time.time()
+
     def _stage_by_id(self, stage_id: int) -> Optional[Stage]:
         for stage in self._shuffle_to_map_stage.values():
             if stage.id == stage_id:
@@ -463,6 +516,7 @@ class DAGScheduler:
         else:
             # Some outputs got invalidated while we ran; resubmit the holes
             # (reference: base_scheduler.rs:317-334).
+            self.bus.post(ev.StageResubmitted(stage_id=stage.id))
             submit_missing_tasks(stage)
             job.running.add(stage)
 
@@ -473,9 +527,13 @@ class DAGScheduler:
         if time.time() - job.last_fetch_failure < conf.resubmit_timeout_s:
             return
         to_retry = list(job.failed)
-        job.failed.clear()
+        # Remove exactly what we snapshotted — clear() would silently drop
+        # a stage the reaper thread added between the snapshot and here,
+        # and a dropped stage is never resubmitted.
+        job.failed.difference_update(to_retry)
         log.info("resubmitting failed stages: %s", to_retry)
         for stage in to_retry:
+            self.bus.post(ev.StageResubmitted(stage_id=stage.id))
             submit_stage(stage)
 
     def _maybe_speculate(self, job: _Job, conf, event_queue) -> None:

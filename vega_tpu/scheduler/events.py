@@ -65,6 +65,37 @@ class TaskEnd(Event):
 
 
 @dataclasses.dataclass
+class ExecutorLost(Event):
+    """The liveness reaper (or a dead dispatch socket) declared an executor
+    gone: its map outputs were unregistered (tracker generation bumped) and
+    its in-flight dispatches failed over to survivors."""
+
+    executor_id: str = ""
+    host: str = ""
+    reason: str = ""  # "process exited" | "heartbeat timeout" | ...
+
+
+@dataclasses.dataclass
+class ExecutorRestarted(Event):
+    """A dead worker slot was respawned (capped restarts, exponential
+    backoff); `attempt` counts restarts of that slot, starting at 1."""
+
+    executor_id: str = ""
+    host: str = ""
+    attempt: int = 0
+
+
+@dataclasses.dataclass
+class StageResubmitted(Event):
+    """A failed stage re-entered submission after a fetch failure — the
+    coarse recovery path. In-place fetch retries (transient socket drops)
+    deliberately do NOT produce this event; chaos tests key on that
+    distinction."""
+
+    stage_id: int = -1
+
+
+@dataclasses.dataclass
 class BlockSpilled(Event):
     """A block left RAM for the disk tier (store/ TieredCache demotion,
     ShuffleStore memory-pressure spill, or a dense-tier block demotion)."""
@@ -174,6 +205,11 @@ class MetricsListener(Listener):
         self.promoted_bytes: Dict[str, int] = {}
         self.spill_count = 0
         self.promote_count = 0
+        # Fault-tolerance counters: chaos tests distinguish in-place fetch
+        # retry (no resubmits) from the executor-loss resubmit path.
+        self.executors_lost = 0
+        self.executors_restarted = 0
+        self.stages_resubmitted = 0
         self._lock = threading.Lock()
 
     def on_event(self, event: Event) -> None:
@@ -200,6 +236,12 @@ class MetricsListener(Listener):
                 self.total_task_time_s += event.duration_s
                 if not event.success:
                     self.task_failures += 1
+            elif isinstance(event, ExecutorLost):
+                self.executors_lost += 1
+            elif isinstance(event, ExecutorRestarted):
+                self.executors_restarted += 1
+            elif isinstance(event, StageResubmitted):
+                self.stages_resubmitted += 1
             elif isinstance(event, BlockSpilled):
                 self.spill_count += 1
                 self.spilled_bytes[event.store] = (
@@ -217,6 +259,9 @@ class MetricsListener(Listener):
                 "tasks": self.task_count,
                 "task_failures": self.task_failures,
                 "total_task_time_s": round(self.total_task_time_s, 6),
+                "executors_lost": self.executors_lost,
+                "executors_restarted": self.executors_restarted,
+                "stages_resubmitted": self.stages_resubmitted,
                 "spills": self.spill_count,
                 "promotes": self.promote_count,
                 "spilled_bytes": dict(self.spilled_bytes),

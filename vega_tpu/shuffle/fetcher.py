@@ -19,7 +19,7 @@ from typing import Callable, Iterator, List, Tuple
 
 from vega_tpu import serialization
 from vega_tpu.env import Env
-from vega_tpu.errors import FetchFailedError, ShuffleError
+from vega_tpu.errors import FetchFailedError, ShuffleError, VegaError
 
 log = logging.getLogger("vega_tpu")
 
@@ -28,13 +28,61 @@ class ShuffleFetcher:
     @staticmethod
     def fetch_blobs(shuffle_id: int, reduce_id: int) -> List[bytes]:
         """Fetch the raw serialized buckets for `reduce_id` (native-framed or
-        pickled); callers that can merge natively avoid the decode."""
+        pickled); callers that can merge natively avoid the decode.
+
+        If a fetch fails, the locations may simply be stale (the liveness
+        reaper unregistered a lost executor's outputs and a survivor — or a
+        respawn — re-registered them elsewhere): re-resolve them once and
+        refetch before escalating, so reducers follow moved outputs instead
+        of failing the whole task on old addresses. The failure path pays
+        one redundant resolve+refetch; the fault-free hot path pays
+        nothing (no extra tracker round-trips)."""
         env = Env.get()
         tracker = env.map_output_tracker
         if tracker is None:
             raise ShuffleError("no map output tracker configured")
-        server_uris: List[str] = tracker.get_server_uris(shuffle_id)
+        try:
+            try:
+                uris = tracker.get_server_uris(shuffle_id)
+            except VegaError as e:
+                # Timed out waiting for locations: outputs were invalidated
+                # (executor loss) and nothing has recomputed them yet. Must
+                # surface as FetchFailed — the typed error is what makes
+                # the scheduler resubmit the producing stage; a generic
+                # error would just retry this reduce task against the same
+                # empty registry until max_failures aborts the job.
+                raise FetchFailedError(
+                    None, shuffle_id, None, reduce_id,
+                    f"map output locations unavailable: {e}",
+                ) from e
+            return ShuffleFetcher._fetch_blobs_once(
+                env, uris, shuffle_id, reduce_id
+            )
+        except FetchFailedError as first_failure:
+            log.info("fetch of shuffle %d failed (%s); re-resolving "
+                     "locations once", shuffle_id, first_failure)
+            try:
+                # Short deadline: the wait returns early the moment new
+                # locations register (or immediately when nothing was
+                # unregistered); the full 5s is only burned when recovery
+                # needs this very task's failure to start.
+                return ShuffleFetcher._fetch_blobs_once(
+                    env, tracker.get_server_uris(shuffle_id, timeout=5.0),
+                    shuffle_id, reduce_id,
+                )
+            except FetchFailedError:
+                raise  # fresher and no less actionable than the first
+            except VegaError:
+                # Re-resolve timed out (the lost outputs have no new homes
+                # yet — only the scheduler's resubmit path creates them).
+                # The ORIGINAL FetchFailedError must reach the scheduler:
+                # a generic error here would retry the reduce task forever
+                # without ever recomputing the missing map outputs.
+                raise first_failure
 
+    @staticmethod
+    def _fetch_blobs_once(env, server_uris: List[str], shuffle_id: int,
+                          reduce_id: int) -> List[bytes]:
         # Group map ids by server so each server is hit by one worker
         # (reference: shuffle_fetcher.rs:33-53).
         by_server: dict = {}

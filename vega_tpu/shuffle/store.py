@@ -29,6 +29,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
+from vega_tpu import faults
 from vega_tpu.store.disk import DiskStore
 
 log = logging.getLogger("vega_tpu")
@@ -153,6 +154,9 @@ class ShuffleStore:
             "disk_bytes": disk.used_bytes if disk else 0,
             "spill_count": self.spill_count,
             "spilled_bytes": self.spilled_bytes,
+            # Checksum/format failures surfaced as misses: a non-zero count
+            # here is disk corruption that was caught, not served.
+            "read_errors": disk.read_errors if disk else 0,
         }
 
     def __len__(self):
@@ -204,6 +208,10 @@ class ShuffleStore:
             log.warning("shuffle spill of %s failed; bucket stays in RAM",
                         _disk_key(*key), exc_info=True)
             return False
+        # Chaos harness: may flip bytes in the file just written — the
+        # checksummed read then reports the bucket missing (FetchFailed ->
+        # map-stage retry), proving corrupt disk data can never be served.
+        faults.get().corrupt_spilled(self._disk, _disk_key(*key))
         with self._lock:
             self.spill_count += 1
             self.spilled_bytes += len(data)

@@ -118,6 +118,13 @@ class DiskStore:
         with self._lock:
             return key in self._index
 
+    def path_of(self, key: str) -> Optional[str]:
+        """On-disk path of a block (fault injection / diagnostics only —
+        readers must go through get() for the checksum)."""
+        with self._lock:
+            entry = self._index.get(key)
+        return entry[0] if entry is not None else None
+
     def remove(self, key: str) -> int:
         """Delete one block; returns the payload bytes freed (0 if absent)."""
         with self._lock:
