@@ -582,5 +582,34 @@ def main():
         ctx.stop()
 
 
+def _usage_line() -> int:
+    """--help/--dryrun: honor the one-JSON-line contract without running
+    the benchmark — and without importing jax or touching the backend, so
+    this path can never hang on a wedged tunnel. tests/test_entry_contract
+    gates on it."""
+    print(json.dumps({
+        "metric": "bench dryrun (usage only, nothing measured)",
+        "value": 0,
+        "unit": "rows/sec",
+        "vs_baseline": 0.0,
+        "detail": {
+            "usage": "python bench.py [--dryrun|--help|-h]",
+            "env": {
+                "VEGA_BENCH_SCALE": "workload scale, 1.0 = 20M rows / "
+                                    "1M keys (default 1.0)",
+                "VEGA_BENCH_TIMEOUT_S": "wall budget in seconds "
+                                        "(default 900)",
+                "VEGA_BENCH_CPU_FALLBACK": "1: reduced-scale CPU "
+                                           "fallback leg",
+            },
+            "contract": "bench.py prints exactly ONE JSON line on "
+                        "stdout, whatever happens",
+        },
+    }))
+    return 0
+
+
 if __name__ == "__main__":
+    if any(a in ("--dryrun", "--help", "-h") for a in sys.argv[1:]):
+        sys.exit(_usage_line())
     sys.exit(main())

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# vegalint gate: zero unsuppressed invariant findings over the tier-1
+# sweep set (vega_tpu/, tests/, bench.py). Exit nonzero on any finding;
+# scripts/t1.sh chains this after the test run so the tier-1 entrypoint
+# gates on a clean lint. Rule catalog: docs/LINTING.md.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec python -m vega_tpu.lint vega_tpu tests bench.py "$@"

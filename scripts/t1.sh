@@ -8,4 +8,9 @@ rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# Invariant gate: tier-1 is only green if vegalint is clean too
+# (docs/LINTING.md; suppressions need a justified pragma).
+if [ "$rc" -eq 0 ]; then
+  bash "$(dirname "$0")/lint.sh" || rc=$?
+fi
 exit $rc

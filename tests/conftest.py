@@ -57,6 +57,28 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip_hw)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    # Runtime lock-order sanitizer (vega_tpu/lint/sync_witness.py): under
+    # VEGA_TPU_DEBUG_SYNC=1 every named lock records acquisition order and
+    # raises on inversion AT the inverting acquire; this end-of-session
+    # check additionally fails the run if an in-place raise was swallowed
+    # by a broad handler somewhere (the VG005 blindness, dynamically).
+    from vega_tpu.lint import sync_witness
+
+    if sync_witness.enabled():
+        sync_witness.check_clean()
+
+
+def pytest_terminal_summary(terminalreporter):
+    from vega_tpu.lint import sync_witness
+
+    if sync_witness.enabled():
+        st = sync_witness.witness().stats()
+        terminalreporter.write_line(
+            f"sync-witness: {st['locks']} named locks, {st['edges']} "
+            f"order edges, {len(st['inversions'])} inversion(s)")
+
+
 @pytest.fixture()
 def ctx():
     """Fresh local Context per test. The Env (shuffle store, trackers) is a

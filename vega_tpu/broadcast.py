@@ -10,16 +10,16 @@ deserialize once.
 from __future__ import annotations
 
 import itertools
-import threading
 from typing import Any
 
 from vega_tpu import serialization
 from vega_tpu.cache import KeySpace
 from vega_tpu.env import Env
+from vega_tpu.lint.sync_witness import named_lock
 
 _next_id = itertools.count(0)
 _local_values: dict = {}
-_lock = threading.Lock()
+_lock = named_lock("broadcast._lock")
 
 
 class Broadcast:

@@ -10,9 +10,9 @@ from __future__ import annotations
 
 import enum
 import sys
-import threading
 from collections import OrderedDict
 from typing import Any, Callable, List, Optional, Tuple
+from vega_tpu.lint.sync_witness import named_lock
 
 
 class KeySpace(enum.Enum):
@@ -77,7 +77,7 @@ class BoundedMemoryCache:
         self._capacity = capacity_bytes
         self._entries: "OrderedDict[Key, Tuple[Any, int]]" = OrderedDict()
         self._used = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("cache.BoundedMemoryCache._lock")
         self.evictions = 0
         # Eviction hook (key, value, size), set by TieredCache (store/) to
         # demote evicted entries to disk instead of losing them. Called

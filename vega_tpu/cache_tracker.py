@@ -14,13 +14,14 @@ from typing import Dict, List
 
 from vega_tpu.cache import KeySpace
 from vega_tpu.env import Env
+from vega_tpu.lint.sync_witness import named_lock
 
 
 class CacheTracker:
     def __init__(self):
         # rdd_id -> partition -> [host uris]
         self._locs: Dict[int, Dict[int, List[str]]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("cache_tracker.CacheTracker._lock")
 
     def register_rdd(self, rdd_id: int, num_partitions: int) -> None:
         with self._lock:
@@ -59,7 +60,7 @@ class CacheTracker:
 # partition don't duplicate work (the reference busy-waits on a 'loading' set,
 # cache_tracker.rs:337-340).
 _loading_locks: Dict = {}
-_loading_guard = threading.Lock()
+_loading_guard = named_lock("cache_tracker._loading_guard")
 
 
 def get_or_compute(rdd, split, task_context=None):

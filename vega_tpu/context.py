@@ -30,6 +30,7 @@ log = logging.getLogger("vega_tpu")
 
 
 import contextlib
+from vega_tpu.lint.sync_witness import named_lock
 
 
 @contextlib.contextmanager
@@ -43,7 +44,7 @@ def _profile_trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
-_active_context_lock = threading.Lock()
+_active_context_lock = named_lock("context._active_context_lock")
 _active_context: Optional["Context"] = None
 
 

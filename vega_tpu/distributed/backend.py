@@ -48,6 +48,7 @@ from vega_tpu.errors import NetworkError, TaskError
 from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.dag import TaskBackend
 from vega_tpu.scheduler.task import Task, TaskEndEvent
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -81,7 +82,7 @@ class DistributedBackend(TaskBackend):
         self.conf = conf
         self._executors: Dict[str, _Executor] = {}
         self._rr = itertools.count(0)
-        self._lock = threading.Lock()
+        self._lock = named_lock("distributed.backend.DistributedBackend._lock")
         self._stopped = False
         self._stop_event = threading.Event()
         # The scheduler (or any observer) plugs in here: bus.post for

@@ -81,7 +81,9 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 reply = serialization.dumps(
                     ("error", exc, traceback.format_exc())
                 )
-            except Exception:  # unpicklable exception
+            except Exception:  # unpicklable exception: ship its repr
+                log.warning("task exception %r is unpicklable; shipping "
+                            "repr to driver", exc, exc_info=True)
                 reply = serialization.dumps(
                     ("error", RuntimeError(repr(exc)), traceback.format_exc())
                 )

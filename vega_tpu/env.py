@@ -15,9 +15,10 @@ import enum
 import logging
 import os
 import tempfile
-import threading
 import uuid
 from typing import Optional
+
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -246,7 +247,7 @@ class Env:
     """
 
     _instance: Optional["Env"] = None
-    _lock = threading.Lock()
+    _lock = named_lock("env.Env._lock")
 
     def __init__(self, conf: Optional[Configuration] = None, is_driver: bool = True):
         from vega_tpu.cache import BoundedMemoryCache

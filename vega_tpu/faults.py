@@ -49,9 +49,9 @@ import json
 import logging
 import os
 import signal
-import threading
 import time
 from typing import Optional
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -91,7 +91,7 @@ class FaultInjector:
         self.stats_dir = env.get(pref + "STATS_DIR") or None
 
         self._tasks_done = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.FaultInjector._lock")
 
     # ------------------------------------------------------------- targeting
     @property
@@ -205,7 +205,7 @@ class FaultInjector:
 
 
 _injector: Optional[FaultInjector] = None
-_injector_lock = threading.Lock()
+_injector_lock = named_lock("faults._injector_lock")
 
 
 def get() -> FaultInjector:

@@ -1,0 +1,25 @@
+"""vegalint: the project's invariant linter.
+
+The invariants that keep vega_tpu correct (compat-shimmed jax access, no
+device probing on import paths, pure placement-property reads, serialized
+device reads under cache locks, ...) used to live only in CLAUDE.md prose;
+two of them caused real incidents before this package existed (the
+seed-suite XLA:CPU deadlock, the jax-0.4 dense-tier wipeout). vegalint
+turns each written invariant into a machine-checked rule:
+
+    python -m vega_tpu.lint vega_tpu tests bench.py
+
+Rule catalog: docs/LINTING.md (or ``python -m vega_tpu.lint --list-rules``).
+Runtime companion: ``vega_tpu.lint.sync_witness`` — under
+``VEGA_TPU_DEBUG_SYNC=1`` the named locks record their acquisition order
+per thread and raise on inversion, so VG003's static lock-order graph is
+double-checked dynamically by every tier-1 run that sets the flag.
+
+This package must stay importable without jax (it is imported at lock
+construction time by core modules via sync_witness) and without the rest
+of vega_tpu (the CLI lints a tree it never imports).
+"""
+
+from vega_tpu.lint.engine import Finding, LintResult, all_rules, run_lint
+
+__all__ = ["Finding", "LintResult", "all_rules", "run_lint"]

@@ -1,0 +1,60 @@
+"""CLI: python -m vega_tpu.lint [paths...] [--format text|json]
+[--select VG001,VG003] [--list-rules]
+
+Exit status: 0 clean, 1 unsuppressed findings (or unparseable files),
+2 usage error. The tier-1 entrypoint (scripts/t1.sh) gates on this via
+scripts/lint.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from vega_tpu.lint.engine import (
+    all_rules,
+    render_json,
+    render_text,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m vega_tpu.lint",
+        description="vegalint: machine-checked vega_tpu invariants "
+                    "(catalog: docs/LINTING.md)")
+    parser.add_argument("paths", nargs="*",
+                        default=["vega_tpu", "tests", "bench.py"],
+                        help="files or directories (default: the tier-1 "
+                             "sweep set)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run (default: "
+                             "all)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, r in sorted(all_rules().items()):
+            print(f"{rid}  {r.title}")
+            doc = " ".join((r.doc or "").split())
+            if doc:
+                print(f"       {doc}")
+        return 0
+
+    select = [s.strip() for s in args.select.split(",")] \
+        if args.select else None
+    try:
+        result = run_lint(args.paths, select=select)
+    except ValueError as exc:  # unknown --select rule id
+        print(f"vegalint: {exc}", file=sys.stderr)
+        return 2
+    print(render_json(result) if args.format == "json"
+          else render_text(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

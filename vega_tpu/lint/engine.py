@@ -1,0 +1,332 @@
+"""vegalint core: file model, rule registry, pragma handling, reporters.
+
+Pure stdlib (ast + re) — the linter must run in well under ten seconds on
+the 1-core sandbox and must not import jax or any vega_tpu runtime module
+(it lints source trees it never executes).
+
+Rule protocol
+-------------
+A rule is registered with :func:`rule` and receives either one
+:class:`FileCtx` (per-file rules) or the whole list (``project=True`` —
+needed by the lock-order analysis, whose acquisition graph spans modules)
+and yields :class:`Finding` objects.
+
+Pragmas
+-------
+A finding on line N is suppressed when line N — or a standalone comment
+line directly above it — carries::
+
+    # vegalint: ignore[VG003] — one-line justification
+
+The justification is MANDATORY: a pragma without one is itself a finding
+(VG000, not suppressible), which is how the acceptance criterion "every
+ignore carries a justification" is machine-enforced rather than reviewed.
+``ignore[*]`` suppresses every rule on that line (same justification duty).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*vegalint:\s*ignore\[([^\]]*)\]\s*(.*)$"
+)
+# Leading em-dash / dash / colon before the justification text.
+_JUSTIFY_STRIP = " \t—–:-"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # as given on the command line (relative where possible)
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.justification is None:
+            d.pop("justification")
+        return d
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.justification if self.suppressed \
+            else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    title: str
+    doc: str  # rationale + example, surfaced by --list-rules and the docs
+    check: Callable
+    project: bool = False  # True: check(list[FileCtx]); else check(FileCtx)
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, title: str, doc: str = "", project: bool = False):
+    def register(fn):
+        _RULES[rule_id] = Rule(rule_id, title, doc or (fn.__doc__ or ""),
+                               fn, project)
+        return fn
+
+    return register
+
+
+def all_rules() -> Dict[str, Rule]:
+    # Importing the rules module populates the registry on first use.
+    from vega_tpu.lint import rules  # noqa: F401
+
+    return dict(_RULES)
+
+
+class FileCtx:
+    """One parsed source file plus the import-alias map rules share."""
+
+    def __init__(self, path: str, display: str, source: str):
+        self.path = path
+        self.display = display  # normalized, '/'-separated, for reporting
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = _collect_aliases(self.tree)
+        # pragma line -> (set of rule ids or {'*'}, justification, col).
+        # Pragmas are read from real COMMENT tokens, so a docstring that
+        # *mentions* the syntax (this engine's own, say) is not a pragma.
+        self.pragmas: Dict[int, Tuple[set, str, int]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m:
+                    ids = {s.strip() for s in m.group(1).split(",")
+                           if s.strip()}
+                    just = m.group(2).strip(_JUSTIFY_STRIP).strip()
+                    self.pragmas[tok.start[0]] = (
+                        ids, just, tok.start[1] + m.start() + 1)
+        except tokenize.TokenError:
+            pass  # the ast parse already succeeded; just no pragmas
+
+    # ---------------------------------------------------------- path scoping
+    def in_dir(self, *parts: str) -> bool:
+        """True when the file lives under a directory path containing the
+        given '/'-joined fragment (e.g. in_dir('vega_tpu', 'tpu'))."""
+        return "/" + "/".join(parts) + "/" in "/" + self.display
+
+    def endswith(self, suffix: str) -> bool:
+        return self.display.endswith(suffix)
+
+    @property
+    def module(self) -> str:
+        """Dotted module name anchored at the last 'vega_tpu' path segment
+        (lock keys and messages use it); top-level scripts use the stem."""
+        parts = self.display.split("/")
+        anchors = [i for i, p in enumerate(parts[:-1]) if p == "vega_tpu"]
+        if anchors:
+            parts = parts[anchors[-1]:]
+        if parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][:-3]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    # ----------------------------------------------------------- ast helpers
+    def qualified(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases
+        expanded: `jnp.nonzero` -> 'jax.numpy.nonzero' after
+        `import jax.numpy as jnp`."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        return ".".join([base] + list(reversed(parts)))
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]  # unsuppressed, reported, gate exit status
+    suppressed: List[Finding]
+    files: int
+    errors: List[str]  # unparseable files etc.
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    def to_dict(self) -> dict:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "errors": self.errors,
+            "by_rule": counts,
+        }
+
+
+def discover(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def run_lint(paths: Iterable[str],
+             select: Optional[Iterable[str]] = None) -> LintResult:
+    rules = all_rules()
+    if select:
+        keep = set(select)
+        unknown = keep - set(rules)
+        if unknown:
+            # A typo'd --select silently checking nothing would report the
+            # invariant gate green — fail loudly instead.
+            raise ValueError(f"unknown rule id(s) in select: "
+                             f"{sorted(unknown)}; known: {sorted(rules)}")
+        rules = {rid: r for rid, r in rules.items() if rid in keep}
+    ctxs: List[FileCtx] = []
+    errors: List[str] = []
+    paths = list(paths)
+    for p in paths:
+        # Same rationale: a mistyped path must not make the gate pass
+        # vacuously.
+        if not os.path.exists(p):
+            errors.append(f"{p}: path does not exist")
+        elif not os.path.isdir(p) and not p.endswith(".py"):
+            errors.append(f"{p}: not a directory or .py file")
+    files = discover(paths)
+    for path in files:
+        display = os.path.normpath(path).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            ctxs.append(FileCtx(path, display, source))
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            errors.append(f"{display}: {type(exc).__name__}: {exc}")
+
+    raw: List[Finding] = []
+    for r in rules.values():
+        if r.project:
+            raw.extend(r.check(ctxs))
+        else:
+            for ctx in ctxs:
+                raw.extend(r.check(ctx))
+
+    by_display = {c.display: c for c in ctxs}
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    used_pragmas: Dict[Tuple[str, int], bool] = {}
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        ctx = by_display.get(f.path)
+        hit = _pragma_for(ctx, f) if ctx is not None else None
+        if hit is not None and f.rule != "VG000":
+            line, (_ids, just, _col) = hit
+            used_pragmas[(f.path, line)] = True
+            f.suppressed = True
+            f.justification = just or None
+            suppressed.append(f)
+        else:
+            findings.append(f)
+
+    # Pragma hygiene (VG000): a pragma must carry a justification; a pragma
+    # that names no known rule, or suppresses nothing, is dead weight —
+    # either the invariant code was fixed (delete the pragma) or the rule
+    # drifted (fix the rule). Not themselves suppressible.
+    known = set(all_rules()) | {"*"}
+    for ctx in ctxs:
+        for line, (ids, just, col) in sorted(ctx.pragmas.items()):
+            if not just:
+                findings.append(Finding(
+                    "VG000", ctx.display, line, col,
+                    "pragma without justification — write "
+                    "'# vegalint: ignore[RULE] — why this is safe'"))
+            unknown = ids - known
+            if unknown:
+                findings.append(Finding(
+                    "VG000", ctx.display, line, col,
+                    f"pragma names unknown rule(s) {sorted(unknown)}"))
+            elif select is None \
+                    and not used_pragmas.get((ctx.display, line)):
+                findings.append(Finding(
+                    "VG000", ctx.display, line, col,
+                    f"pragma suppresses nothing (rules {sorted(ids)} did "
+                    "not fire here) — delete it or re-anchor it"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings, suppressed, len(ctxs), errors)
+
+
+def _pragma_for(ctx: FileCtx, f: Finding):
+    """Pragma applying to finding `f`: same line, or a standalone comment
+    line directly above."""
+    for line in (f.line, f.line - 1):
+        hit = ctx.pragmas.get(line)
+        if hit is None:
+            continue
+        if line == f.line - 1:
+            text = ctx.lines[line - 1].lstrip() if line >= 1 else ""
+            if not text.startswith("#"):
+                continue  # trailing pragma on the previous code line
+        ids = hit[0]
+        if f.rule in ids or "*" in ids:
+            return line, hit
+    return None
+
+
+# ------------------------------------------------------------------ reporters
+def render_text(result: LintResult) -> str:
+    lines = [f.render() for f in result.findings]
+    lines.extend(f"error: {e}" for e in result.errors)
+    lines.append(
+        f"vegalint: {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, {result.files} file(s)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.to_dict(), indent=1, sort_keys=True)

@@ -1,0 +1,711 @@
+"""vegalint rules VG001–VG007: the project invariants as AST checks.
+
+Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
+catalog with rationale and examples). Rules are deliberately conservative:
+a rule that cries wolf gets pragma'd into silence, and then the invariant
+is unguarded again — so every heuristic here is tuned to the failure mode
+that actually bit this repo, not to theoretical completeness. The dynamic
+complement (vega_tpu/lint/sync_witness.py) covers what lexical analysis
+cannot see at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from vega_tpu.lint.engine import FileCtx, Finding, rule
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _last_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """Leftmost identifier of an attribute chain (`a.b.c()` -> 'a')."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+def _own_nodes(root: ast.AST) -> Iterator[ast.AST]:
+    """Descendants of `root` excluding nested function/lambda subtrees —
+    the code that actually runs when `root`'s body runs."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNC_DEFS + (ast.Lambda,)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# VG001 — raw jax spellings that must go through tpu/compat.py
+# ---------------------------------------------------------------------------
+# jax.shard_map / jax.enable_x64 / jax.export do not exist on jax < 0.5 and
+# lax.platform_dependent lowers every branch there; writing any of them
+# directly wiped out the entire dense tier at seed (fixed in PR 1 by
+# vega_tpu/tpu/compat.py). Only compat.py may touch the raw surface.
+
+_VG001_BANNED = (
+    "jax.shard_map",
+    "jax.enable_x64",
+    "jax.export",
+    "jax.lax.platform_dependent",
+    "jax.experimental.shard_map",
+    "jax.experimental.enable_x64",
+)
+
+
+def _banned_prefix(qual: Optional[str]) -> Optional[str]:
+    if qual is None:
+        return None
+    for b in _VG001_BANNED:
+        if qual == b or qual.startswith(b + "."):
+            return b
+    return None
+
+
+@rule("VG001", "raw jax compat-surface spelling outside tpu/compat.py")
+def vg001(ctx: FileCtx) -> Iterator[Finding]:
+    if ctx.endswith("tpu/compat.py"):
+        return
+    # Import sites: `from jax.experimental.shard_map import ...`,
+    # `from jax import export`, `from jax.lax import platform_dependent`.
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.level == 0 \
+                and node.module:
+            for a in node.names:
+                b = _banned_prefix(f"{node.module}.{a.name}")
+                if b:
+                    yield Finding(
+                        "VG001", ctx.display, node.lineno,
+                        node.col_offset + 1,
+                        f"import of {node.module}.{a.name}: use "
+                        "vega_tpu.tpu.compat (jax<0.5 has a different "
+                        "surface — this exact drift wiped the dense tier "
+                        "at seed)")
+    # Use sites: outermost Name/Attribute chains whose alias-expanded
+    # dotted name lands on the banned surface.
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Attribute, ast.Name)):
+            continue
+        if isinstance(parents.get(node), ast.Attribute):
+            continue  # inner link of a longer chain; outermost reports
+        if isinstance(node, ast.Name) and not isinstance(
+                node.ctx, ast.Load):
+            continue
+        qual = ctx.qualified(node)
+        b = _banned_prefix(qual)
+        if b:
+            yield Finding(
+                "VG001", ctx.display, node.lineno, node.col_offset + 1,
+                f"raw '{qual}' — use the vega_tpu.tpu.compat shim "
+                "(CLAUDE.md: ALL dense-tier code goes through compat.py)")
+
+
+# ---------------------------------------------------------------------------
+# VG002 — device probes reachable at module import time
+# ---------------------------------------------------------------------------
+# jax.devices()/default_backend() initialize the backend; on a wedged axon
+# tunnel that call hangs forever, so CLAUDE.md bans it from import paths
+# (and conftest's forced CPU mesh must run before any backend init).
+
+_VG002_PROBES = {
+    "jax.devices",
+    "jax.default_backend",
+    "jax.local_devices",
+    "jax.device_count",
+}
+
+
+def _is_main_guard(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and test.left.id == "__name__"
+            and len(test.comparators) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value == "__main__")
+
+
+@rule("VG002", "device probe reachable at module import time")
+def vg002(ctx: FileCtx) -> Iterator[Finding]:
+    # Local functions that probe: a module-level call to one of them is
+    # just as import-hanging as the probe itself (one hop, same module).
+    probe_funcs: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNC_DEFS):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and ctx.qualified(sub.func) in _VG002_PROBES:
+                    probe_funcs.add(node.name)
+                    break
+
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, import_time: bool) -> None:
+        if isinstance(node, _FUNC_DEFS):
+            # Decorators and argument defaults DO run at import time;
+            # the body does not.
+            for d in node.decorator_list:
+                walk(d, import_time)
+            for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                walk(d, import_time)
+            for b in node.body:
+                walk(b, False)
+            return
+        if isinstance(node, ast.Lambda):
+            walk(node.body, False)
+            return
+        if isinstance(node, ast.If) and _is_main_guard(node.test):
+            # `if __name__ == "__main__":` runs as a script entry, not on
+            # import — but its ELSE branch is exactly what runs on import.
+            for b in node.body:
+                walk(b, False)
+            for b in node.orelse:
+                walk(b, import_time)
+            return
+        if import_time and isinstance(node, ast.Call):
+            qual = ctx.qualified(node.func)
+            if qual in _VG002_PROBES:
+                findings.append(Finding(
+                    "VG002", ctx.display, node.lineno, node.col_offset + 1,
+                    f"'{qual}()' runs at module import time — backend "
+                    "init on an import path hangs forever on a wedged "
+                    "device tunnel (CLAUDE.md environment quirk)"))
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in probe_funcs:
+                findings.append(Finding(
+                    "VG002", ctx.display, node.lineno, node.col_offset + 1,
+                    f"module-level call to '{node.func.id}()', which "
+                    "probes jax devices — backend init on an import path "
+                    "hangs on a wedged tunnel"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, import_time)
+
+    walk(ctx.tree, True)
+    yield from findings
+
+
+# ---------------------------------------------------------------------------
+# VG003 — lock-order graph: cycles + blocking calls under cache/store locks
+# ---------------------------------------------------------------------------
+# The seed suite froze on exactly this: two task threads interleaving
+# device slicing + device_get deadlocked old XLA:CPU on the 1-core box.
+# The rule builds the acquisition graph over threading.Lock/RLock (and
+# sync_witness.named_lock) attributes across vega_tpu/, flags cycles, and
+# flags blocking calls (device_get/host_get, socket recv, Future.result,
+# queue.get without timeout) made while holding _host_cache_lock or any
+# cache/store lock. Lexical nesting plus one resolvable call hop; the
+# runtime sync_witness covers dynamic orders statically invisible here.
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+_RLOCK_CTORS = {"threading.RLock"}
+
+
+def _lock_ctor(call: ast.AST, ctx: FileCtx) -> Optional[bool]:
+    """None if not a lock constructor; else True when reentrant."""
+    if not isinstance(call, ast.Call):
+        return None
+    qual = ctx.qualified(call.func)
+    if qual in _LOCK_CTORS:
+        return qual in _RLOCK_CTORS
+    if _last_name(call.func) == "named_lock":
+        for k in call.keywords:
+            if k.arg == "reentrant" and isinstance(k.value, ast.Constant):
+                return bool(k.value.value)
+        return False
+    return None
+
+
+class _Vg003State:
+    def __init__(self) -> None:
+        self.locks: Dict[str, bool] = {}  # key -> reentrant
+        # (a, b) -> (display, line) of first `acquire b while holding a`
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # (module, cls, fname) -> direct lock keys it acquires
+        self.fn_locks: Dict[Tuple[str, Optional[str], str], Set[str]] = {}
+        # deferred call hops: (held keys, callee, display, line)
+        self.calls: List[Tuple[List[str], Tuple, str, int]] = []
+        self.findings: List[Finding] = []
+
+
+def _vg003_lock_key(expr: ast.AST, ctx: FileCtx, cls: Optional[str],
+                    state: _Vg003State) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        key = f"{ctx.module}.{expr.id}"
+        if key in state.locks:
+            return key
+        alias = ctx.aliases.get(expr.id)
+        if alias and alias in state.locks:
+            return alias
+        return key if "lock" in expr.id.lower() else None
+    if isinstance(expr, ast.Attribute):
+        base = _base_name(expr)
+        if base == "self" and isinstance(expr.value, ast.Name):
+            key = f"{ctx.module}.{cls}.{expr.attr}" if cls else None
+            if key:
+                return key if (key in state.locks
+                               or "lock" in expr.attr.lower()) else None
+        qual = ctx.qualified(expr)
+        if qual and qual in state.locks:
+            return qual
+        if "lock" in expr.attr.lower():
+            return f"{ctx.module}.?.{expr.attr}"  # opaque foreign lock
+    return None
+
+
+_CACHEISH = ("cache", "store")
+
+
+def _is_cacheish(key: str) -> bool:
+    low = key.lower()
+    return any(s in low for s in _CACHEISH)
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    name = _last_name(call.func)
+    if name in ("device_get", "host_get"):
+        return f"{name}() (a driver<->device round trip)"
+    if name == "recv":
+        return "socket recv()"
+    if name == "result" and not call.args and not _kw(call, "timeout"):
+        return "Future.result() without timeout"
+    if name == "get" and isinstance(call.func, ast.Attribute) \
+            and not call.args and not _kw(call, "timeout"):
+        recv = _base_name(call.func) or ""
+        attr_chain = call.func.value
+        attr = attr_chain.attr if isinstance(attr_chain, ast.Attribute) \
+            else recv
+        if "queue" in (attr or "").lower() or "queue" in recv.lower():
+            return "queue get() without timeout"
+    return None
+
+
+def _vg003_scan_fn(body: List[ast.stmt], ctx: FileCtx, cls: Optional[str],
+                   fname: str, state: _Vg003State) -> None:
+    direct: Set[str] = set()
+    nested: List[Tuple[List[ast.stmt], Optional[str], str]] = []
+
+    def walk(node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, _FUNC_DEFS):
+            nested.append((node.body, cls, node.name))
+            return  # a nested def runs later, not under the held locks
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.With):
+            here: List[str] = []
+            for item in node.items:
+                walk(item.context_expr, held + here)
+                key = _vg003_lock_key(item.context_expr, ctx, cls, state)
+                if key is None:
+                    continue
+                for h in held + here:
+                    if h == key and state.locks.get(key):
+                        continue  # reentrant re-acquire is fine
+                    state.edges.setdefault(
+                        (h, key), (ctx.display, item.context_expr.lineno))
+                here.append(key)
+                direct.add(key)
+            for b in node.body:
+                walk(b, held + here)
+            return
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(node)
+            cacheish = [h for h in held if _is_cacheish(h)]
+            if desc and cacheish:
+                state.findings.append(Finding(
+                    "VG003", ctx.display, node.lineno,
+                    node.col_offset + 1,
+                    f"blocking {desc} while holding cache/store lock "
+                    f"'{cacheish[-1]}' — can deadlock or starve the "
+                    "1-core sandbox (the seed-suite XLA:CPU wedge)"))
+            if held:
+                callee: Optional[Tuple] = None
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" and cls:
+                    callee = (ctx.module, cls, f.attr)
+                elif isinstance(f, ast.Name):
+                    callee = (ctx.module, None, f.id)
+                if callee is not None:
+                    state.calls.append(
+                        (list(held), callee, ctx.display, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in body:
+        walk(stmt, [])
+    fn_key = (ctx.module, cls, fname)
+    state.fn_locks.setdefault(fn_key, set()).update(direct)
+    for nbody, ncls, nname in nested:
+        _vg003_scan_fn(nbody, ctx, ncls, nname, state)
+
+
+@rule("VG003", "lock-order cycles and blocking calls under cache/store "
+      "locks", project=True)
+def vg003(ctxs: List[FileCtx]) -> Iterator[Finding]:
+    ctxs = [c for c in ctxs if c.in_dir("vega_tpu")]
+    state = _Vg003State()
+    # Pass 1: lock definitions (module-level names and self.X attributes).
+    for ctx in ctxs:
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.targets[0], ast.Name):
+                r = _lock_ctor(node.value, ctx)
+                if r is not None:
+                    state.locks[f"{ctx.module}.{node.targets[0].id}"] = r
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                r = _lock_ctor(sub.value, ctx)
+                if r is None:
+                    continue
+                t = sub.targets[0]
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    state.locks[f"{ctx.module}.{node.name}.{t.attr}"] = r
+                elif isinstance(t, ast.Name):  # class-body lock (Env._lock)
+                    state.locks[f"{ctx.module}.{node.name}.{t.id}"] = r
+    # Pass 2: acquisitions — module body, functions, methods.
+    for ctx in ctxs:
+        _vg003_scan_fn(
+            [s for s in ctx.tree.body
+             if not isinstance(s, _FUNC_DEFS + (ast.ClassDef,))],
+            ctx, None, "<module>", state)
+        for node in ctx.tree.body:
+            if isinstance(node, _FUNC_DEFS):
+                _vg003_scan_fn(node.body, ctx, None, node.name, state)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, _FUNC_DEFS):
+                        _vg003_scan_fn(sub.body, ctx, node.name,
+                                       sub.name, state)
+    # Pass 3: one call hop — held locks flow into the callee's direct set.
+    for held, callee, display, line in state.calls:
+        for key in state.fn_locks.get(callee, ()):
+            for h in held:
+                if h == key and state.locks.get(key):
+                    continue
+                state.edges.setdefault((h, key), (display, line))
+    # Pass 4: cycles (including non-reentrant self-acquisition).
+    adj: Dict[str, Set[str]] = {}
+    for (a, b), _site in state.edges.items():
+        adj.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    for (a, b), (display, line) in sorted(state.edges.items(),
+                                          key=lambda kv: kv[1]):
+        if a == b:
+            state.findings.append(Finding(
+                "VG003", display, line, 1,
+                f"non-reentrant lock '{a}' re-acquired while already "
+                "held — self-deadlock"))
+            continue
+        path = _find_path(adj, b, a)
+        if path is None:
+            continue
+        cycle = [a] + path[:-1]  # path ends at a; drop the repeat
+        lo = cycle.index(min(cycle))
+        canon = tuple(cycle[lo:] + cycle[:lo])
+        if canon in seen_cycles:
+            continue
+        seen_cycles.add(canon)
+        state.findings.append(Finding(
+            "VG003", display, line, 1,
+            "lock-order cycle: " + " -> ".join(cycle + [cycle[0]])
+            + " — two threads taking these in opposite order deadlock"))
+    yield from state.findings
+
+
+def _find_path(adj: Dict[str, Set[str]], src: str,
+               dst: str) -> Optional[List[str]]:
+    """BFS path src..dst (inclusive of src, exclusive of repeat of dst)."""
+    if src == dst:
+        return [src]
+    parent: Dict[str, str] = {src: src}
+    frontier = [src]
+    while frontier:
+        nxt: List[str] = []
+        for u in frontier:
+            for v in sorted(adj.get(u, ())):
+                if v in parent:
+                    continue
+                parent[v] = u
+                if v == dst:
+                    path = [v]
+                    while path[-1] != src:
+                        path.append(parent[path[-1]])
+                    return list(reversed(path))
+                nxt.append(v)
+        frontier = nxt
+    return None
+
+
+# ---------------------------------------------------------------------------
+# VG004 — purity of hash_placed / key_sorted property readers
+# ---------------------------------------------------------------------------
+# A bare property read must never launch an exchange (round-4 advisor):
+# exchange planners call _settle_placement() explicitly first. A reader
+# that materializes turns an innocent `if rdd.hash_placed:` into device
+# work — silently, at unpredictable times.
+
+_VG004_READERS = {"hash_placed", "key_sorted"}
+_VG004_IMPURE_CALLS = {
+    "_settle_placement", "_materialize", "block", "collect", "to_numpy",
+    "device_get", "host_get", "compute", "splits",
+}
+_VG004_IMPURE_ATTRS = {"counts_np", "num_rows"}
+
+
+@rule("VG004", "hash_placed/key_sorted property readers must stay pure")
+def vg004(ctx: FileCtx) -> Iterator[Finding]:
+    if not ctx.in_dir("vega_tpu"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, _FUNC_DEFS)
+                and node.name in _VG004_READERS):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _last_name(sub.func)
+                if name in _VG004_IMPURE_CALLS:
+                    yield Finding(
+                        "VG004", ctx.display, sub.lineno,
+                        sub.col_offset + 1,
+                        f"'{node.name}' reader calls '{name}()' — "
+                        "placement property reads are PURE; planners "
+                        "call _settle_placement() explicitly (CLAUDE.md)")
+            elif isinstance(sub, ast.Attribute) \
+                    and sub.attr in _VG004_IMPURE_ATTRS:
+                yield Finding(
+                    "VG004", ctx.display, sub.lineno, sub.col_offset + 1,
+                    f"'{node.name}' reader touches '.{sub.attr}' (device "
+                    "materialization) — placement property reads are PURE")
+
+
+# ---------------------------------------------------------------------------
+# VG005 — blind broad excepts in distributed/ shuffle/ scheduler/
+# ---------------------------------------------------------------------------
+# A swallowed exception in the control plane turns a crash into a hang
+# (the chaos harness exists because of these). Broad handlers must log or
+# re-raise (typed VegaError included) — silence is the only failure.
+
+_VG005_DIRS = (("vega_tpu", "distributed"), ("vega_tpu", "shuffle"),
+               ("vega_tpu", "scheduler"))
+_LOG_RECEIVERS = {"log", "logger", "logging"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(isinstance(n, ast.Name)
+               and n.id in ("Exception", "BaseException") for n in names)
+
+
+@rule("VG005", "broad except that neither logs nor re-raises")
+def vg005(ctx: FileCtx) -> Iterator[Finding]:
+    if not any(ctx.in_dir(*d) for d in _VG005_DIRS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.ExceptHandler)
+                and _handler_is_broad(node)):
+            continue
+        ok = False
+        for sub in _own_nodes(node):
+            if isinstance(sub, ast.Raise):
+                ok = True
+                break
+            if isinstance(sub, ast.Call):
+                name = _last_name(sub.func)
+                base = _base_name(sub.func)
+                if (base in _LOG_RECEIVERS and name in _LOG_METHODS) \
+                        or (base == "warnings" and name == "warn") \
+                        or (base == "traceback"
+                            and name == "print_exc"):
+                    ok = True
+                    break
+        if not ok:
+            yield Finding(
+                "VG005", ctx.display, node.lineno, node.col_offset + 1,
+                "broad except swallows the error silently — log it or "
+                "re-raise a typed VegaError (a swallowed control-plane "
+                "exception turns a crash into a hang)")
+
+
+# ---------------------------------------------------------------------------
+# VG006 — traced-code hazards in tpu/
+# ---------------------------------------------------------------------------
+# Inside jit/shard_map-traced code, .item(), int()/bool() on a traced
+# value, and nonzero/unique without static size= are ConcretizationError
+# tracebacks at best and silent recompiles/dynamic shapes at worst.
+
+_TRACED_FILES = ("tpu/kernels.py", "tpu/pallas_kernels.py")
+_TRACER_NAMES = {"shard_map", "jit", "pallas_call", "_shard_program"}
+_SIZED_OPS = {"nonzero", "unique", "argwhere", "flatnonzero"}
+_ARRAY_MODULES = ("jax.", "numpy.")
+
+
+def _is_array_expr(node: ast.AST, ctx: FileCtx) -> bool:
+    """Heuristic: a Compare, or a call into jax/numpy, or a method call on
+    an array-ish receiver — the expressions whose int()/bool() coercion
+    concretizes a tracer."""
+    if isinstance(node, ast.Compare):
+        return True
+    if isinstance(node, ast.Call):
+        qual = ctx.qualified(node.func)
+        if qual and (qual.startswith(_ARRAY_MODULES)
+                     or qual.startswith("jnp.")):
+            return True
+        if isinstance(node.func, ast.Attribute) and _last_name(
+                node.func) in ("any", "all", "sum", "max", "min"):
+            return True
+    return False
+
+
+def _traced_nodes(ctx: FileCtx) -> List[ast.AST]:
+    traced: List[ast.AST] = []
+    names: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and _last_name(node.func) in _TRACER_NAMES:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                elif isinstance(arg, (ast.Lambda,)):
+                    traced.append(arg)
+    module_level = any(ctx.endswith(f) for f in _TRACED_FILES)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, _FUNC_DEFS):
+            continue
+        decorated = any(_last_name(d.func if isinstance(d, ast.Call) else d)
+                        in ("jit", "pallas_call")
+                        for d in node.decorator_list)
+        if node.name in names or decorated \
+                or (module_level and node in ctx.tree.body):
+            traced.append(node)
+    return traced
+
+
+@rule("VG006", "traced-code hazards (.item / int()/bool() / unsized "
+      "nonzero) in tpu/")
+def vg006(ctx: FileCtx) -> Iterator[Finding]:
+    if not ctx.in_dir("vega_tpu", "tpu"):
+        return
+    seen: Set[int] = set()
+    for root in _traced_nodes(ctx):
+        for sub in ast.walk(root):
+            if id(sub) in seen or not isinstance(sub, ast.Call):
+                continue
+            seen.add(id(sub))
+            name = _last_name(sub.func)
+            if name == "item":
+                yield Finding(
+                    "VG006", ctx.display, sub.lineno, sub.col_offset + 1,
+                    ".item() inside traced code concretizes the tracer — "
+                    "host-side folds belong outside the shard program")
+            elif isinstance(sub.func, ast.Name) \
+                    and sub.func.id in ("int", "bool", "float") \
+                    and sub.args and _is_array_expr(sub.args[0], ctx):
+                yield Finding(
+                    "VG006", ctx.display, sub.lineno, sub.col_offset + 1,
+                    f"{sub.func.id}() on a traced expression — use "
+                    "lax.cond/where; Python coercion breaks under jit")
+            elif name in _SIZED_OPS and not _kw(sub, "size"):
+                qual = ctx.qualified(sub.func) or ""
+                if qual.startswith(_ARRAY_MODULES) \
+                        or qual.startswith("jnp."):
+                    yield Finding(
+                        "VG006", ctx.display, sub.lineno,
+                        sub.col_offset + 1,
+                        f"'{name}' without static size= in traced code — "
+                        "dynamic output shape cannot compile (static "
+                        "shapes everywhere: CLAUDE.md invariant)")
+
+
+# ---------------------------------------------------------------------------
+# VG007 — pool starvation: blocking on a shared executor from inside it
+# ---------------------------------------------------------------------------
+# nproc=1 here: pools run one thread per task, so a task that submits to
+# its own pool and blocks on the Future waits on work queued behind
+# itself. Draining a pool you created locally is fine; blocking on a
+# shared/ambient pool's Future is the hazard.
+
+_POOL_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+
+@rule("VG007", "submit + blocking wait on a shared executor in one "
+      "function")
+def vg007(ctx: FileCtx) -> Iterator[Finding]:
+    if not ctx.in_dir("vega_tpu"):
+        return
+    for fn in [n for n in ast.walk(ctx.tree) if isinstance(n, _FUNC_DEFS)]:
+        local_pools: Set[str] = set()
+        submits: List[Tuple[int, int, str]] = []
+        waits: List[Tuple[int, int, str]] = []
+        own = list(_own_nodes(fn))
+        # Pass 1: pools this function creates itself (draining those is
+        # legal — the deadlock needs the pool to be shared).
+        for sub in own:
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _last_name(sub.value.func) in _POOL_CTORS:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        local_pools.add(t.id)
+            if isinstance(sub, ast.withitem) \
+                    and isinstance(sub.context_expr, ast.Call) \
+                    and _last_name(sub.context_expr.func) in _POOL_CTORS \
+                    and isinstance(sub.optional_vars, ast.Name):
+                local_pools.add(sub.optional_vars.id)
+        for sub in own:
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _last_name(sub.func)
+            if name == "submit" and isinstance(sub.func, ast.Attribute):
+                base = _base_name(sub.func)
+                if base not in local_pools:
+                    submits.append((sub.lineno, sub.col_offset + 1,
+                                    base or "?"))
+            elif name == "result" and not _kw(sub, "timeout") \
+                    and not sub.args:
+                waits.append((sub.lineno, sub.col_offset + 1,
+                              "Future.result()"))
+            elif name == "as_completed" or (
+                    name == "wait"
+                    and (ctx.qualified(sub.func) or "").endswith(
+                        "futures.wait")
+                    and not _kw(sub, "timeout")):
+                waits.append((sub.lineno, sub.col_offset + 1, name))
+        if submits and waits:
+            line, col, desc = waits[0]
+            yield Finding(
+                "VG007", ctx.display, line, col,
+                f"blocking {desc} in a function that also submits to "
+                f"shared executor '{submits[0][2]}' — on the 1-thread-"
+                "per-task pool this starves (task waits on work queued "
+                "behind itself); drain a locally-created pool instead")

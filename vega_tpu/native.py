@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
-import threading
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -55,7 +55,7 @@ def merge_encoded_py(flagged_blobs, op_name: str):
             combined[k] = op(combined[k], v) if k in combined else v
     return list(combined.items())
 
-_lock = threading.Lock()
+_lock = named_lock("native._lock")
 _native = None
 _load_attempted = False
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 import itertools
 import logging
 import queue
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set
 
@@ -30,6 +29,7 @@ from vega_tpu.env import Env
 from vega_tpu.errors import FetchFailedError, TaskError, VegaError
 from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.stage import Stage
+from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.scheduler.task import (
     ResultTask,
     ShuffleMapTask,
@@ -104,7 +104,7 @@ class DAGScheduler:
         # (distributed_scheduler.rs:183-187). Jobs from multiple driver
         # threads serialize here. Reentrant: materializing a checkpoint
         # (_do_checkpoint) legitimately nests a job inside job setup.
-        self._job_lock = threading.RLock()
+        self._job_lock = named_lock("scheduler.dag.DAGScheduler._job_lock", reentrant=True)
         # The in-flight job, visible to the reaper callback: executor loss
         # must proactively fail the affected stages of a RUNNING job (see
         # _on_executor_lost) — recovery cannot depend on a reducer

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from vega_tpu.lint.sync_witness import named_lock
+
 log = logging.getLogger("vega_tpu")
 
 
@@ -128,7 +130,7 @@ class LiveListenerBus:
         self._listeners: List[Listener] = []
         self._thread: Optional[threading.Thread] = None
         self._started = False
-        self._lock = threading.Lock()
+        self._lock = named_lock("scheduler.events.EventBus._lock")
 
     def add_listener(self, listener: Listener) -> None:
         with self._lock:
@@ -210,7 +212,7 @@ class MetricsListener(Listener):
         self.executors_lost = 0
         self.executors_restarted = 0
         self.stages_resubmitted = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("scheduler.events.MetricsListener._lock")
 
     def on_event(self, event: Event) -> None:
         with self._lock:

@@ -25,12 +25,12 @@ subsystem decoupled from the scheduler):
 from __future__ import annotations
 
 import logging
-import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
 from vega_tpu import faults
 from vega_tpu.store.disk import DiskStore
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -52,7 +52,7 @@ class ShuffleStore:
                  memory_budget: int = MEMORY_BUDGET):
         self._mem: "OrderedDict[Key, bytes]" = OrderedDict()
         self._mem_bytes = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("shuffle.store.ShuffleStore._lock")
         self._disk = DiskStore(spill_dir) if spill_dir else None
         self._spill_threshold = spill_threshold
         self._memory_budget = memory_budget

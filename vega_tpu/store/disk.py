@@ -23,6 +23,7 @@ import struct
 import threading
 import zlib
 from typing import Dict, List, Optional, Tuple
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -53,7 +54,7 @@ class DiskStore:
         self._root = root
         self._index: Dict[str, Tuple[str, int]] = {}
         self._used = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.disk.DiskStore._lock")
         self.read_errors = 0  # checksum/format failures surfaced as misses
 
     @property

@@ -17,12 +17,12 @@ from __future__ import annotations
 
 import logging
 import pickle
-import threading
 from typing import Any, Dict, Optional, Tuple
 
 from vega_tpu.cache import BoundedMemoryCache, KeySpace
 from vega_tpu.store.disk import DiskStore
 from vega_tpu.store.level import StorageLevel
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -41,7 +41,7 @@ class TieredCache:
         self.disk = disk
         memory.on_evict = self._on_memory_evict
         self._levels: Dict[Tuple[KeySpace, int], StorageLevel] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.tiered.TieredCache._lock")
         self.spill_count = 0
         self.spilled_bytes = 0
         self.promote_count = 0

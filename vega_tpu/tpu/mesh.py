@@ -10,16 +10,16 @@ context.rs:209-303).
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from vega_tpu.lint.sync_witness import named_lock
 
 SHARD_AXIS = "shards"
 
-_lock = threading.Lock()
+_lock = named_lock("tpu.mesh._lock")
 _default_mesh: Optional[Mesh] = None
 
 
