@@ -553,6 +553,11 @@ def main():
         # Tiered-store occupancy + spill/promote counters: attributes any
         # RSS/HBM movement to spill traffic (0 spills == fully resident).
         detail["storage"] = ctx.storage_status()
+        # Shuffle-fetch pipeline counters (streams / buckets / round trips
+        # / overlap seconds): in local mode these are local-tier reads
+        # (zero round trips); on a multi-executor run the round-trip count
+        # is the batching win (1 per (reducer, server) vs 1 per bucket).
+        detail["fetch"] = ctx.metrics_summary().get("fetch", {})
         _leg_history_compare_and_append(detail)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "

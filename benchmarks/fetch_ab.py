@@ -1,0 +1,144 @@
+"""A/B: per-bucket vs batched shuffle fetch over real sockets.
+
+The reference pulls one bucket per HTTP GET (shuffle_fetcher.rs:33-100);
+vega_tpu's framed-TCP port kept that shape — one request/response round per
+(map_id, reduce_id) — until the pipelined shuffle plane (get_many) batched
+every bucket a reducer needs from a server into ONE round trip answered as
+a stream. This benchmark measures both legs against a real in-process
+ShuffleServer: same store, same sockets, same buckets; only the protocol
+differs. The per-bucket leg pays M serialized request/response rounds per
+server; the batched leg pays 1.
+
+Prints ONE JSON line (medians of 3; this 1-core sandbox carries ~±15%
+single-run noise, see CLAUDE.md). Usage:
+
+  python benchmarks/fetch_ab.py [n_buckets] [bucket_kib]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# No jax needed on the fetch plane, but importing vega_tpu must never
+# probe a (possibly wedged) TPU backend: force the CPU mesh first, like
+# every benchmark here.
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)
+
+REPS = 3
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+_SERVER_CHILD = """
+import sys
+sys.path.insert(0, {root!r})
+from _cpu_mesh import force_cpu_mesh
+force_cpu_mesh(8)
+import time
+from vega_tpu.distributed.shuffle_server import ShuffleServer
+from vega_tpu.shuffle.store import ShuffleStore
+
+store = ShuffleStore()
+payload = b"x" * {bucket_bytes}
+for m in range({n_buckets}):
+    store.put(0, m, 0, payload)
+server = ShuffleServer(store)
+print(server.uri, flush=True)
+time.sleep(600)
+"""
+
+
+def main():
+    n_buckets = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    bucket_kib = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+
+    import subprocess
+
+    from vega_tpu.env import Env
+    from vega_tpu.map_output_tracker import MapOutputTracker
+    from vega_tpu.shuffle import fetcher as fetcher_mod
+    from vega_tpu.shuffle.fetcher import ShuffleFetcher
+
+    payload = b"x" * (bucket_kib * 1024)
+    # The server lives in its OWN process (the executor shape): turnaround
+    # latency is a real cross-process wakeup, not a same-interpreter GIL
+    # handoff — that per-request turnaround is exactly what batching
+    # eliminates.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_CHILD.format(
+            root=root, n_buckets=n_buckets,
+            bucket_bytes=len(payload))],
+        stdout=subprocess.PIPE, text=True,
+    )
+    uri = child.stdout.readline().strip()
+    assert uri, "server child failed to start"
+
+    env = Env.get()
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(0, n_buckets)
+    tracker.register_map_outputs(0, [uri] * n_buckets)
+    env.map_output_tracker = tracker
+    env.shuffle_server = None  # force the socket path, not local reads
+
+    def one_rep(batched: bool):
+        env.conf.fetch_batch_enabled = batched
+        fetcher_mod.reset_stats()
+        t0 = time.time()
+        n = 0
+        total = 0
+        for blob in ShuffleFetcher.fetch_stream(0, 0):
+            n += 1
+            total += len(blob)
+        wall = time.time() - t0
+        assert n == n_buckets and total == n_buckets * len(payload)
+        return wall, fetcher_mod.stats_snapshot()["round_trips"]
+
+    try:
+        # warm both paths once (socket pool, code paths) before timing
+        for b in (False, True):
+            env.conf.fetch_batch_enabled = b
+            assert sum(1 for _ in ShuffleFetcher.fetch_stream(0, 0)) \
+                == n_buckets
+        # Interleave the legs A/B per repetition so slow host-level drift
+        # (noisy neighbors on this shared 1-core sandbox) hits both legs
+        # equally instead of biasing whichever ran second.
+        pb_walls, b_walls = [], []
+        per_bucket_rtt = batched_rtt = 0
+        for _ in range(REPS):
+            w, per_bucket_rtt = one_rep(batched=False)
+            pb_walls.append(w)
+            w, batched_rtt = one_rep(batched=True)
+            b_walls.append(w)
+        per_bucket_s, batched_s = median(pb_walls), median(b_walls)
+    finally:
+        env.conf.fetch_batch_enabled = True
+        child.kill()
+        child.wait()
+
+    print(json.dumps({
+        "metric": "shuffle fetch wall time, per-bucket vs batched "
+                  "get_many (one server process, real sockets; "
+                  "medians of 3)",
+        "buckets": n_buckets,
+        "bucket_bytes": len(payload),
+        "per_bucket_s": round(per_bucket_s, 6),
+        "batched_s": round(batched_s, 6),
+        "speedup": round(per_bucket_s / batched_s, 2) if batched_s else None,
+        "round_trips_per_reducer_server": {
+            "per_bucket": per_bucket_rtt,
+            "batched": batched_rtt,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -302,6 +302,7 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
                 "backend": backend,
             }))
 
+        fetch_before = ctx.metrics_summary().get("fetch", {})
         rows, host_s, dev_s = fn(ctx, scale, bank)
         rec = {
             "config": c,
@@ -311,10 +312,22 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
             "device_s": round(dev_s, 3),
             "device_vs_host": round(host_s / dev_s, 2) if dev_s else None,
             "backend": backend,
+            # Per-config shuffle-fetch delta (streams/buckets/round trips/
+            # overlap): attributes the pipelined-fetch contribution to each
+            # leg instead of one cumulative blob at the end.
+            "fetch": _fetch_delta(fetch_before,
+                                  ctx.metrics_summary().get("fetch", {})),
         }
         emit(json.dumps(rec))
         results.append(rec)
     return results
+
+
+def _fetch_delta(before: dict, after: dict) -> dict:
+    return {k: (round(after.get(k, 0) - before.get(k, 0), 6)
+                if isinstance(after.get(k, 0), float)
+                else after.get(k, 0) - before.get(k, 0))
+            for k in after}
 
 
 def main():

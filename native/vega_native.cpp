@@ -34,6 +34,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <new>
 #include <unordered_map>
 #include <vector>
 
@@ -304,11 +305,47 @@ static PyObject* bucket_pairs(PyObject*, PyObject* args) {
   return out;
 }
 
+// Reduce-side merge accumulator. Shared by the one-shot merge_encoded and
+// the streaming merge_state_* entry points (shuffle/fetcher.py's pipelined
+// fetch feeds buckets here AS THEY ARRIVE, so the merge overlaps network
+// time instead of following it). Semantics are identical either way: the
+// result is int-typed iff every fed blob was int-typed, and an int64
+// combine overflow poisons the state — finish then reports failure and the
+// caller redoes the merge with exact Python bignums.
+struct MergeState {
+  std::unordered_map<int64_t, Acc> combined;
+  bool int_inputs = true;   // every blob int-typed so far
+  bool overflowed = false;  // an int64 combine overflowed
+};
+
+static void merge_state_feed_rows(MergeState* st, const Row* rows,
+                                  size_t count, int blob_is_int, int op) {
+  st->int_inputs = st->int_inputs && (blob_is_int != 0);
+  for (size_t r = 0; r < count; ++r) {
+    double dv = blob_is_int ? static_cast<double>(rows[r].bits)
+                            : bits2d(rows[r].bits);
+    int64_t iv = blob_is_int ? rows[r].bits : 0;
+    auto it = st->combined.find(rows[r].key);
+    if (it == st->combined.end()) {
+      st->combined.emplace(rows[r].key, Acc{dv, iv});
+    } else {
+      it->second.d = apply_op_d(op, it->second.d, dv);
+      if (st->int_inputs && !st->overflowed &&
+          !apply_op_i(op, it->second.i, iv, &it->second.i)) {
+        st->overflowed = true;
+      }
+    }
+  }
+}
+
+static PyObject* merge_state_result(const MergeState& st) {
+  if (st.int_inputs && st.overflowed) {
+    Py_RETURN_NONE;  // exact Python bignum merge instead of rounding
+  }
+  return pair_list_from_accs(st.combined, st.int_inputs && !st.overflowed);
+}
+
 // merge_encoded(list[(bytes, is_int)], op) -> list[(int, float|int)] | None
-// Reduce-side merge across buckets with per-blob value typing; the result
-// is int-typed iff every input blob was int-typed. If an int combine
-// overflows int64 the call returns None and the caller redoes the merge
-// with the exact pure-Python decoder (merge_encoded_py).
 static PyObject* merge_encoded(PyObject*, PyObject* args) {
   PyObject* blobs;
   int op;
@@ -316,9 +353,7 @@ static PyObject* merge_encoded(PyObject*, PyObject* args) {
   PyObject* seq = PySequence_Fast(blobs, "expected a sequence of (bytes, int)");
   if (seq == nullptr) return nullptr;
 
-  std::unordered_map<int64_t, Acc> combined;
-  bool int_inputs = true;   // every blob int-typed so far
-  bool overflowed = false;  // an int64 combine overflowed
+  MergeState st;
   Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
   for (Py_ssize_t idx = 0; idx < n; ++idx) {
     PyObject* entry = PySequence_Fast_GET_ITEM(seq, idx);
@@ -334,30 +369,69 @@ static PyObject* merge_encoded(PyObject*, PyObject* args) {
       Py_DECREF(seq);
       return nullptr;
     }
-    int_inputs = int_inputs && (blob_is_int != 0);
-    size_t count = static_cast<size_t>(size) / sizeof(Row);
-    const Row* rows = reinterpret_cast<const Row*>(data);
-    for (size_t r = 0; r < count; ++r) {
-      double dv = blob_is_int ? static_cast<double>(rows[r].bits)
-                              : bits2d(rows[r].bits);
-      int64_t iv = blob_is_int ? rows[r].bits : 0;
-      auto it = combined.find(rows[r].key);
-      if (it == combined.end()) {
-        combined.emplace(rows[r].key, Acc{dv, iv});
-      } else {
-        it->second.d = apply_op_d(op, it->second.d, dv);
-        if (int_inputs && !overflowed &&
-            !apply_op_i(op, it->second.i, iv, &it->second.i)) {
-          overflowed = true;
-        }
-      }
-    }
+    merge_state_feed_rows(&st, reinterpret_cast<const Row*>(data),
+                          static_cast<size_t>(size) / sizeof(Row),
+                          blob_is_int, op);
   }
   Py_DECREF(seq);
-  if (int_inputs && overflowed) {
-    Py_RETURN_NONE;  // exact Python bignum merge instead of rounding
+  return merge_state_result(st);
+}
+
+// ---- streaming merge (accumulator reuse across arriving buckets) ----------
+
+static constexpr const char* kMergeStateCapsule = "vega_tpu.MergeState";
+
+static void merge_state_destroy(PyObject* capsule) {
+  delete static_cast<MergeState*>(
+      PyCapsule_GetPointer(capsule, kMergeStateCapsule));
+}
+
+static MergeState* merge_state_from(PyObject* capsule) {
+  return static_cast<MergeState*>(
+      PyCapsule_GetPointer(capsule, kMergeStateCapsule));
+}
+
+// merge_state_new() -> capsule
+static PyObject* merge_state_new(PyObject*, PyObject*) {
+  MergeState* st = new (std::nothrow) MergeState();
+  if (st == nullptr) return PyErr_NoMemory();
+  PyObject* cap = PyCapsule_New(st, kMergeStateCapsule, merge_state_destroy);
+  if (cap == nullptr) delete st;
+  return cap;
+}
+
+// merge_state_feed(capsule, bytes, is_int, op) -> None
+// Feeds one encoded bucket into the accumulator. Accepts any buffer
+// (bytes or a memoryview over the wire payload) without copying.
+static PyObject* merge_state_feed(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  Py_buffer view;
+  int is_int;
+  int op;
+  if (!PyArg_ParseTuple(args, "Oy*ii", &capsule, &view, &is_int, &op))
+    return nullptr;
+  MergeState* st = merge_state_from(capsule);
+  if (st == nullptr) {
+    PyBuffer_Release(&view);
+    return nullptr;
   }
-  return pair_list_from_accs(combined, int_inputs && !overflowed);
+  merge_state_feed_rows(st, static_cast<const Row*>(view.buf),
+                        static_cast<size_t>(view.len) / sizeof(Row),
+                        is_int, op);
+  PyBuffer_Release(&view);
+  Py_RETURN_NONE;
+}
+
+// merge_state_finish(capsule) -> list[(int, float|int)] | None
+// None = an int64 combine overflowed somewhere in the stream; the caller
+// must redo the whole merge on the exact pure-Python path (the state keeps
+// no raw buckets, so the redo refetches — rare by construction).
+static PyObject* merge_state_finish(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  MergeState* st = merge_state_from(capsule);
+  if (st == nullptr) return nullptr;
+  return merge_state_result(*st);
 }
 
 // decode_pairs(bytes, is_int) -> list[(int, float|int)]
@@ -466,6 +540,12 @@ static PyMethodDef kMethods[] = {
      "Hash-bucket (int, number) pairs without combining."},
     {"merge_encoded", merge_encoded, METH_VARARGS,
      "Merge encoded (bytes, is_int) buckets with a named op."},
+    {"merge_state_new", merge_state_new, METH_NOARGS,
+     "New streaming-merge accumulator (capsule)."},
+    {"merge_state_feed", merge_state_feed, METH_VARARGS,
+     "Feed one encoded bucket into a streaming-merge accumulator."},
+    {"merge_state_finish", merge_state_finish, METH_VARARGS,
+     "Finish a streaming merge: pair list, or None on int64 overflow."},
     {"decode_pairs", decode_pairs, METH_VARARGS,
      "Decode packed rows to a list of pairs."},
     {"encode_pairs", encode_pairs, METH_VARARGS,

@@ -151,6 +151,39 @@ def test_dropped_fetch_recovers_in_place_no_resubmission(monkeypatch, tmp_path):
         ctx.stop()
 
 
+def test_get_many_stream_cut_mid_batch_recovers_partial_retry(
+        monkeypatch, tmp_path):
+    """Tentpole acceptance: a connection dropped MID-get_many-stream (the
+    server cuts after framing one bucket) recovers via the missing-tail
+    retry — results bit-identical to a fault-free run, delivered buckets
+    never re-merged (a double-merge would double-count the sums), and NO
+    stage resubmission or executor loss (the in-place vs resubmit
+    distinction, now reproven for partial batches)."""
+    stats_dir = str(tmp_path / "stats")
+    # 8 map partitions over 2 executors: each (reducer, server) get_many
+    # carries several buckets, so the cut lands mid-batch with real
+    # delivered state behind it. Two injections so both a first stream
+    # and its successor's stream get cut.
+    monkeypatch.setenv("VEGA_TPU_FAULT_FETCH_STREAM_DROP_N", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_FETCH_DROP_AFTER_BUCKETS", "1")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context()
+    try:
+        assert _reduce_job(ctx) == _expected_reduce()
+        cuts = [s for s in faults.read_stats(stats_dir)
+                if s["fault"] == "fetch_stream_drop"]
+        assert cuts, "no get_many stream was ever cut mid-batch"
+        assert all(c["bucket_index"] >= 1 for c in cuts), \
+            "cuts must land AFTER at least one delivered bucket"
+        summary = ctx.metrics_summary()
+        assert summary["stages_resubmitted"] == 0, \
+            "a partial batch must recover in place, not resubmit"
+        assert summary["executors_lost"] == 0
+    finally:
+        ctx.stop()
+
+
 def test_corrupt_disk_bucket_reads_as_missing_then_stage_retry(
         monkeypatch, tmp_path):
     """Satellite: flip bytes in a spilled shuffle file on an executor; the

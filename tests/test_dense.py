@@ -2009,6 +2009,72 @@ def test_table_plan_warm_reduce_and_repair(dctx):
     assert r4._table_plan is True
 
 
+def test_table_plan_concurrent_no_defer_falls_through(dctx):
+    """Regression (ADVICE r5): a settlement repair that sets
+    _dense_no_defer AFTER the table-plan gate but BEFORE its launch must
+    make the reduce fall through to the standard plan — not feed the
+    fixed-caps table program into _run_exchange's blocking retry loop,
+    whose grown capacities the table build ignores (six identical
+    launches ending in a spurious VegaError). Simulated by flipping the
+    flag from inside the table program's cache lookup — the worst-timed
+    interleaving."""
+    from vega_tpu.tpu import dense_rdd as dr
+
+    def build():
+        return (dctx.dense_range(20_000).map(lambda x: (x % 1_000, x))
+                .reduce_by_key(op="add"))
+
+    exp = dict(build().collect())  # cold: learns the range
+    warm = build()
+    assert dict(warm.collect()) == exp
+    assert warm._table_plan is True  # hint active: table plan armed
+
+    real = dr._cached_program
+
+    def racing(key, build_fn):
+        prog = real(key, build_fn)
+        if isinstance(key, tuple) and key and key[0] == "rbk_table":
+            # The concurrent repair lands exactly here.
+            dctx.__dict__["_dense_no_defer"] = True
+        return prog
+
+    dr._cached_program = racing
+    try:
+        r = build()
+        got = dict(r.collect())  # must NOT raise VegaError
+        assert got == exp
+        assert r._table_plan is False  # fell through to the standard plan
+    finally:
+        dr._cached_program = real
+        dctx.__dict__["_dense_no_defer"] = False
+
+
+def test_multiproc_memo_resets_on_multihost_init(monkeypatch):
+    """Regression (ADVICE r5): init_multihost must reset the
+    single-vs-multi-process eviction-policy memo next to
+    set_default_mesh(None) — a stop()+new-multihost-Context process would
+    otherwise keep running the single-process LRU/weakref policy on a
+    multi-process mesh."""
+    from vega_tpu.tpu import dense_rdd as dr, mesh as mesh_lib
+
+    # Pretend this process already resolved the policy single-process.
+    monkeypatch.setattr(dr, "_lifetime_multiproc_memo", False)
+    # jax.distributed cannot actually rendezvous here; stub it and
+    # restore every module-global init_multihost mutates.
+    monkeypatch.setattr(mesh_lib.jax.distributed, "initialize",
+                        lambda **kw: None)
+    monkeypatch.setattr(mesh_lib, "_multihost_settings", None)
+    monkeypatch.setattr(mesh_lib, "_multihost_heartbeat_s", None)
+    saved_mesh = mesh_lib._default_mesh
+    try:
+        mesh_lib.init_multihost(coordinator="127.0.0.1:0",
+                                num_processes=1, process_id=0)
+        assert dr._lifetime_multiproc_memo is None, \
+            "init_multihost must invalidate the eviction-policy memo"
+    finally:
+        mesh_lib.set_default_mesh(saved_mesh)
+
+
 def test_dense_spilled_block_parity(dctx):
     """Tiered-store acceptance: a persisted (MEMORY_AND_DISK) dense node
     whose block was demoted to disk under HBM pressure promotes back

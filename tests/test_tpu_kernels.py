@@ -61,6 +61,45 @@ def test_ring_sort_and_join(ring_ctx):
     assert left.join(right).count() == 1000
 
 
+def test_sort_impl_flip_mints_fresh_programs(ring_ctx):
+    """Regression (ADVICE r5): an in-process dense_sort_impl flip must
+    re-trace every cached program that can reach _group_by_bucket's
+    escape hatch — the resolved impl is read at trace time, so a stale
+    cached program would silently A/B the wrong implementation. The ring
+    exchange on CPU takes the escape hatch (prefer_low_memory with no
+    Pallas path), and the sort node exercises the exchange keys. Results
+    must also be identical under either impl (both groupings are
+    stable)."""
+    from vega_tpu.env import Env
+    from vega_tpu.tpu import dense_rdd as dr
+
+    def run():
+        return sorted(
+            (k, sorted(vs)) for k, vs in
+            ring_ctx.dense_range(8_000).map(lambda x: (x % 97, x))
+            .group_by_key().collect()
+        )
+
+    conf = Env.get().conf
+    old = conf.dense_sort_impl
+
+    def gbk_keys():
+        return {k for k in dr._PROGRAM_CACHE if k[0] == "gbk"}
+
+    try:
+        conf.dense_sort_impl = "xla"
+        first = run()
+        keys_xla = gbk_keys()
+        assert any("xla" in k for k in keys_xla)
+        conf.dense_sort_impl = "packed"
+        assert run() == first  # bit-identical across impls
+        fresh = gbk_keys() - keys_xla
+        assert fresh and all("packed" in k for k in fresh), \
+            "the flipped impl must mint fresh programs, not reuse stale"
+    finally:
+        conf.dense_sort_impl = old
+
+
 def test_ring_skew_overflow(ring_ctx):
     got = dict(
         ring_ctx.dense_range(4096).map(lambda x: (x * 0, x))

@@ -108,6 +108,10 @@ class Context:
             # surface through the shuffle server's `status`).
             env.cache.event_sink = self.bus.post
             env.shuffle_store.event_sink = self.bus.post
+            # Fetch-pipeline observability: driver-side reduce tasks post
+            # ShuffleFetchCompleted per stream (round trips / bytes /
+            # overlap); executor fetches keep fetcher-local counters.
+            env.fetch_event_sink = self.bus.post
 
             if mode is DeploymentMode.LOCAL:
                 self._backend = LocalBackend()

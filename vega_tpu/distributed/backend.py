@@ -141,6 +141,9 @@ class DistributedBackend(TaskBackend):
                 VEGA_TPU_HEARTBEAT_INTERVAL_S=str(self.conf.heartbeat_interval_s),
                 VEGA_TPU_FETCH_RETRIES=str(self.conf.fetch_retries),
                 VEGA_TPU_FETCH_RETRY_INTERVAL_S=str(self.conf.fetch_retry_interval_s),
+                VEGA_TPU_FETCH_BATCH_ENABLED=(
+                    "1" if self.conf.fetch_batch_enabled else "0"),
+                VEGA_TPU_FETCH_QUEUE_BUCKETS=str(self.conf.fetch_queue_buckets),
                 # Respawned incarnations disarm one-shot fault injections
                 # (faults.py): a chaos-killed slot comes back healthy.
                 VEGA_TPU_FAULT_INCARNATION=str(incarnation),
@@ -162,6 +165,9 @@ class DistributedBackend(TaskBackend):
             f"VEGA_TPU_HEARTBEAT_INTERVAL_S={self.conf.heartbeat_interval_s}",
             f"VEGA_TPU_FETCH_RETRIES={self.conf.fetch_retries}",
             f"VEGA_TPU_FETCH_RETRY_INTERVAL_S={self.conf.fetch_retry_interval_s}",
+            "VEGA_TPU_FETCH_BATCH_ENABLED="
+            + ("1" if self.conf.fetch_batch_enabled else "0"),
+            f"VEGA_TPU_FETCH_QUEUE_BUCKETS={self.conf.fetch_queue_buckets}",
             f"VEGA_TPU_FAULT_INCARNATION={incarnation}",
             sys.executable, "-m",
             "vega_tpu.distributed.worker",

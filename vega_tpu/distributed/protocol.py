@@ -7,7 +7,24 @@ SURVEY.md §2.5). vega_tpu frames with an 8-byte little-endian length prefix
 (native/) accelerates bulk shuffle payloads.
 
 Message shape: (msg_type: str, payload) tuples, request/response per
-connection round.
+connection round — EXCEPT the shuffle plane's `get_many`, which is one
+request answered by a STREAM of per-bucket replies (the batched pull that
+collapses M fetch round trips into 1; see Exoshuffle, PAPERS.md). The
+stream grammar lives here so the server (shuffle_server._Handler) and the
+client (fetch_many_remote) can never drift:
+
+    -> ("get_many", (shuffle_id, [map_id, ...], reduce_id))
+    <- per requested map_id, in request order:
+         ("bucket", map_id) + one raw bytes frame        (bucket served)
+       | ("bucket_missing", map_id)                      (gone: FetchFailed;
+                                                          ends the stream —
+                                                          the client drops
+                                                          the connection)
+    <- ("batch_end", n_sent)                             (stream terminator)
+
+Per-bucket status is preserved (a missing bucket escalates exactly like the
+single-`get` "missing" reply) and the terminator lets the client detect a
+truncated stream (dropped connection mid-batch) and retry ONLY the tail.
 """
 
 from __future__ import annotations
@@ -87,6 +104,22 @@ def request(host: str, port: int, msg_type: str, payload: Any = None,
         if reply_type == "error":
             raise NetworkError(f"remote error for {msg_type}: {reply}")
         return reply
+
+
+def send_bucket(sock: socket.socket, map_id: int, data: bytes) -> None:
+    """One served bucket of a `get_many` stream: status frame then payload
+    frame. The payload rides send_bytes (no pickling) so the server's write
+    path is bytes-in/bytes-out from whichever ShuffleStore tier held it."""
+    send_msg(sock, "bucket", map_id)
+    send_bytes(sock, data)
+
+
+def send_bucket_missing(sock: socket.socket, map_id: int) -> None:
+    send_msg(sock, "bucket_missing", map_id)
+
+
+def send_batch_end(sock: socket.socket, n_sent: int) -> None:
+    send_msg(sock, "batch_end", n_sent)
 
 
 def parse_uri(uri: str) -> Tuple[str, int]:

@@ -8,6 +8,12 @@ vega_tpu serves the same keying over the framed-TCP protocol instead of
 HTTP — one round trip, zero header overhead, and the payload path stays
 zero-copy (bytes in, bytes out of the ShuffleStore). A `status` message
 doubles as the healthcheck (shuffle_manager.rs:34-52's status checker).
+
+Where the reference pays one GET per (map_id, reduce_id) bucket
+(shuffle_fetcher.rs:33-100), `get_many` batches every bucket a reducer
+needs from this server into ONE request answered by a stream of framed
+per-bucket replies (protocol.py grammar) — M round trips become 1, and
+the client merges buckets while later ones are still on the wire.
 """
 
 from __future__ import annotations
@@ -47,6 +53,32 @@ class _Handler(socketserver.BaseRequestHandler):
                     else:
                         protocol.send_msg(sock, "ok", None)
                         protocol.send_bytes(sock, data)
+                elif msg_type == "get_many":
+                    # Batched pull: one request for every bucket this
+                    # reducer needs from this server, answered as a stream
+                    # of per-bucket replies (protocol.py grammar). Buckets
+                    # are read lazily (store.iter_buckets) straight into
+                    # the framed write path — disk-tier buckets included —
+                    # so a big batch never materializes server-side.
+                    shuffle_id, map_ids, reduce_id = payload
+                    inj = faults.get()
+                    for i, (map_id, data) in enumerate(
+                            store.iter_buckets(shuffle_id, map_ids,
+                                               reduce_id)):
+                        if inj.serve_fetch() or inj.serve_stream_fetch(i):
+                            # Injected fault: cut the connection mid-stream
+                            # — the client must retry ONLY the undelivered
+                            # tail (exactly-once per bucket).
+                            return
+                        if data is None:
+                            # The client escalates FetchFailed and drops
+                            # the connection on this reply — nothing sent
+                            # after it is ever read, so stop streaming
+                            # (and stop paying disk reads) right here.
+                            protocol.send_bucket_missing(sock, map_id)
+                            return
+                        protocol.send_bucket(sock, map_id, data)
+                    protocol.send_batch_end(sock, len(map_ids))
                 elif msg_type == "status":
                     # Tier occupancy + spill counters (store.status());
                     # "entries" keeps the original healthcheck contract.
@@ -151,6 +183,96 @@ def fetch_remote(uri: str, shuffle_id: int, map_id: int, reduce_id: int) -> byte
         uri, shuffle_id, map_id, reduce_id,
         f"fetch failed after {attempts} attempts: {last_error}",
     ) from last_error
+
+
+def fetch_many_remote(uri: str, shuffle_id: int, map_ids, reduce_id: int,
+                      deliver) -> int:
+    """Batched fetch: ONE `get_many` round trip for every bucket this
+    reducer needs from `uri`, with per-bucket replies streamed back and
+    handed to `deliver(map_id, data)` as they come off the wire (the
+    caller overlaps decode/merge with the remaining network time).
+
+    Recovery contract (the mid-stream edition of fetch_remote's): a
+    connection dropped partway through the stream is retried in place,
+    re-requesting ONLY the undelivered tail — buckets already handed to
+    `deliver` are never refetched or re-merged (exactly-once per bucket).
+    A "bucket_missing" reply escalates FetchFailedError immediately, same
+    as the single-get "missing". Returns the number of round trips spent
+    (1 on the fault-free path, whatever M buckets it carried)."""
+    from vega_tpu.env import Env
+
+    conf = Env.get().conf
+    attempts = max(1, int(getattr(conf, "fetch_retries", 3)))
+    interval = float(getattr(conf, "fetch_retry_interval_s", 0.2))
+    remaining = dict.fromkeys(map_ids)  # ordered set of undelivered ids
+    round_trips = 0
+    last_error: Optional[NetworkError] = None
+    for attempt in range(attempts):
+        try:
+            return _get_many_round(uri, shuffle_id, remaining, reduce_id,
+                                   deliver, round_trips)
+        except NetworkError as e:
+            _drop_connection(uri)
+            last_error = e
+            round_trips += 1  # the failed round still went on the wire
+            if attempt + 1 < attempts:
+                log.warning(
+                    "transient batched-fetch failure from %s (attempt "
+                    "%d/%d, %d/%d buckets delivered): %s; retrying tail "
+                    "in place", uri, attempt + 1, attempts,
+                    len(map_ids) - len(remaining), len(map_ids), e)
+                time.sleep(interval * (attempt + 1))
+    first_missing = next(iter(remaining), None)
+    raise FetchFailedError(
+        uri, shuffle_id, first_missing, reduce_id,
+        f"batched fetch failed after {attempts} attempts: {last_error}",
+    ) from last_error
+
+
+def _get_many_round(uri, shuffle_id, remaining, reduce_id, deliver,
+                    round_trips):
+    """One get_many request/stream round. Raises NetworkError for
+    transient faults (caller retries the tail); anything else — a
+    bucket_missing escalation, or an exception out of the caller's
+    `deliver` — drops the pooled connection first, because the socket
+    still holds unconsumed stream frames and the next pooled request on
+    this thread would read them as its own reply."""
+    clean = False
+    try:
+        sock = _pooled_connection(uri)
+        protocol.send_msg(sock, "get_many",
+                          (shuffle_id, list(remaining), reduce_id))
+        round_trips += 1
+        while True:
+            reply_type, payload = protocol.recv_msg(sock)
+            if reply_type == "bucket":
+                data = protocol.recv_bytes(sock)
+                if payload in remaining:  # tolerate benign repeats
+                    deliver(payload, data)
+                    del remaining[payload]
+            elif reply_type == "bucket_missing":
+                raise FetchFailedError(uri, shuffle_id, payload,
+                                       reduce_id,
+                                       "server has no such bucket")
+            elif reply_type == "batch_end":
+                break
+            else:
+                raise NetworkError(
+                    f"unexpected get_many reply {reply_type!r}")
+        if not remaining:
+            clean = True
+            return round_trips
+        # A well-framed batch_end with buckets still undelivered means
+        # the server never saw them in the request — protocol breakage,
+        # not transience: retrying the same request would get the same
+        # truncated answer, so escalate without burning the retry budget.
+        raise FetchFailedError(
+            uri, shuffle_id, next(iter(remaining)), reduce_id,
+            f"get_many stream ended with {len(remaining)} buckets "
+            "undelivered")
+    finally:
+        if not clean:
+            _drop_connection(uri)
 
 
 def check_status(uri: str, timeout: float = 5.0) -> Optional[dict]:

@@ -98,6 +98,17 @@ class Configuration:
     # A server answering "missing" escalates immediately (not transient).
     fetch_retries: int = 3
     fetch_retry_interval_s: float = 0.2
+    # Pipelined shuffle fetch (shuffle/fetcher.py): batched `get_many`
+    # requests — ONE round trip per (reducer, server) instead of one per
+    # bucket — answered as a stream the reducer merges while later
+    # buckets are still on the wire. 0/false falls back to the per-bucket
+    # `get` protocol (same pipeline, M round trips).
+    fetch_batch_enabled: bool = True
+    # Bound on the fetch pipeline's bucket queue: at most this many
+    # fetched-but-unmerged buckets are resident per reduce task (producer
+    # threads block past it — backpressure IS the reducer's peak-memory
+    # bound; the old path materialized the entire List[bytes]).
+    fetch_queue_buckets: int = 32
     # Dense-tier shuffle collective: "all_to_all" (one fused collective,
     # [n_shards x slot] peak buffer) or "ring" (n-1 ppermute steps, one-slot
     # peak buffer — for big blocks on big meshes). See tpu/ring.py.
@@ -177,11 +188,12 @@ class Configuration:
                      "CACHE_CAPACITY_BYTES", "MAX_FAILURES",
                      "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
                      "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
-                     "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES"):
+                     "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
+                     "FETCH_QUEUE_BUCKETS"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
-                     "SPECULATION"):
+                     "SPECULATION", "FETCH_BATCH_ENABLED"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
         for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",
@@ -279,6 +291,10 @@ class Env:
         self.cache_tracker = None
         self.shuffle_server = None  # distributed mode only
         self.executor_id: Optional[str] = None
+        # Set by the Context to LiveListenerBus.post (driver-side): the
+        # shuffle fetcher posts ShuffleFetchCompleted per reduce stream.
+        # Executors keep process-local counters only (fetcher.stats).
+        self.fetch_event_sink = None
 
     @classmethod
     def get(cls) -> "Env":

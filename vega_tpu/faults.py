@@ -11,7 +11,11 @@ the distributed plane consults at its natural failure seams:
                       suppress_heartbeat() (wedge: alive but silent)
   - shuffle_server -> serve_fetch() (drop the connection / delay the reply
                       for the first N bucket gets — a transient network
-                      fault the fetch-retry path must absorb)
+                      fault the fetch-retry path must absorb),
+                      serve_stream_fetch(i) (cut a get_many batch stream
+                      after serving FETCH_DROP_AFTER_BUCKETS buckets — the
+                      partial-batch fault the missing-tail retry must
+                      absorb without re-merging delivered buckets)
   - shuffle/store  -> corrupt_spilled(disk, key) (flip payload bytes in a
                       spilled bucket file — the checksummed read must turn
                       it into a miss, never wrong data)
@@ -28,6 +32,12 @@ tests:
   VEGA_TPU_FAULT_SUPPRESS_HEARTBEATS 1 -> stop heartbeating (stay alive)
   VEGA_TPU_FAULT_FETCH_DROP_N        drop the first N shuffle-bucket gets
   VEGA_TPU_FAULT_FETCH_DELAY_S       delay every served get by S seconds
+  VEGA_TPU_FAULT_FETCH_STREAM_DROP_N cut the first N get_many streams
+                                     mid-batch (after ..._AFTER_BUCKETS
+                                     buckets have been served)
+  VEGA_TPU_FAULT_FETCH_DROP_AFTER_BUCKETS
+                                     buckets to serve before the stream
+                                     cut (default 1: deliver one, drop)
   VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
   VEGA_TPU_FAULT_STATS_DIR           append one JSON line per injected
                                      fault to <dir>/faults-<pid>.jsonl so
@@ -87,6 +97,8 @@ class FaultInjector:
         self.suppress_heartbeats = armed and _flag("SUPPRESS_HEARTBEATS")
         self.fetch_drop_n = _int("FETCH_DROP_N") if armed else 0
         self.fetch_delay_s = _float("FETCH_DELAY_S") if armed else 0.0
+        self.fetch_stream_drop_n = _int("FETCH_STREAM_DROP_N") if armed else 0
+        self.fetch_drop_after_buckets = _int("FETCH_DROP_AFTER_BUCKETS", 1)
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
         self.stats_dir = env.get(pref + "STATS_DIR") or None
 
@@ -101,6 +113,7 @@ class FaultInjector:
             self.kill_after_tasks or self.hang_tasks
             or self.suppress_heartbeats or self.fetch_drop_n
             or self.fetch_delay_s or self.corrupt_spill_n
+            or self.fetch_stream_drop_n
         )
 
     def _targets_me(self) -> bool:
@@ -159,6 +172,25 @@ class FaultInjector:
             self.fetch_drop_n -= 1
         self._record("fetch_drop")
         log.warning("FAULT: dropping shuffle fetch connection")
+        return True
+
+    def serve_stream_fetch(self, bucket_index: int) -> bool:
+        """shuffle_server.py, per bucket of a get_many stream: True -> cut
+        the connection NOW, after `fetch_drop_after_buckets` buckets have
+        already been framed — a partial batch the client must complete by
+        retrying only the undelivered tail."""
+        if not (self.active and self.fetch_stream_drop_n
+                and self._targets_me()):
+            return False
+        if bucket_index < self.fetch_drop_after_buckets:
+            return False
+        with self._lock:
+            if self.fetch_stream_drop_n <= 0:
+                return False
+            self.fetch_stream_drop_n -= 1
+        self._record("fetch_stream_drop", bucket_index=bucket_index)
+        log.warning("FAULT: cutting get_many stream after %d buckets",
+                    bucket_index)
         return True
 
     def corrupt_spilled(self, disk_store, key: str) -> None:

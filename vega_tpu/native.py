@@ -55,6 +55,50 @@ def merge_encoded_py(flagged_blobs, op_name: str):
             combined[k] = op(combined[k], v) if k in combined else v
     return list(combined.items())
 
+
+class StreamingMerge:
+    """Incremental reduce-side merge: feed encoded buckets AS THEY ARRIVE
+    off the pipelined fetch (shuffle/fetcher.fetch_stream), so the merge
+    overlaps network time instead of following the last byte.
+
+    Backed by the C++ accumulator (merge_state_new/feed/finish) when the
+    compiled module is present, else an exact pure-Python dict (bignum
+    ints — no overflow case). finish() returns the merged pair list, or
+    None iff the NATIVE path saw an int64 overflow: the caller must then
+    redo the merge on the exact Python path (results must be bit-identical
+    whichever host path ran — silently rounding through doubles is the one
+    thing this contract forbids). Not thread-safe: one reduce task, one
+    merger."""
+
+    def __init__(self, op_name: str):
+        self._op = OP_BY_NAME[op_name]
+        nat = get()
+        if nat is not None and hasattr(nat, "merge_state_new"):
+            self._nat = nat
+            self._state = nat.merge_state_new()
+            self._py_op = None
+            self._acc = None
+        else:
+            self._nat = None
+            self._state = None
+            self._py_op = _PY_OPS[op_name]
+            self._acc = {}
+
+    def feed(self, payload: bytes, is_int: bool) -> None:
+        if self._nat is not None:
+            self._nat.merge_state_feed(self._state, payload,
+                                       1 if is_int else 0, self._op)
+            return
+        op = self._py_op
+        acc = self._acc
+        for k, v in decode_pairs_py(payload, bool(is_int)):
+            acc[k] = op(acc[k], v) if k in acc else v
+
+    def finish(self):
+        if self._nat is not None:
+            return self._nat.merge_state_finish(self._state)
+        return list(self._acc.items())
+
 _lock = named_lock("native._lock")
 _native = None
 _load_attempted = False

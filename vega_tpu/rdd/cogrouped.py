@@ -66,7 +66,10 @@ class CoGroupedRDD(RDD):
                     slot(k)[i].append(v)
             else:
                 # Shuffled: each fetched combiner is already a list of values
-                # (reference: co_grouped_rdd.rs:226-243).
+                # (reference: co_grouped_rdd.rs:226-243). fetch() streams —
+                # buckets decode and fold into the group table as they come
+                # off the wire (bounded by the fetch queue), never as a
+                # materialized List[bytes] of the whole input.
                 for k, vs in ShuffleFetcher.fetch(sid, split.index):
                     slot(k)[i].extend(vs)
         return iter(groups.items())

@@ -8,6 +8,7 @@ partition count, :102-110).
 
 from __future__ import annotations
 
+import logging
 from typing import Iterator, List
 
 from vega_tpu import serialization
@@ -17,6 +18,8 @@ from vega_tpu.partitioner import Partitioner
 from vega_tpu.rdd.base import RDD
 from vega_tpu.shuffle.fetcher import ShuffleFetcher
 from vega_tpu.split import Split
+
+log = logging.getLogger("vega_tpu")
 
 
 class ShuffledRDD(RDD):
@@ -38,54 +41,75 @@ class ShuffledRDD(RDD):
         return [Split(i) for i in range(self.num_partitions)]
 
     def compute(self, split: Split, task_context=None) -> Iterator:
+        from vega_tpu import native
         from vega_tpu.dependency import NATIVE_GROUP_MAGIC, NATIVE_MAGIC
 
         merge_combiners = self.aggregator.merge_combiners
-        blobs = ShuffleFetcher.fetch_blobs(self.shuffle_id, split.index)
-        native_blobs = [b for b in blobs if b[:4] == NATIVE_MAGIC]
-        group_blobs = [b for b in blobs if b[:4] == NATIVE_GROUP_MAGIC]
+        # Streaming merge: each bucket is decoded/merged AS IT ARRIVES off
+        # the pipelined fetch, so the C++ hash-map merge (reference hot
+        # loop 2, shuffled_rdd.rs:154-164) overlaps the remaining network
+        # time and peak memory is bounded by the fetch queue, not the
+        # whole reduce input. A shuffle's buckets are all VN01
+        # (pre-combined), all VG01 (raw group rows), or pickled — the map
+        # side picks one encoding per shuffle — but heterogeneous streams
+        # (mixed pickle + native across executors) still merge correctly.
+        merger = None  # lazy: non-native shuffles never build one
         combiners: dict = {}
-
-        if group_blobs:
-            # Raw (k, v) rows from the native group path: collect into lists
-            # (C decode + one dict pass; reference: shuffled_rdd.rs:149-170
-            # with the Vec-collecting aggregator).
-            from vega_tpu import native
-
-            for b in group_blobs:
-                for k, val in native.decode(b[5:], b[4] == 1):
+        py_combined: dict = {}
+        for blob in ShuffleFetcher.fetch_stream(self.shuffle_id,
+                                                split.index):
+            magic = blob[:4]
+            if magic == NATIVE_MAGIC:
+                if merger is None:
+                    merger = native.StreamingMerge(self.aggregator.op_name)
+                # memoryview: the C++ feed takes any buffer (y*), so the
+                # payload is parsed in place — no per-bucket copy on the
+                # hot merge loop.
+                merger.feed(memoryview(blob)[5:], blob[4] == 1)
+            elif magic == NATIVE_GROUP_MAGIC:
+                # Raw (k, v) rows from the native group path: collect into
+                # lists (C decode + one dict pass; reference:
+                # shuffled_rdd.rs:149-170 with the Vec-collecting
+                # aggregator).
+                for k, val in native.decode(blob[5:], blob[4] == 1):
                     bucket = combiners.get(k)
                     if bucket is None:
                         combiners[k] = [val]
                     else:
                         bucket.append(val)
+            else:
+                for k, c in serialization.loads(blob):
+                    if k in py_combined:
+                        py_combined[k] = merge_combiners(py_combined[k], c)
+                    else:
+                        py_combined[k] = c
 
-        if native_blobs:
-            # Native merge (C++ hash-map; reference hot loop 2 equivalent,
-            # shuffled_rdd.rs:154-164); pure-Python merge when this process
-            # lacks the compiled module (heterogeneous cluster).
-            from vega_tpu import native
-
-            nat = native.get()
-            flagged = [(b[5:], 1 if b[4] == 1 else 0) for b in native_blobs]
-            merged = None
-            if nat is not None:
-                op = native.OP_BY_NAME[self.aggregator.op_name]
-                # None = an int64 combine overflowed; redo below with
-                # Python bignums (exact) instead of rounded doubles.
-                merged = nat.merge_encoded(flagged, op)
+        if merger is not None:
+            merged = merger.finish()
             if merged is None:
+                # An int64 combine overflowed in the native accumulator:
+                # redo the whole merge with exact Python bignums. The
+                # stream kept no raw buckets (that is the point), so the
+                # redo refetches them — the buckets still live in their
+                # map-side stores, and the fresh state discards every
+                # partially-merged value (no double-merge).
+                log.info("native streaming merge overflowed int64; "
+                         "refetching shuffle %d reduce %d for the exact "
+                         "Python merge", self.shuffle_id, split.index)
+                flagged = [
+                    (b[5:], 1 if b[4] == 1 else 0)
+                    for b in ShuffleFetcher.fetch_blobs(self.shuffle_id,
+                                                        split.index)
+                    if b[:4] == NATIVE_MAGIC
+                ]
                 merged = native.merge_encoded_py(
                     flagged, self.aggregator.op_name
                 )
             combiners = dict(merged)
 
-        for blob in blobs:
-            if blob[:4] in (NATIVE_MAGIC, NATIVE_GROUP_MAGIC):
-                continue
-            for k, c in serialization.loads(blob):
-                if k in combiners:
-                    combiners[k] = merge_combiners(combiners[k], c)
-                else:
-                    combiners[k] = c
+        for k, c in py_combined.items():
+            if k in combiners:
+                combiners[k] = merge_combiners(combiners[k], c)
+            else:
+                combiners[k] = c
         return iter(combiners.items())

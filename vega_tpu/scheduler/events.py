@@ -117,6 +117,26 @@ class BlockPromoted(Event):
     nbytes: int = 0
 
 
+@dataclasses.dataclass
+class ShuffleFetchCompleted(Event):
+    """One reduce task's fetch stream finished (shuffle/fetcher.py).
+    round_trips counts network request/response rounds — the batched
+    `get_many` protocol pays 1 per (reducer, server) where the per-bucket
+    protocol pays 1 per bucket. overlap_s is fetch time hidden behind the
+    consumer's concurrent decode/merge (net_s minus the consumer's queue
+    wait); local-tier reads count buckets/bytes with zero round trips."""
+
+    shuffle_id: int = -1
+    reduce_id: int = -1
+    buckets: int = 0
+    nbytes: int = 0
+    round_trips: int = 0
+    wall_s: float = 0.0
+    net_s: float = 0.0
+    overlap_s: float = 0.0
+    batched: bool = True
+
+
 class Listener:
     def on_event(self, event: Event) -> None:
         raise NotImplementedError
@@ -212,6 +232,15 @@ class MetricsListener(Listener):
         self.executors_lost = 0
         self.executors_restarted = 0
         self.stages_resubmitted = 0
+        # Shuffle-fetch pipeline counters (ShuffleFetchCompleted): bench.py
+        # and benchmarks/suite.py surface these as the `fetch` detail.
+        self.fetch_streams = 0
+        self.fetch_buckets = 0
+        self.fetch_bytes = 0
+        self.fetch_round_trips = 0
+        self.fetch_wall_s = 0.0
+        self.fetch_net_s = 0.0
+        self.fetch_overlap_s = 0.0
         self._lock = named_lock("scheduler.events.MetricsListener._lock")
 
     def on_event(self, event: Event) -> None:
@@ -244,6 +273,14 @@ class MetricsListener(Listener):
                 self.executors_restarted += 1
             elif isinstance(event, StageResubmitted):
                 self.stages_resubmitted += 1
+            elif isinstance(event, ShuffleFetchCompleted):
+                self.fetch_streams += 1
+                self.fetch_buckets += event.buckets
+                self.fetch_bytes += event.nbytes
+                self.fetch_round_trips += event.round_trips
+                self.fetch_wall_s += event.wall_s
+                self.fetch_net_s += event.net_s
+                self.fetch_overlap_s += event.overlap_s
             elif isinstance(event, BlockSpilled):
                 self.spill_count += 1
                 self.spilled_bytes[event.store] = (
@@ -268,4 +305,13 @@ class MetricsListener(Listener):
                 "promotes": self.promote_count,
                 "spilled_bytes": dict(self.spilled_bytes),
                 "promoted_bytes": dict(self.promoted_bytes),
+                "fetch": {
+                    "streams": self.fetch_streams,
+                    "buckets": self.fetch_buckets,
+                    "bytes": self.fetch_bytes,
+                    "round_trips": self.fetch_round_trips,
+                    "wall_s": round(self.fetch_wall_s, 6),
+                    "net_s": round(self.fetch_net_s, 6),
+                    "overlap_s": round(self.fetch_overlap_s, 6),
+                },
             }

@@ -9,121 +9,376 @@ URIs fetch over the executor's shuffle TCP server
 (distributed/shuffle_server.py). A failed remote fetch raises FetchFailedError
 so the scheduler can actually run its recovery path (unlike the reference,
 where the error path panics — see errors.FetchFailedError docstring).
+
+The fetch plane is PIPELINED (the Exoshuffle decomposition, PAPERS.md):
+`fetch_stream` is the core API — per-server fetch threads issue ONE batched
+`get_many` request each (M round trips collapse to 1) and push buckets into
+a size-bounded queue as they come off the wire, while the consumer decodes/
+merges concurrently. Reducer peak memory is bounded by
+Configuration.fetch_queue_buckets in-flight buckets, never the whole input.
+`fetch_blobs` / `fetch` / `fetch_into` are thin wrappers over the stream;
+`fetch_batch_enabled=0` keeps the per-bucket `get` protocol live (same
+pipeline, one round trip per bucket).
 """
 
 from __future__ import annotations
 
 import logging
-from concurrent.futures import ThreadPoolExecutor
+import queue
+import threading
+import time
 from typing import Callable, Iterator, List, Tuple
 
 from vega_tpu import serialization
 from vega_tpu.env import Env
 from vega_tpu.errors import FetchFailedError, ShuffleError, VegaError
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
 
+class _AbandonedStream(Exception):
+    """Internal: the consumer closed the stream; producers unwind."""
+
+
+# Queue sentinel: each producer enqueues one when it finishes (success or
+# failure), so the consumer's drain loop ends the instant the last
+# producer does — never by burning a poll timeout.
+_PRODUCER_DONE = object()
+
+
+# Process-lifetime fetch counters (benchmarks/fetch_ab.py and tests read
+# these; the per-stream edition also rides the driver event bus as
+# ShuffleFetchCompleted). peak_queued is the high-water bucket count of the
+# bounded queue — the proof the streaming path never materializes the full
+# List[bytes].
+_totals_lock = named_lock("shuffle.fetcher._totals_lock")
+_TOTALS = {
+    "streams": 0, "buckets": 0, "bytes": 0, "round_trips": 0,
+    "net_s": 0.0, "wait_s": 0.0, "overlap_s": 0.0, "wall_s": 0.0,
+    "peak_queued": 0, "duplicates": 0,
+}
+
+
+def stats_snapshot() -> dict:
+    with _totals_lock:
+        return dict(_TOTALS)
+
+
+def reset_stats() -> None:
+    with _totals_lock:
+        for k in _TOTALS:
+            _TOTALS[k] = 0 if isinstance(_TOTALS[k], int) else 0.0
+
+
+def _bank_totals(stats: dict) -> None:
+    with _totals_lock:
+        _TOTALS["streams"] += 1
+        for k in ("buckets", "bytes", "round_trips", "net_s", "wait_s",
+                  "overlap_s", "wall_s", "duplicates"):
+            _TOTALS[k] += stats[k]
+        if stats["peak_queued"] > _TOTALS["peak_queued"]:
+            _TOTALS["peak_queued"] = stats["peak_queued"]
+
+
 class ShuffleFetcher:
     @staticmethod
-    def fetch_blobs(shuffle_id: int, reduce_id: int) -> List[bytes]:
-        """Fetch the raw serialized buckets for `reduce_id` (native-framed or
-        pickled); callers that can merge natively avoid the decode.
+    def fetch_stream(shuffle_id: int, reduce_id: int) -> Iterator[bytes]:
+        """Yield the raw serialized buckets for `reduce_id` as they arrive,
+        bounded-memory: at most Configuration.fetch_queue_buckets buckets
+        sit decoded-but-unconsumed at any moment, so merge cost overlaps
+        network time instead of following it.
 
-        If a fetch fails, the locations may simply be stale (the liveness
-        reaper unregistered a lost executor's outputs and a survivor — or a
-        respawn — re-registered them elsewhere): re-resolve them once and
-        refetch before escalating, so reducers follow moved outputs instead
-        of failing the whole task on old addresses. The failure path pays
-        one redundant resolve+refetch; the fault-free hot path pays
-        nothing (no extra tracker round-trips)."""
+        Recovery contract (reproven for a drop MID-STREAM): a dropped
+        connection is first retried in place against the same server,
+        re-requesting only the undelivered tail (fetch_many_remote /
+        fetch_remote); if that escalates to FetchFailedError, the
+        locations may simply be stale (the liveness reaper unregistered a
+        lost executor's outputs and a survivor — or a respawn —
+        re-registered them elsewhere): re-resolve them ONCE and refetch
+        the undelivered buckets only — buckets already yielded are never
+        refetched or re-merged (exactly-once per bucket). If the
+        re-resolve itself times out, the ORIGINAL FetchFailedError
+        propagates so the scheduler's stage-resubmit recovery still
+        fires."""
         env = Env.get()
         tracker = env.map_output_tracker
         if tracker is None:
             raise ShuffleError("no map output tracker configured")
         try:
-            try:
-                uris = tracker.get_server_uris(shuffle_id)
-            except VegaError as e:
-                # Timed out waiting for locations: outputs were invalidated
-                # (executor loss) and nothing has recomputed them yet. Must
-                # surface as FetchFailed — the typed error is what makes
-                # the scheduler resubmit the producing stage; a generic
-                # error would just retry this reduce task against the same
-                # empty registry until max_failures aborts the job.
-                raise FetchFailedError(
-                    None, shuffle_id, None, reduce_id,
-                    f"map output locations unavailable: {e}",
-                ) from e
-            return ShuffleFetcher._fetch_blobs_once(
-                env, uris, shuffle_id, reduce_id
-            )
-        except FetchFailedError as first_failure:
-            log.info("fetch of shuffle %d failed (%s); re-resolving "
-                     "locations once", shuffle_id, first_failure)
-            try:
-                # Short deadline: the wait returns early the moment new
-                # locations register (or immediately when nothing was
-                # unregistered); the full 5s is only burned when recovery
-                # needs this very task's failure to start.
-                return ShuffleFetcher._fetch_blobs_once(
-                    env, tracker.get_server_uris(shuffle_id, timeout=5.0),
-                    shuffle_id, reduce_id,
-                )
-            except FetchFailedError:
-                raise  # fresher and no less actionable than the first
-            except VegaError:
-                # Re-resolve timed out (the lost outputs have no new homes
-                # yet — only the scheduler's resubmit path creates them).
-                # The ORIGINAL FetchFailedError must reach the scheduler:
-                # a generic error here would retry the reduce task forever
-                # without ever recomputing the missing map outputs.
-                raise first_failure
+            uris = tracker.get_server_uris(shuffle_id)
+        except VegaError as e:
+            # Timed out waiting for locations: outputs were invalidated
+            # (executor loss) and nothing has recomputed them yet. Must
+            # surface as FetchFailed — the typed error is what makes
+            # the scheduler resubmit the producing stage; a generic
+            # error would just retry this reduce task against the same
+            # empty registry until max_failures aborts the job.
+            raise FetchFailedError(
+                None, shuffle_id, None, reduce_id,
+                f"map output locations unavailable: {e}",
+            ) from e
+        return ShuffleFetcher._stream(env, tracker, list(uris),
+                                      shuffle_id, reduce_id)
 
     @staticmethod
-    def _fetch_blobs_once(env, server_uris: List[str], shuffle_id: int,
-                          reduce_id: int) -> List[bytes]:
-        # Group map ids by server so each server is hit by one worker
-        # (reference: shuffle_fetcher.rs:33-53).
-        by_server: dict = {}
-        for map_id, uri in enumerate(server_uris):
-            if uri is None:
-                raise FetchFailedError(None, shuffle_id, map_id, reduce_id,
-                                       "missing map output location")
-            by_server.setdefault(uri, []).append(map_id)
-
+    def _stream(env, tracker, uris: List[str], shuffle_id: int,
+                reduce_id: int) -> Iterator[bytes]:
+        conf = env.conf
+        batched = bool(getattr(conf, "fetch_batch_enabled", True))
+        maxq = max(1, int(getattr(conf, "fetch_queue_buckets", 32)))
+        stats = {"buckets": 0, "bytes": 0, "round_trips": 0, "net_s": 0.0,
+                 "wait_s": 0.0, "peak_queued": 0, "duplicates": 0,
+                 "batched": batched}
+        t_start = time.monotonic()
+        delivered = set()
+        total = len(uris)
+        abandoned = {"flag": False}
+        counter_lock = named_lock("shuffle.fetcher.stream_counters")
+        resolved_once = False
         local_store = env.shuffle_store
 
-        def fetch_from(uri: str) -> List[bytes]:
-            blobs = []
-            for map_id in by_server[uri]:
-                if uri == "local" or (env.shuffle_server is not None
-                                      and uri == env.shuffle_server.uri):
+        try:
+            while True:
+                # -- split undelivered buckets into local vs per-server
+                local_ids: List[int] = []
+                by_server: dict = {}
+                for map_id, uri in enumerate(uris):
+                    if map_id in delivered:
+                        continue
+                    if uri is None:
+                        raise FetchFailedError(
+                            None, shuffle_id, map_id, reduce_id,
+                            "missing map output location")
+                    if uri == "local" or (
+                            env.shuffle_server is not None
+                            and uri == env.shuffle_server.uri):
+                        local_ids.append(map_id)
+                    else:
+                        by_server.setdefault(uri, []).append(map_id)
+
+                failures: List[FetchFailedError] = []
+                threads: List[threading.Thread] = []
+                q: "queue.Queue" = queue.Queue(maxsize=maxq)
+                queued = {"n": 0}  # resident data buckets (excl. sentinels)
+
+                def _bounded_put(item, q=q):
+                    # Block while the consumer is busy merging
+                    # (backpressure IS the memory bound), bail out if it
+                    # abandoned the stream — checked up front too, so an
+                    # orphaned stream stops costing network/disk at the
+                    # next bucket, not only once the queue fills.
+                    while True:
+                        if abandoned["flag"]:
+                            raise _AbandonedStream()
+                        try:
+                            q.put(item, timeout=0.2)
+                            return
+                        except queue.Full:
+                            pass
+
+                def produce(assignments, failures=failures):
+                    # One worker thread serving one or more servers
+                    # sequentially (fan-out is capped; see below).
+                    from vega_tpu.distributed.shuffle_server import (
+                        fetch_many_remote, fetch_remote)
+
+                    t0 = time.monotonic()
+
+                    def deliver(map_id, data):
+                        # Count resident DATA buckets ourselves —
+                        # q.qsize() would also count producer-done
+                        # sentinels and overstate the high-water mark.
+                        # Incremented before the (possibly blocking) put:
+                        # a bucket waiting in the producer's hand is
+                        # resident too.
+                        with counter_lock:
+                            queued["n"] += 1
+                            if queued["n"] > stats["peak_queued"]:
+                                stats["peak_queued"] = queued["n"]
+                        try:
+                            _bounded_put((map_id, data))
+                        except _AbandonedStream:
+                            with counter_lock:
+                                queued["n"] -= 1
+                            raise
+
+                    try:
+                        for uri, ids in assignments:
+                            try:
+                                if batched:
+                                    rts = fetch_many_remote(
+                                        uri, shuffle_id, ids, reduce_id,
+                                        deliver)
+                                else:
+                                    rts = 0
+                                    for m in ids:
+                                        data = fetch_remote(
+                                            uri, shuffle_id, m, reduce_id)
+                                        rts += 1
+                                        deliver(m, data)
+                                with counter_lock:
+                                    stats["round_trips"] += rts
+                            except FetchFailedError as e:
+                                with counter_lock:
+                                    failures.append(e)
+                            except _AbandonedStream:
+                                raise  # not a server failure: unwind
+                            except Exception:  # noqa: BLE001 — must not strand the consumer
+                                log.exception("unexpected shuffle-fetch "
+                                              "failure from %s", uri)
+                                with counter_lock:
+                                    failures.append(FetchFailedError(
+                                        uri, shuffle_id, ids[0], reduce_id,
+                                        "unexpected fetch error (see log)"))
+                    except _AbandonedStream:
+                        return  # consumer gone: no one reads the sentinel
+                    finally:
+                        with counter_lock:
+                            stats["net_s"] += time.monotonic() - t0
+                        try:
+                            _bounded_put(_PRODUCER_DONE)
+                        except _AbandonedStream:
+                            pass
+
+                # Cap the fan-out like the old per-server pool did
+                # (max_workers=16): past 16 servers, each worker thread
+                # walks several servers sequentially — still one get_many
+                # round trip per server, still overlapped with the merge.
+                n_workers = min(len(by_server), 16)
+                lanes = [[] for _ in range(n_workers)]
+                for i, item in enumerate(by_server.items()):
+                    lanes[i % n_workers].append(item)
+                for lane in lanes:
+                    t = threading.Thread(target=produce, args=(lane,),
+                                         name="shuffle-fetch", daemon=True)
+                    threads.append(t)
+                    t.start()
+
+                # -- local tier: read lazily, one bucket resident at a
+                # time, while the fetch threads fill the queue behind us.
+                for map_id in local_ids:
                     data = local_store.get(shuffle_id, map_id, reduce_id)
                     if data is None:
-                        raise FetchFailedError(uri, shuffle_id, map_id, reduce_id,
-                                               "bucket missing from local store")
-                else:
-                    from vega_tpu.distributed.shuffle_server import fetch_remote
+                        with counter_lock:
+                            failures.append(FetchFailedError(
+                                uris[map_id], shuffle_id, map_id,
+                                reduce_id,
+                                "bucket missing from local store"))
+                        continue
+                    delivered.add(map_id)
+                    stats["buckets"] += 1
+                    stats["bytes"] += len(data)
+                    yield data
 
-                    data = fetch_remote(uri, shuffle_id, map_id, reduce_id)
-                blobs.append(data)
-            return blobs
+                # -- drain the remote queue until every producer's DONE
+                # sentinel has come through (ends the instant the last
+                # producer finishes; the timeout is pure crash-safety)
+                ended = 0
+                while ended < len(threads):
+                    t_w = time.monotonic()
+                    try:
+                        item = q.get(timeout=0.2)
+                    except queue.Empty:
+                        # Idle time is idle time whether or not a bucket
+                        # eventually arrived — dropping Empty polls would
+                        # overstate overlap_s (= net_s - wait_s).
+                        stats["wait_s"] += time.monotonic() - t_w
+                        continue
+                    stats["wait_s"] += time.monotonic() - t_w
+                    if item is _PRODUCER_DONE:
+                        ended += 1
+                        continue
+                    map_id, data = item
+                    with counter_lock:
+                        queued["n"] -= 1
+                    if map_id in delivered:
+                        # Exactly-once: a retried tail must never re-yield
+                        # a bucket the consumer already merged.
+                        stats["duplicates"] += 1
+                        log.warning("duplicate shuffle bucket suppressed: "
+                                    "shuffle=%d map=%d reduce=%d",
+                                    shuffle_id, map_id, reduce_id)
+                        continue
+                    delivered.add(map_id)
+                    stats["buckets"] += 1
+                    stats["bytes"] += len(data)
+                    yield data
+                for t in threads:
+                    t.join(timeout=5.0)
 
-        uris = list(by_server)
-        if len(uris) == 1:
-            blob_lists = [fetch_from(uris[0])]
-        else:
-            with ThreadPoolExecutor(max_workers=min(len(uris), 16)) as pool:
-                blob_lists = list(pool.map(fetch_from, uris))
-        return [blob for blobs in blob_lists for blob in blobs]
+                if not failures:
+                    break
+                failure = failures[0]
+                if resolved_once:
+                    raise failure  # fresher and no less actionable
+                resolved_once = True
+                log.info("fetch of shuffle %d failed mid-stream (%s); "
+                         "re-resolving locations once for the %d "
+                         "undelivered buckets", shuffle_id, failure,
+                         total - len(delivered))
+                try:
+                    # Short deadline: the wait returns early the moment
+                    # new locations register (or immediately when nothing
+                    # was unregistered); the full 5s is only burned when
+                    # recovery needs this very task's failure to start.
+                    uris = list(tracker.get_server_uris(shuffle_id,
+                                                        timeout=5.0))
+                except VegaError:
+                    # Re-resolve timed out (the lost outputs have no new
+                    # homes yet — only the scheduler's resubmit path
+                    # creates them). The ORIGINAL FetchFailedError must
+                    # reach the scheduler: a generic error here would
+                    # retry the reduce task forever without ever
+                    # recomputing the missing map outputs.
+                    raise failure from None
+
+            if len(delivered) != total:
+                raise ShuffleError(
+                    f"shuffle {shuffle_id} reduce {reduce_id}: "
+                    f"{total - len(delivered)} buckets never delivered")
+        finally:
+            abandoned["flag"] = True
+
+        wall = time.monotonic() - t_start
+        stats["wall_s"] = wall
+        # Seconds of network/producer time hidden behind consumer work:
+        # producers were busy net_s seconds total while the consumer only
+        # idled wait_s of them. net_s sums across concurrent producer
+        # THREADS, so clamp to wall time — overlap beyond the stream's
+        # own duration would overstate the win A/B decisions key on.
+        stats["overlap_s"] = min(max(0.0, stats["net_s"] - stats["wait_s"]),
+                                 wall)
+        _bank_totals(stats)
+        sink = getattr(env, "fetch_event_sink", None)
+        if sink is not None:
+            try:
+                from vega_tpu.scheduler.events import ShuffleFetchCompleted
+
+                sink(ShuffleFetchCompleted(
+                    shuffle_id=shuffle_id, reduce_id=reduce_id,
+                    buckets=stats["buckets"], nbytes=stats["bytes"],
+                    round_trips=stats["round_trips"],
+                    wall_s=wall, net_s=stats["net_s"],
+                    overlap_s=stats["overlap_s"], batched=batched,
+                ))
+            except Exception:  # noqa: BLE001 — observability must not break IO
+                log.debug("fetch event emit failed", exc_info=True)
+
+    @staticmethod
+    def fetch_blobs(shuffle_id: int, reduce_id: int) -> List[bytes]:
+        """Materialize every bucket for `reduce_id` (thin wrapper over
+        fetch_stream — same batching and recovery contract; use the stream
+        directly when the merge can run incrementally)."""
+        return list(ShuffleFetcher.fetch_stream(shuffle_id, reduce_id))
 
     @staticmethod
     def fetch(shuffle_id: int, reduce_id: int) -> Iterator[Tuple]:
-        """Yield all (K, C) pairs destined for `reduce_id`."""
+        """Yield all (K, C) pairs destined for `reduce_id`, decoding each
+        bucket as it arrives off the stream (decode overlaps network)."""
         from vega_tpu.dependency import NATIVE_GROUP_MAGIC, NATIVE_MAGIC
 
-        for blob in ShuffleFetcher.fetch_blobs(shuffle_id, reduce_id):
+        for blob in ShuffleFetcher.fetch_stream(shuffle_id, reduce_id):
             magic = blob[:4]
             if magic in (NATIVE_MAGIC, NATIVE_GROUP_MAGIC):
                 from vega_tpu import native

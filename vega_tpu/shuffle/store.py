@@ -99,6 +99,15 @@ class ShuffleStore:
             return self._disk.get(_disk_key(*key))
         return None
 
+    def iter_buckets(self, shuffle_id: int, map_ids, reduce_id: int):
+        """Yield (map_id, data-or-None) lazily, one bucket at a time — the
+        `get_many` serve path. Each bucket is read (RAM tier, else a
+        checksummed disk read) only when the previous one has already been
+        framed onto the wire, so serving a large batch never stages more
+        than one bucket beyond what the socket buffers hold."""
+        for map_id in map_ids:
+            yield map_id, self.get(shuffle_id, map_id, reduce_id)
+
     def contains(self, shuffle_id: int, map_id: int, reduce_id: int) -> bool:
         key = (shuffle_id, map_id, reduce_id)
         with self._lock:

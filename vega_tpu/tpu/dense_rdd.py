@@ -180,6 +180,17 @@ def _lifetime_multiproc() -> bool:
     return _lifetime_multiproc_memo
 
 
+def _reset_lifetime_multiproc_memo() -> None:
+    """mesh.init_multihost calls this next to set_default_mesh(None): a
+    process that materialized dense blocks under a single-process Context
+    and then joined a jax.distributed mesh (stop() + new multihost Context
+    is supported) must re-resolve the eviction policy — keeping the stale
+    False memo would run the LRU/weakref policy on a multi-process mesh,
+    the exact cross-process divergence the FIFO hardening prevents."""
+    global _lifetime_multiproc_memo
+    _lifetime_multiproc_memo = None
+
+
 def _lifetime_lru(ctx) -> dict:
     return ctx.__dict__.setdefault("_dense_block_lru", {})
 
@@ -3517,6 +3528,16 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 lambda: _shard_program(self.mesh, table_prog,
                                        1 + len(in_names), (_SPEC,) * 4),
             )
+            # The gate above checked _dense_no_defer, but a CONCURRENT
+            # thread's settlement repair may have set it since: re-check
+            # immediately before launch and fall through to the standard
+            # plan if so. Without this, _run_exchange would take its
+            # blocking retry loop, whose grown capacities this build
+            # lambda ignores — six identical fixed-caps launches ending in
+            # a spurious VegaError instead of a plan fallback.
+            if self.context.__dict__.get("_dense_no_defer"):
+                table_range = None
+        if table_range is not None:
             # The gate guarantees _dense_no_defer is off, so this is
             # exactly _run_exchange's deferred fixed-caps launch — bus
             # events, the pending entry, and settlement/repair all ride
@@ -3565,7 +3586,8 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     # escape so the plan can't OOM where fused_sort won't
                     low_mem = capacity * (n + 1) * 4 > (256 << 20)
                     cols, bucket = kernels.partition_by_bucket(
-                        cols, bucket, n, prefer_low_memory=low_mem)
+                        cols, bucket, n, prefer_low_memory=low_mem,
+                        sort_impl=sort_impl)
                     cols, count, overflow = exchange(
                         cols, count, bucket, n, slot, out_cap,
                         pregrouped=True,
@@ -3598,6 +3620,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                     bucket = jnp.zeros_like(cols[KEY])
                     cols, count, overflow = exchange(
                         cols, count, bucket, n, slot, out_cap,
+                        sort_impl=sort_impl,
                     )
                 else:
                     capacity = cols[KEY].shape[0]
@@ -3747,7 +3770,8 @@ class _GroupByKeyRDD(_ExchangeRDD):
                     bucket = (_bucket_cols(cols, n)
                               if n > 1 else jnp.zeros_like(cols[KEY]))
                     cols, count, overflow = exchange(
-                        cols, count, bucket, n, slot, out_cap
+                        cols, count, bucket, n, slot, out_cap,
+                        sort_impl=sort_impl,
                     )
                 if not elide_sorted:  # already sorted rows skip the sort
                     cols = kernels.sort_by_column(cols, count, KEY,
@@ -3901,7 +3925,8 @@ class _JoinRDD(_ExchangeRDD):
                 )
             bucket = (_bucket_cols(cols, n)
                       if n > 1 else jnp.zeros_like(cols[KEY]))
-            return exchange(cols, count, bucket, n, slot_pair, out_cap)
+            return exchange(cols, count, bucket, n, slot_pair, out_cap,
+                            sort_impl=sort_impl)
 
         def build(slot_pair, out_cap):
             join_cap = join_cap_override[0] or out_cap
@@ -4175,7 +4200,8 @@ class _SortByKeyRDD(_ExchangeRDD):
                         keys_lo=cols.get(lo_name) if composite else None,
                     )
                 cols, count, overflow = exchange(
-                    cols, count, bucket, n, slot, out_cap
+                    cols, count, bucket, n, slot, out_cap,
+                    sort_impl=sort_impl,
                 )
                 cols = kernels.sort_by_column(
                     cols, count, KEY, descending=not ascending,

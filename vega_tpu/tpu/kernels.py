@@ -134,7 +134,8 @@ def passthrough_exchange(cols: Cols, count: jax.Array, capacity: int,
 
 
 def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
-                     prefer_low_memory: bool = False):
+                     prefer_low_memory: bool = False,
+                     sort_impl: str = None):
     """Stable-group rows by target bucket; returns (grouped cols,
     per-bucket counts, per-bucket start offsets).
 
@@ -143,7 +144,13 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
     beats the O(n log n) argsort. The one-hot/cumsum intermediates are
     O(capacity * n_shards), so callers with a memory bound to honor
     (ring_exchange) set prefer_low_memory and larger meshes always take the
-    argsort path."""
+    argsort path.
+
+    sort_impl is the caller's RESOLVED dense_sort_impl — cached-program
+    builders must thread the exact value that sits in their program-cache
+    key (exchange/partition_by_bucket forward it), so an in-process config
+    flip re-traces instead of silently A/B-ing a stale cached program.
+    None (direct/uncached callers only) resolves from the live config."""
     from vega_tpu.tpu import pallas_kernels as _pk
 
     counts_all = _pk.bucket_hist(bucket, n_shards + 1)
@@ -177,7 +184,8 @@ def _group_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
     # the argsort so a pinned 'xla' (the unmeasured-on-chip-packed TPU
     # default) never executes packed code. Every row participates;
     # padding rows carry bucket == n_shards and sort last by value.
-    if resolve_sort_impl() == "packed":
+    if (sort_impl if sort_impl is not None
+            else resolve_sort_impl()) == "packed":
         order = packed_sort_perm(orderable_words([bucket]),
                                  jnp.int32(bucket.shape[0]))
     else:
@@ -436,7 +444,8 @@ def packed_sort_perm(words, count: jax.Array,
 
 
 def partition_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
-                        prefer_low_memory: bool = False
+                        prefer_low_memory: bool = False,
+                        sort_impl: str = None
                         ) -> Tuple[Cols, jax.Array]:
     """Stable counting partition: rows become contiguous per bucket (the
     ghost bucket n_shards sinks last), preserving in-bucket row order —
@@ -452,7 +461,7 @@ def partition_by_bucket(cols: Cols, bucket: jax.Array, n_shards: int,
     instead). Returns (grouped cols, grouped bucket)."""
     grouped, _cto, _starts = _group_by_bucket(
         dict(cols, __bucket=bucket), bucket, n_shards,
-        prefer_low_memory=prefer_low_memory)
+        prefer_low_memory=prefer_low_memory, sort_impl=sort_impl)
     b = grouped.pop("__bucket")
     return grouped, b
 
@@ -500,6 +509,7 @@ def bucket_exchange(
     slot_capacity: int,  # C: max rows this shard sends to any one target
     out_capacity: int,  # per-shard capacity of the received block
     pregrouped: bool = False,  # rows already bucket-grouped (bucket_key_sort)
+    sort_impl: str = None,  # caller's resolved dense_sort_impl (cache-keyed)
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """All-to-all by bucket id. Returns (cols, new_count, overflow_flag).
 
@@ -521,8 +531,8 @@ def bucket_exchange(
         counts_to, starts = pregrouped_group(bucket, n_shards)
         sorted_cols = cols
     else:
-        sorted_cols, counts_to, starts = _group_by_bucket(cols, bucket,
-                                                          n_shards)
+        sorted_cols, counts_to, starts = _group_by_bucket(
+            cols, bucket, n_shards, sort_impl=sort_impl)
     overflow_send = jnp.any(counts_to > slot_capacity)
 
     # Build [n_shards, slot_capacity] send buffers per column.

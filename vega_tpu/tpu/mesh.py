@@ -63,6 +63,12 @@ def init_multihost(coordinator: Optional[str] = None,
         kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout_s
     jax.distributed.initialize(**kwargs)
     set_default_mesh(None)  # rebuild over the now-global device set
+    # The eviction-policy memo (LRU/weakref vs multi-process FIFO) was
+    # possibly resolved under the pre-distributed single-process device
+    # set; it must re-resolve over the now-global one.
+    from vega_tpu.tpu import dense_rdd
+
+    dense_rdd._reset_lifetime_multiproc_memo()
     global _multihost_settings, _multihost_heartbeat_s
     _multihost_settings = (coordinator, num_processes, process_id)
     # Record the EFFECTIVE timeout (jax's own default when none was

@@ -42,10 +42,13 @@ def ring_exchange(
     slot_capacity: int,
     out_capacity: int,
     pregrouped: bool = False,
+    sort_impl: str = None,
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """Drop-in replacement for kernels.bucket_exchange (same contract:
     returns (cols, new_count, overflow_flag); pregrouped means rows are
-    already contiguous per bucket, so grouping collapses to a bincount)."""
+    already contiguous per bucket, so grouping collapses to a bincount;
+    sort_impl is the caller's resolved dense_sort_impl, threaded so the
+    grouping escape hatch matches the caller's program-cache key)."""
     capacity = bucket.shape[0]
     if n_shards == 1:
         return kernels.passthrough_exchange(cols, count, capacity,
@@ -61,7 +64,8 @@ def ring_exchange(
         # intermediates would defeat exactly the peak-memory bound this
         # exchange exists to provide.
         sorted_cols, counts_to, starts = kernels._group_by_bucket(
-            cols, bucket, n_shards, prefer_low_memory=True
+            cols, bucket, n_shards, prefer_low_memory=True,
+            sort_impl=sort_impl,
         )
     overflow = jnp.any(counts_to > slot_capacity)
 
