@@ -558,6 +558,12 @@ def main():
         # (zero round trips); on a multi-executor run the round-trip count
         # is the batching win (1 per (reducer, server) vs 1 per bucket).
         detail["fetch"] = ctx.metrics_summary().get("fetch", {})
+        # Task-dispatch-plane counters (stage binaries shipped vs cache
+        # hits, header/result bytes, need_binary recoveries): zeros on a
+        # local in-process run; on a distributed run the binaries_shipped
+        # vs tasks_v2 gap is the dedup win (benchmarks/dispatch_ab.py
+        # measures it A/B over real sockets).
+        detail["dispatch"] = ctx.metrics_summary().get("dispatch", {})
         _leg_history_compare_and_append(detail)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "

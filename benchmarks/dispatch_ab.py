@@ -1,0 +1,132 @@
+"""A/B: legacy per-task envelopes vs deduplicated stage-binary dispatch.
+
+The reference ships the WHOLE serialized task — lineage, closure and all —
+per task (one capnp envelope each, serialized_data.capnp), so an N-task
+stage pays N lineage pickles on the GIL-bound driver and N deserializations
+per executor: the per-task overhead tax Exoshuffle (PAPERS.md) identifies
+as the limiter for fine-grained distributed dataflow. The deduplicated
+plane (task_v2) serializes the stage binary once, ships it per executor on
+first use, and sends a tiny header per task; results return as
+out-of-band buffer frames.
+
+This benchmark runs BOTH legs against a real spawned worker process over
+real sockets — same job, same fleet, only the driver-side knob differs
+(the worker speaks both protocols unconditionally). The lineage is padded
+with a ~256 KiB closure constant so it is non-trivially sized, the way
+real lineages with broadcast-free lookup tables are.
+
+Prints ONE JSON line (medians of 3, legs interleaved per repetition so
+host-level drift on this shared 1-core sandbox hits both equally).
+Usage:
+
+  python benchmarks/dispatch_ab.py [n_tasks] [closure_kib]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Importing vega_tpu must never probe a (possibly wedged) TPU backend:
+# force the CPU mesh first, like every benchmark here.
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)
+
+REPS = 3
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    closure_kib = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+
+    import vega_tpu as v
+
+    # One worker process: every dispatch crosses a real socket, and the
+    # dedup leg's once-per-executor binary ship is maximally visible.
+    ctx = v.Context("distributed", num_workers=1)
+    dedup_before = ctx.conf.task_binary_dedup
+    try:
+        # Non-trivial lineage: the map closure drags a deterministic
+        # ~closure_kib ballast (a lookup table baked into the lambda, the
+        # pattern that bloats real lineages).
+        ballast = bytes(range(256)) * (4 * closure_kib)
+        rdd = (ctx.parallelize(list(range(n_tasks * 8)), n_tasks)
+               .map(lambda x, _t=ballast: x + (_t[x % len(_t)] % 3))
+               .filter(lambda x: x >= 0))
+        expected = None
+
+        def dispatch_delta():
+            return dict(ctx.metrics_summary().get("dispatch", {}))
+
+        def one_rep(dedup: bool):
+            nonlocal expected
+            ctx.conf.task_binary_dedup = dedup
+            before = dispatch_delta()
+            t0 = time.time()
+            total = sum(rdd.collect())
+            wall = time.time() - t0
+            after = dispatch_delta()
+            if expected is None:
+                expected = total
+            assert total == expected, "A/B legs disagree on results"
+            delta = {k: after[k] - before.get(k, 0) for k in after}
+            return wall, delta
+
+        # Warm both paths once (worker import caches, socket pool, code
+        # paths) before timing.
+        for dedup in (False, True):
+            one_rep(dedup)
+
+        legacy_walls, dedup_walls = [], []
+        legacy_delta = dedup_delta = None
+        for _ in range(REPS):
+            w, legacy_delta = one_rep(dedup=False)
+            legacy_walls.append(w)
+            w, dedup_delta = one_rep(dedup=True)
+            dedup_walls.append(w)
+    finally:
+        ctx.conf.task_binary_dedup = dedup_before
+        ctx.stop()
+
+    legacy_bytes = legacy_delta["driver_serialized_bytes"]
+    dedup_bytes = dedup_delta["driver_serialized_bytes"]
+    legacy_s, dedup_s = median(legacy_walls), median(dedup_walls)
+    print(json.dumps({
+        "metric": "task dispatch wall + driver-serialized bytes per stage, "
+                  "legacy per-task envelopes vs deduplicated stage-binary "
+                  "dispatch (one worker process, real sockets; medians "
+                  "of 3)",
+        "tasks_per_stage": n_tasks,
+        "closure_bytes": 1024 * closure_kib,
+        "legacy_s": round(legacy_s, 6),
+        "dedup_s": round(dedup_s, 6),
+        "speedup": round(legacy_s / dedup_s, 2) if dedup_s else None,
+        "legacy_driver_bytes": legacy_bytes,
+        "dedup_driver_bytes": dedup_bytes,
+        "driver_bytes_reduction": (
+            round(legacy_bytes / dedup_bytes, 2) if dedup_bytes else None),
+        "dedup_dispatch": {
+            "binaries_shipped": dedup_delta["binaries_shipped"],
+            "binary_bytes": dedup_delta["binary_bytes"],
+            "binary_cache_hits": dedup_delta["binary_cache_hits"],
+            "need_binary": dedup_delta["need_binary"],
+            "header_bytes": dedup_delta["header_bytes"],
+            "result_bytes": dedup_delta["result_bytes"],
+        },
+        "legacy_dispatch": {
+            "task_bytes": legacy_delta["legacy_task_bytes"],
+            "result_bytes": legacy_delta["result_bytes"],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

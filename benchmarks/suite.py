@@ -303,6 +303,7 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
             }))
 
         fetch_before = ctx.metrics_summary().get("fetch", {})
+        dispatch_before = ctx.metrics_summary().get("dispatch", {})
         rows, host_s, dev_s = fn(ctx, scale, bank)
         rec = {
             "config": c,
@@ -317,6 +318,10 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
             # leg instead of one cumulative blob at the end.
             "fetch": _fetch_delta(fetch_before,
                                   ctx.metrics_summary().get("fetch", {})),
+            # Task-dispatch delta (same shape-preserving diff): binaries
+            # shipped vs cache hits and driver-serialized bytes per leg.
+            "dispatch": _fetch_delta(
+                dispatch_before, ctx.metrics_summary().get("dispatch", {})),
         }
         emit(json.dumps(rec))
         results.append(rec)

@@ -294,6 +294,123 @@ def _expected_reduce():
     return sorted(exp.items())
 
 
+def test_respawned_executor_triggers_need_binary_reship(monkeypatch):
+    """Tentpole acceptance: a respawned executor comes back with an EMPTY
+    binary cache while the driver's known-hash set for that executor id is
+    STALE (it remembers shipping the stage binary to the dead
+    incarnation). The resubmitted map stage reuses its cached binary, the
+    driver sends `binary_cached`, the fresh worker answers `need_binary`,
+    the binary re-ships inline mid-stage — and results are bit-identical.
+    Correctness never depends on driver bookkeeping."""
+    ctx = _chaos_context()
+    try:
+        pairs = ctx.parallelize([(i % 5, i) for i in range(200)], 8)
+        shuffled = pairs.reduce_by_key(lambda a, b: a + b, 4)
+        expected = sorted(shuffled.collect())
+        assert expected == _expected_reduce()
+
+        backend = ctx._backend
+        victim = backend._executors["exec-0"]
+        # The driver shipped this map stage's binary to exec-0 during the
+        # first job; that known-hash entry (keyed by executor ID) is about
+        # to go stale.
+        assert backend._known_hashes.get("exec-0")
+        victim.process.kill()
+        victim.process.wait()
+        assert _wait_metric(ctx, "executors_restarted", 1), \
+            "killed worker slot was never respawned"
+
+        # exec-0's map outputs are gone: the cached map stage resubmits
+        # with its cached StageBinary; the respawned exec-0 (same id,
+        # empty cache) gets `binary_cached` for a hash it never saw.
+        before = ctx.metrics_summary()["dispatch"]["need_binary"]
+        assert sorted(shuffled.collect()) == expected
+        after = ctx.metrics_summary()["dispatch"]["need_binary"]
+        assert after - before >= 1, \
+            "respawned executor never answered need_binary"
+    finally:
+        ctx.stop()
+
+
+def test_drop_binary_fault_recovers_in_place(monkeypatch, tmp_path):
+    """Chaos drop-the-binary hook (faults.py): a worker that evicts a
+    cached stage binary the driver believes it holds answers `need_binary`
+    and gets it re-shipped inline on the SAME connection — results
+    identical, no stage resubmission, no executor loss."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_DROP_BINARY_N", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context()
+    try:
+        assert _reduce_job(ctx) == _expected_reduce()
+        drops = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "drop_binary"]
+        assert drops, "no cached binary was ever dropped"
+        summary = ctx.metrics_summary()
+        assert summary["dispatch"]["need_binary"] >= 1
+        assert summary["stages_resubmitted"] == 0, \
+            "a dropped binary must recover in place, not resubmit"
+        assert summary["executors_lost"] == 0
+    finally:
+        ctx.stop()
+
+
+def test_worker_cache_eviction_falls_back_to_need_binary():
+    """Satellite: drive the task_v2 wire protocol directly against a live
+    worker whose binary LRU holds ONE entry. Shipping a second stage's
+    binary evicts the first; a later `binary_cached` dispatch for the
+    evicted hash must answer `need_binary`, accept the inline re-ship, and
+    return a result identical to the pre-eviction run."""
+    from vega_tpu import serialization
+    from vega_tpu.distributed import protocol
+    from vega_tpu.scheduler.task import StageBinary, TaskHeader
+
+    ctx = _chaos_context(task_binary_cache_entries=1)
+    try:
+        rdd = ctx.parallelize(list(range(10)), 1)
+        split = rdd.cached_splits()[0]
+        b_sum = StageBinary("result", rdd, lambda tc, it: sum(it))
+        b_max = StageBinary("result", rdd, lambda tc, it: max(it))
+
+        executor = next(iter(ctx._backend._executors.values()))
+        host, port = protocol.parse_uri(executor.task_uri)
+
+        def dispatch(binary, inline):
+            with protocol.connect(host, port) as sock:
+                protocol.send_msg(sock, "task_v2", binary.sha)
+                protocol.send_bytes(sock, serialization.dumps(TaskHeader(
+                    task_id=0, stage_id=0, partition=0, split=split,
+                    attempt=0, binary_sha=binary.sha, kind="result")))
+                if inline:
+                    protocol.send_msg(sock, "binary", binary.sha)
+                    protocol.send_bytes(sock, binary.payload)
+                else:
+                    protocol.send_msg(sock, "binary_cached", binary.sha)
+                reply, meta = protocol.recv_msg(sock)
+                asked = 0
+                while reply == "need_binary":
+                    asked += 1
+                    protocol.send_msg(sock, "binary", binary.sha)
+                    protocol.send_bytes(sock, binary.payload)
+                    reply, meta = protocol.recv_msg(sock)
+                assert reply == "result"
+                head = protocol.recv_bytes(sock)
+                buffers = [protocol.recv_buffer(sock) for _ in range(meta)]
+                status, result, _dt = serialization.loads_oob(head, buffers)
+                assert status == "success", result
+                return result, asked
+
+        assert dispatch(b_sum, inline=True) == (45, 0)
+        assert dispatch(b_sum, inline=False) == (45, 0)  # cached: no re-ship
+        assert dispatch(b_max, inline=True) == (9, 0)    # capacity 1: evicts
+        result, asked = dispatch(b_sum, inline=False)    # evicted hash
+        assert (result, asked) == (45, 1), \
+            "evicted binary must recover via exactly one need_binary re-ship"
+    finally:
+        ctx.stop()
+
+
 # --------------------------------------------------------------------------
 # Unit-level companions (no worker processes): tracker-client reconnect and
 # the reaper's bulk map-output invalidation.

@@ -5,9 +5,23 @@ connection to an executor, writes the framed task, and awaits the result on
 the same socket (:382-445), choosing executors round-robin with a pinned-host
 seek (:447-469), retrying connects 5x with backoff (:434-441).
 
-vega_tpu keeps that dispatch shape, and adds the executor fault tolerance
-the reference lacks (SURVEY.md §5 failure detection — its executor loss is
-'retry connect 5x then panic'):
+vega_tpu keeps that dispatch shape, but deduplicates the payload: the
+reference writes the WHOLE serialized task — lineage and closure — per
+task (its one-field capnp envelope, serialized_data.capnp), so an
+N-partition stage pays N lineage pickles on the GIL-bound driver. Here the
+stage binary is pickled once per stage (scheduler/task.py StageBinary) and
+shipped to each executor on first use only; per-task dispatch carries a
+tiny header. Per-executor known-hash sets are advisory — a worker that
+lacks the hash answers `need_binary` and the binary re-ships inline on the
+same connection (protocol.py task_v2 grammar), so respawns and cache
+evictions self-heal. Results return as protocol-5 out-of-band buffer
+frames (zero-copy numpy). `task_binary_dedup=0` keeps the legacy
+one-envelope-per-task protocol live for A/B and fallback
+(benchmarks/dispatch_ab.py measures both legs).
+
+It also adds the executor fault tolerance the reference lacks (SURVEY.md
+§5 failure detection — its executor loss is 'retry connect 5x then
+panic'):
 
   * a dead socket marks the executor lost and re-dispatches its task;
   * a **liveness reaper** thread sweeps worker heartbeats
@@ -81,6 +95,12 @@ class DistributedBackend(TaskBackend):
         env.shuffle_server = None  # driver serves no shuffle data
         self.conf = conf
         self._executors: Dict[str, _Executor] = {}
+        # Per-executor-ID sets of stage-binary hashes believed delivered.
+        # Keyed by executor_id (NOT the _Executor object) so a respawned
+        # slot inherits its predecessor's — deliberately stale — set: the
+        # wire-level need_binary recovery is what keeps that correct, and
+        # the chaos suite drives exactly that staleness.
+        self._known_hashes: Dict[str, Set[str]] = {}
         self._rr = itertools.count(0)
         self._lock = named_lock("distributed.backend.DistributedBackend._lock")
         self._stopped = False
@@ -144,6 +164,10 @@ class DistributedBackend(TaskBackend):
                 VEGA_TPU_FETCH_BATCH_ENABLED=(
                     "1" if self.conf.fetch_batch_enabled else "0"),
                 VEGA_TPU_FETCH_QUEUE_BUCKETS=str(self.conf.fetch_queue_buckets),
+                VEGA_TPU_TASK_BINARY_DEDUP=(
+                    "1" if self.conf.task_binary_dedup else "0"),
+                VEGA_TPU_TASK_BINARY_CACHE_ENTRIES=str(
+                    self.conf.task_binary_cache_entries),
                 # Respawned incarnations disarm one-shot fault injections
                 # (faults.py): a chaos-killed slot comes back healthy.
                 VEGA_TPU_FAULT_INCARNATION=str(incarnation),
@@ -168,6 +192,10 @@ class DistributedBackend(TaskBackend):
             "VEGA_TPU_FETCH_BATCH_ENABLED="
             + ("1" if self.conf.fetch_batch_enabled else "0"),
             f"VEGA_TPU_FETCH_QUEUE_BUCKETS={self.conf.fetch_queue_buckets}",
+            "VEGA_TPU_TASK_BINARY_DEDUP="
+            + ("1" if self.conf.task_binary_dedup else "0"),
+            "VEGA_TPU_TASK_BINARY_CACHE_ENTRIES="
+            + str(self.conf.task_binary_cache_entries),
             f"VEGA_TPU_FAULT_INCARNATION={incarnation}",
             sys.executable, "-m",
             "vega_tpu.distributed.worker",
@@ -452,8 +480,36 @@ class DistributedBackend(TaskBackend):
                     return e
             return alive[next(self._rr) % len(alive)]
 
+    @property
+    def preserialize_stage_binaries(self) -> bool:
+        # Deduplicated dispatch wants the stage binary pickled once at
+        # submit_missing_tasks time (off the per-task path); the legacy
+        # leg pickles whole tasks below and never touches it.
+        return bool(self.conf.task_binary_dedup)
+
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
-        payload = serialization.dumps(task)
+        binary = task.stage_binary
+        dedup = bool(self.conf.task_binary_dedup) and binary is not None
+        if dedup:
+            # Only the tiny header is serialized on the submit caller's
+            # thread (the DAG event loop); the stage binary was pickled
+            # once per stage at submit_missing_tasks time.
+            header_payload = serialization.dumps(task.header())
+            payload = None
+            # Byte counters accumulate per WIRE SEND in _send_task (not
+            # per serialization) so a redispatch after a dead executor
+            # counts the same way on both legs — keeps the A/B
+            # driver-bytes comparison apples-to-apples under retries.
+            stats = {"mode": "v2", "header_bytes": 0,
+                     "binary_bytes": 0, "binaries_shipped": 0,
+                     "need_binary": 0, "cache_hit": 0, "result_bytes": 0}
+        else:
+            # Legacy one-envelope-per-task protocol (the reference's only
+            # shape, serialized_data.capnp): whole lineage per task.
+            header_payload = None
+            payload = serialization.dumps(task)
+            stats = {"mode": "legacy", "task_bytes": 0,
+                     "result_bytes": 0}
 
         def dispatch():
             try:
@@ -461,7 +517,79 @@ class DistributedBackend(TaskBackend):
             except BaseException as exc:  # noqa: BLE001 — a dead dispatch
                 # thread would hang the job; always deliver an event.
                 log.exception("dispatch for %s failed", task)
-                callback(TaskEndEvent(task=task, success=False, error=exc))
+                callback(TaskEndEvent(task=task, success=False, error=exc,
+                                      dispatch=stats))
+
+        def _send_task(sock: socket.socket, executor: _Executor) -> None:
+            if not dedup:
+                protocol.send_msg(sock, "task", payload)
+                stats["task_bytes"] += len(payload)
+                return
+            sha = binary.sha
+            with self._lock:
+                known = self._known_hashes.setdefault(
+                    executor.executor_id, set())
+                if len(known) > 4096:
+                    # Unbounded growth guard (a hash per stage, forever).
+                    # Clearing is always safe: the worst case is one
+                    # redundant re-ship per (stage, executor).
+                    known.clear()
+                ship = sha not in known
+                if ship:
+                    # Optimistically marked BEFORE the send so the other
+                    # 63 dispatch threads of this stage ride the cache
+                    # instead of all shipping the binary; if this send
+                    # dies the worker-side need_binary reply heals it.
+                    known.add(sha)
+            # Coalesced into ONE write on the warm path (TWO when the
+            # binary ships — its possibly-multi-MB payload goes in its own
+            # sendall rather than paying a join copy): the byte stream is
+            # identical to the per-frame sends, but a TCP_NODELAY socket
+            # otherwise emits ~6 small segments per task on exactly the
+            # hot path this plane exists to slim down.
+            frames = [protocol.encode_msg("task_v2", sha),
+                      serialization.frame_bytes(header_payload)]
+            stats["header_bytes"] += len(header_payload)
+            if ship:
+                payload_bytes = binary.payload
+                frames.append(protocol.encode_msg("binary", sha))
+                frames.append(serialization.frame_prefix(len(payload_bytes)))
+                protocol.send_raw(sock, b"".join(frames))
+                protocol.send_raw(sock, payload_bytes)
+                stats["binaries_shipped"] += 1
+                stats["binary_bytes"] += len(payload_bytes)
+            else:
+                frames.append(protocol.encode_msg("binary_cached", sha))
+                protocol.send_raw(sock, b"".join(frames))
+
+        def _recv_result(sock: socket.socket):
+            reply_type, meta = protocol.recv_msg(sock)
+            while reply_type == "need_binary":
+                # Worker lacks the hash (fresh respawn, cache eviction,
+                # chaos drop): re-ship inline on this same connection —
+                # correctness never depends on the known-hash bookkeeping.
+                protocol.send_msg(sock, "binary", binary.sha)
+                protocol.send_bytes(sock, binary.payload)
+                stats["need_binary"] += 1
+                stats["binaries_shipped"] += 1
+                stats["binary_bytes"] += len(binary.payload)
+                reply_type, meta = protocol.recv_msg(sock)
+            if reply_type != "result":
+                raise NetworkError(f"bad reply {reply_type}")
+            if meta is None:
+                # Legacy reply: one pickled frame.
+                reply = protocol.recv_bytes(sock)
+                stats["result_bytes"] += len(reply)
+                return serialization.loads(reply)
+            # Dedup reply: pickle header + `meta` out-of-band buffer
+            # frames received into writable bytearrays (zero-copy numpy).
+            head = protocol.recv_bytes(sock)
+            buffers = [protocol.recv_buffer(sock) for _ in range(meta)]
+            stats["result_bytes"] += len(head) + sum(len(b) for b in buffers)
+            if dedup and stats["need_binary"] == 0 \
+                    and not stats["binaries_shipped"]:
+                stats["cache_hit"] = 1
+            return serialization.loads_oob(head, buffers)
 
         def _dispatch_loop():
             attempts = 0
@@ -485,7 +613,8 @@ class DistributedBackend(TaskBackend):
                         if time.time() < no_executor_deadline:
                             time.sleep(0.25)
                             continue
-                    callback(TaskEndEvent(task=task, success=False, error=e))
+                    callback(TaskEndEvent(task=task, success=False, error=e,
+                                          dispatch=stats))
                     return
                 no_executor_deadline = None
                 try:
@@ -506,7 +635,7 @@ class DistributedBackend(TaskBackend):
                                 )
                             executor.sockets.add(sock)
                         try:
-                            protocol.send_msg(sock, "task", payload)
+                            _send_task(sock, executor)
                             # The result wait is unbounded: tasks may
                             # legitimately run for hours. Executor death is
                             # detected by the OS (socket reset; keepalive
@@ -515,12 +644,7 @@ class DistributedBackend(TaskBackend):
                             sock.settimeout(None)
                             sock.setsockopt(socket.SOL_SOCKET,
                                             socket.SO_KEEPALIVE, 1)
-                            reply_type, _ = protocol.recv_msg(sock)
-                            if reply_type != "result":
-                                raise NetworkError(f"bad reply {reply_type}")
-                            status, *rest = serialization.loads(
-                                protocol.recv_bytes(sock)
-                            )
+                            status, *rest = _recv_result(sock)
                         finally:
                             with self._lock:
                                 executor.sockets.discard(sock)
@@ -535,13 +659,14 @@ class DistributedBackend(TaskBackend):
                         result, duration = rest
                         callback(TaskEndEvent(task=task, success=True,
                                               result=result,
-                                              duration_s=duration))
+                                              duration_s=duration,
+                                              dispatch=stats))
                     else:
                         exc, remote_tb = rest
                         if not isinstance(exc, BaseException):
                             exc = TaskError(repr(exc), remote_traceback=remote_tb)
                         callback(TaskEndEvent(task=task, success=False,
-                                              error=exc))
+                                              error=exc, dispatch=stats))
                     return
                 except NetworkError as e:
                     # Executor lost: mark dead, re-dispatch elsewhere
@@ -557,7 +682,8 @@ class DistributedBackend(TaskBackend):
                             executor.alive = executor.process is not None and \
                                 executor.process.poll() is None
                     if attempts >= 3 + len(self._executors):
-                        callback(TaskEndEvent(task=task, success=False, error=e))
+                        callback(TaskEndEvent(task=task, success=False,
+                                              error=e, dispatch=stats))
                         return
                     time.sleep(0.1 * attempts)
 

@@ -25,6 +25,33 @@ client (fetch_many_remote) can never drift:
 Per-bucket status is preserved (a missing bucket escalates exactly like the
 single-`get` "missing" reply) and the terminator lets the client detect a
 truncated stream (dropped connection mid-batch) and retry ONLY the tail.
+
+The task plane has a second multi-frame exchange: the deduplicated
+dispatch protocol (`task_v2`). The legacy `task` message carries the whole
+pickled task (lineage included) per task — the reference's
+one-envelope-per-task shape (serialized_data.capnp). `task_v2` splits that
+into a tiny per-task header plus a stage-level binary shipped once per
+(stage, executor) and cached worker-side:
+
+    -> ("task_v2", sha) + one header frame          (TaskHeader pickle)
+    -> ("binary", sha) + one binary frame           (first use on this
+                                                     executor)
+     | ("binary_cached", sha)                       (driver believes the
+                                                     worker has it)
+    <- ("need_binary", sha)                         (worker lacks it:
+                                                     fresh respawn or LRU
+                                                     eviction — driver
+                                                     bookkeeping is only
+                                                     a hint)
+    -> ("binary", sha) + one binary frame           (inline re-ship, same
+                                                     connection)
+    <- ("result", n_oob) + one pickle-header frame + n_oob out-of-band
+       buffer frames (serialization.dumps_oob: numpy-bearing results
+       cross the wire without the extra pickle copy; received into
+       writable bytearrays so reconstructed arrays stay mutable)
+
+The legacy `task` reply stays ("result", None) + one pickled frame, so
+`task_binary_dedup=0` exercises the complete old envelope end to end.
 """
 
 from __future__ import annotations
@@ -88,11 +115,49 @@ def send_bytes(sock: socket.socket, data: bytes) -> None:
     serialization.write_frame(_SockStream(sock), data)
 
 
+def encode_msg(msg_type: str, payload: Any = None) -> bytes:
+    """One control message as framed bytes — byte-identical to what
+    send_msg writes, for callers that coalesce several frames into a
+    single send (a TCP_NODELAY socket turns every small write into its
+    own segment; the per-task dispatch path sends three)."""
+    return serialization.frame_bytes(serialization.dumps((msg_type, payload)))
+
+
+def send_raw(sock: socket.socket, data: bytes) -> None:
+    """One sendall of pre-framed bytes (see encode_msg)."""
+    _SockStream(sock).write(data)
+
+
 def recv_bytes(sock: socket.socket) -> bytes:
     try:
         return serialization.read_frame(_SockStream(sock))
     except EOFError as e:
         raise NetworkError("connection closed mid-message") from e
+
+
+def recv_buffer(sock: socket.socket) -> bytearray:
+    """Receive one frame into a writable bytearray via recv_into: one copy
+    off the kernel, and `loads_oob` reconstructs numpy arrays directly over
+    the buffer — writable backing keeps the arrays mutable (a bytes-backed
+    out-of-band buffer would make every collected array read-only)."""
+    try:
+        n = serialization.read_frame_len(_SockStream(sock))
+    except EOFError as e:
+        raise NetworkError("connection closed mid-message") from e
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except OSError as e:
+            raise NetworkError(f"socket read failed: {e}") from e
+        if not r:
+            raise NetworkError(
+                f"connection closed with {n - got} buffer bytes outstanding"
+            )
+        got += r
+    return buf
 
 
 def request(host: str, port: int, msg_type: str, payload: Any = None,

@@ -34,6 +34,7 @@ from vega_tpu.distributed.driver_service import RemoteTrackerClient
 from vega_tpu.distributed.shuffle_server import ShuffleServer
 from vega_tpu.env import Configuration, DeploymentMode, Env
 from vega_tpu.errors import NetworkError
+from vega_tpu.scheduler.task import TaskBinaryCache, run_from_header
 
 log = logging.getLogger("vega_tpu")
 
@@ -54,6 +55,9 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             return
         if msg_type == "ping":
             protocol.send_msg(sock, "ok", worker.executor_id)
+            return
+        if msg_type == "task_v2":
+            self._handle_task_v2(sock, worker, payload)
             return
         if msg_type != "task":
             protocol.send_msg(sock, "error", f"unknown {msg_type}")
@@ -78,20 +82,105 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         except BaseException as exc:  # noqa: BLE001 — ship error to driver
             log.debug("task failed", exc_info=True)
             try:
-                reply = serialization.dumps(
-                    ("error", exc, traceback.format_exc())
-                )
-            except Exception:  # unpicklable exception: ship its repr
-                log.warning("task exception %r is unpicklable; shipping "
-                            "repr to driver", exc, exc_info=True)
-                reply = serialization.dumps(
-                    ("error", RuntimeError(repr(exc)), traceback.format_exc())
-                )
-            try:
                 protocol.send_msg(sock, "result", None)
-                protocol.send_bytes(sock, reply)
+                protocol.send_bytes(sock, _pickle_error(exc))
             except NetworkError:
                 pass
+
+    def _handle_task_v2(self, sock, worker: "Worker", sha: str) -> None:
+        """Deduplicated dispatch (protocol.py task_v2 grammar): tiny header
+        frame + stage binary only on first use; the binary is unpickled
+        once per executor and shared across this stage's task threads (the
+        object-sharing local threaded mode already has). A missing hash —
+        fresh respawn, LRU eviction, chaos drop — answers `need_binary`
+        and the driver re-ships inline on this same connection, so
+        correctness never depends on driver bookkeeping."""
+        claim = None
+        try:
+            header_bytes = protocol.recv_bytes(sock)
+            marker, _marker_sha = protocol.recv_msg(sock)
+            if marker == "binary":
+                # Announce the transfer BEFORE the (possibly multi-MB)
+                # payload recv: sibling binary_cached dispatches landing
+                # mid-transfer park in wait_for instead of each triggering
+                # a need_binary re-ship (cold-stage thundering herd).
+                claim = worker.binaries.claim(sha)
+            elif marker != "binary_cached":
+                # Version-skewed/buggy driver: answer a typed error (like
+                # the top-level handler for unknown msg_types) instead of
+                # desyncing into the need_binary exchange.
+                protocol.send_msg(sock, "error", f"unknown marker {marker}")
+                return
+            raw = protocol.recv_bytes(sock) if marker == "binary" else None
+        except NetworkError:
+            worker.binaries.abandon(sha, claim)
+            return
+        t0 = time.time()
+        try:
+            faults.get().maybe_hang_task()  # chaos: wedged-but-alive worker
+            if marker == "binary_cached" and faults.get().maybe_drop_binary():
+                worker.binaries.drop(sha)
+            binary = None
+            if raw is None:
+                # Waits briefly if a sibling connection is mid-deserialize
+                # of the same hash (stage-start thundering herd) before
+                # declaring a miss.
+                binary = worker.binaries.wait_for(sha)
+                if binary is None:
+                    protocol.send_msg(sock, "need_binary", sha)
+                    # Claim the re-ship too, so dispatches arriving during
+                    # its transfer park instead of requesting their own.
+                    claim = worker.binaries.claim(sha)
+                    # Bounded wait: a driver that vanished mid-exchange
+                    # must not strand this handler thread forever.
+                    sock.settimeout(protocol.IO_TIMEOUT)
+                    try:
+                        reply_type, _ = protocol.recv_msg(sock)
+                        if reply_type != "binary":
+                            raise NetworkError(
+                                f"expected binary re-ship, got {reply_type}"
+                            )
+                        raw = protocol.recv_bytes(sock)
+                    finally:
+                        sock.settimeout(None)
+            if binary is None:
+                binary = worker.binaries.load(sha, raw, claim)
+            header = serialization.loads(header_bytes)
+            result = run_from_header(header, binary)
+            # Chaos kill point: computed but unacknowledged (see legacy
+            # path above).
+            faults.get().maybe_kill_worker()
+            head, buffers = serialization.dumps_oob(
+                ("success", result, time.time() - t0)
+            )
+        except BaseException as exc:  # noqa: BLE001 — ship error to driver
+            # Release the transfer claim if the load never consumed it
+            # (recv failure, hang/kill chaos) so parked siblings re-check
+            # instead of waiting out the full load timeout.
+            worker.binaries.abandon(sha, claim)
+            log.debug("task failed", exc_info=True)
+            head, buffers = _pickle_error(exc), []
+        try:
+            # Zero-copy result: pickle header + framed out-of-band buffers
+            # (numpy-bearing partition results cross the wire without the
+            # in-band pickle copy the legacy reply pays).
+            protocol.send_msg(sock, "result", len(buffers))
+            protocol.send_bytes(sock, head)
+            for buf in buffers:
+                protocol.send_bytes(sock, buf)
+        except NetworkError:
+            pass
+
+
+def _pickle_error(exc: BaseException) -> bytes:
+    try:
+        return serialization.dumps(("error", exc, traceback.format_exc()))
+    except Exception:  # unpicklable exception: ship its repr
+        log.warning("task exception %r is unpicklable; shipping repr to "
+                    "driver", exc, exc_info=True)
+        return serialization.dumps(
+            ("error", RuntimeError(repr(exc)), traceback.format_exc())
+        )
 
 
 class Worker:
@@ -111,6 +200,9 @@ class Worker:
         env.shuffle_server = ShuffleServer(env.shuffle_store, host)
 
         self.tracker = tracker
+        # Deserialized stage binaries, one unpickle per stage per executor
+        # (bounded LRU; misses recover via the need_binary re-ship).
+        self.binaries = TaskBinaryCache(conf.task_binary_cache_entries)
         self._server = socketserver.ThreadingTCPServer(
             (host, port), _TaskHandler, bind_and_activate=True
         )

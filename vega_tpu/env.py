@@ -109,6 +109,21 @@ class Configuration:
     # threads block past it — backpressure IS the reducer's peak-memory
     # bound; the old path materialized the entire List[bytes]).
     fetch_queue_buckets: int = 32
+    # --- task dispatch plane ---
+    # Deduplicated dispatch: tasks ship as a tiny header plus a
+    # stage-level binary (the shared (rdd, func | shuffle_dep) closure,
+    # cloudpickled once per stage, content-hashed, sent to each executor
+    # on first use only — a worker lacking the hash answers `need_binary`
+    # and gets it re-shipped inline, so correctness never depends on
+    # driver bookkeeping). Results return with protocol-5 out-of-band
+    # buffers (zero-copy numpy). 0/false keeps the legacy
+    # one-envelope-per-task protocol live (A/B and fallback; the
+    # reference's only shape, serialized_data.capnp).
+    task_binary_dedup: bool = True
+    # Bound on the executor-side LRU of *deserialized* stage binaries
+    # (one lineage unpickle per stage per executor, not per task). An
+    # evicted hash recovers via the need_binary re-ship.
+    task_binary_cache_entries: int = 32
     # Dense-tier shuffle collective: "all_to_all" (one fused collective,
     # [n_shards x slot] peak buffer) or "ring" (n-1 ppermute steps, one-slot
     # peak buffer — for big blocks on big meshes). See tpu/ring.py.
@@ -189,11 +204,12 @@ class Configuration:
                      "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
                      "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
                      "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
-                     "FETCH_QUEUE_BUCKETS"):
+                     "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
-                     "SPECULATION", "FETCH_BATCH_ENABLED"):
+                     "SPECULATION", "FETCH_BATCH_ENABLED",
+                     "TASK_BINARY_DEDUP"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
         for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",

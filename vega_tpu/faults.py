@@ -19,6 +19,9 @@ the distributed plane consults at its natural failure seams:
   - shuffle/store  -> corrupt_spilled(disk, key) (flip payload bytes in a
                       spilled bucket file — the checksummed read must turn
                       it into a miss, never wrong data)
+  - worker.py      -> maybe_drop_binary() (evict a cached task binary the
+                      driver believes this worker holds — forcing the
+                      task_v2 `need_binary` re-ship path mid-stage)
 
 Configuration is via VEGA_TPU_FAULT_* environment variables so injections
 propagate into spawned executor subprocesses (DistributedBackend copies
@@ -39,6 +42,10 @@ tests:
                                      buckets to serve before the stream
                                      cut (default 1: deliver one, drop)
   VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
+  VEGA_TPU_FAULT_DROP_BINARY_N       drop the cached stage binary for the
+                                     first N `binary_cached` task_v2
+                                     dispatches (simulated LRU eviction /
+                                     stale driver known-hash set)
   VEGA_TPU_FAULT_STATS_DIR           append one JSON line per injected
                                      fault to <dir>/faults-<pid>.jsonl so
                                      cross-process tests can assert the
@@ -100,6 +107,7 @@ class FaultInjector:
         self.fetch_stream_drop_n = _int("FETCH_STREAM_DROP_N") if armed else 0
         self.fetch_drop_after_buckets = _int("FETCH_DROP_AFTER_BUCKETS", 1)
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
+        self.drop_binary_n = _int("DROP_BINARY_N") if armed else 0
         self.stats_dir = env.get(pref + "STATS_DIR") or None
 
         self._tasks_done = 0
@@ -113,7 +121,7 @@ class FaultInjector:
             self.kill_after_tasks or self.hang_tasks
             or self.suppress_heartbeats or self.fetch_drop_n
             or self.fetch_delay_s or self.corrupt_spill_n
-            or self.fetch_stream_drop_n
+            or self.fetch_stream_drop_n or self.drop_binary_n
         )
 
     def _targets_me(self) -> bool:
@@ -191,6 +199,22 @@ class FaultInjector:
         self._record("fetch_stream_drop", bucket_index=bucket_index)
         log.warning("FAULT: cutting get_many stream after %d buckets",
                     bucket_index)
+        return True
+
+    def maybe_drop_binary(self) -> bool:
+        """worker.py, on a task_v2 dispatch whose driver believes the stage
+        binary is already cached here: True -> the worker must evict it
+        first, forcing the `need_binary` re-ship recovery mid-stage (the
+        LRU-eviction / respawn-staleness path, driven deterministically)."""
+        if not (self.active and self.drop_binary_n and self._targets_me()):
+            return False
+        with self._lock:
+            if self.drop_binary_n <= 0:
+                return False
+            self.drop_binary_n -= 1
+        self._record("drop_binary")
+        log.warning("FAULT: dropping cached task binary (forcing "
+                    "need_binary re-ship)")
         return True
 
     def corrupt_spilled(self, disk_store, key: str) -> None:

@@ -33,6 +33,7 @@ from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.scheduler.task import (
     ResultTask,
     ShuffleMapTask,
+    StageBinary,
     Task,
     TaskContext,
     TaskEndEvent,
@@ -41,8 +42,45 @@ from vega_tpu.scheduler.task import (
 log = logging.getLogger("vega_tpu")
 
 
+def _lineage_token(rdd) -> tuple:
+    """Cheap driver-side fingerprint of the MUTABLE lineage state reachable
+    from `rdd`: cache/persist flags and checkpoint materialization are
+    flipped in place on live RDD objects, so a stage binary snapshotted
+    before the flip would ship stale semantics on a later resubmission
+    (the legacy leg re-pickles live objects per task and never sees this).
+    submit_missing_tasks rebuilds the binary when the token changed."""
+    token = []
+    seen = set()
+    stack = [rdd]
+    while stack:
+        r = stack.pop()
+        if r.rdd_id in seen:
+            continue
+        seen.add(r.rdd_id)
+        checkpointed = getattr(r, "_checkpointed_rdd", None)
+        token.append((
+            r.rdd_id, bool(getattr(r, "should_cache", False)),
+            str(getattr(r, "storage_level", None)),
+            checkpointed.rdd_id if checkpointed is not None else -1,
+        ))
+        for dep in r.get_dependencies():
+            # Cross shuffle boundaries too: the pickled graph reaches
+            # parent lineages through ShuffleDependency.rdd.
+            stack.append(dep.rdd)
+    return tuple(sorted(token))
+
+
 class TaskBackend:
     """Executes tasks and reports completions."""
+
+    # Backends that serialize tasks (distributed dispatch; the opt-in local
+    # round-trip) set this so the DAG scheduler pre-serializes the stage
+    # binary at submit_missing_tasks time — once per stage, off the
+    # per-task path. Pure in-process backends leave it False and never pay
+    # the pickle.
+    @property
+    def preserialize_stage_binaries(self) -> bool:
+        return False
 
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         raise NotImplementedError
@@ -255,6 +293,7 @@ class DAGScheduler:
             return [result]
 
         stage_starts: Dict[int, float] = {}
+        submitted_stages: set = set()
 
         def submit_stage(stage: Stage):
             """Reference: base_scheduler.rs:347-375."""
@@ -272,6 +311,7 @@ class DAGScheduler:
         def submit_missing_tasks(stage: Stage):
             """Reference: base_scheduler.rs:377-455."""
             stage_starts.setdefault(stage.id, time.time())
+            submitted_stages.add(stage)
             pending = job.pending_tasks.setdefault(stage.id, set())
             tasks: List[Task] = []
             if stage is final_stage:
@@ -294,6 +334,25 @@ class DAGScheduler:
                             self._get_preferred_locs(stage.rdd, p),
                             pinned=stage.rdd.is_pinned,
                         ))
+            # One stage binary for every task of the stage (and every retry
+            # / resubmission / later job over a cached map stage): the
+            # shared (rdd, func | shuffle_dep) closure serializes once per
+            # stage here — off the per-task dispatch path — instead of
+            # riding inside every task envelope. Rebuilt only when the
+            # mutable lineage state the binary snapshotted has changed
+            # (persist/unpersist, checkpoint materialization).
+            token = _lineage_token(stage.rdd)
+            if stage.task_binary is None or stage.task_binary_token != token:
+                if stage is final_stage:
+                    stage.task_binary = StageBinary("result", rdd, func)
+                else:
+                    stage.task_binary = StageBinary(
+                        "shuffle", stage.rdd, stage.shuffle_dep)
+                stage.task_binary_token = token
+            if self.backend.preserialize_stage_binaries:
+                stage.task_binary.ensure_serialized()
+            for task in tasks:
+                task.stage_binary = stage.task_binary
             self.bus.post(ev.StageSubmitted(
                 stage_id=stage.id, num_tasks=len(tasks),
                 is_shuffle_map=stage.is_shuffle_map,
@@ -419,7 +478,7 @@ class DAGScheduler:
                 self.bus.post(ev.TaskEnd(
                     task_id=event.task.task_id, stage_id=event.task.stage_id,
                     partition=event.task.partition, success=event.success,
-                    duration_s=event.duration_s,
+                    duration_s=event.duration_s, dispatch=event.dispatch,
                 ))
                 key = (event.task.stage_id, event.task.partition)
                 job.outstanding[key] = max(0, job.outstanding.get(key, 1) - 1)
@@ -443,6 +502,15 @@ class DAGScheduler:
             raise
         finally:
             self._active_job = None
+            # Shuffle-map Stages outlive the job (_shuffle_to_map_stage
+            # caches them for the driver's lifetime): drop the serialized
+            # payload now — the binary keeps its live (rdd, dep) refs and
+            # lazily re-serializes on a rare post-loss resubmission —
+            # instead of pinning one full pickled lineage copy per stage
+            # (a parallelize() source embeds the whole dataset) forever.
+            for s in submitted_stages:
+                if s.task_binary is not None:
+                    s.task_binary.release_payload()
 
     # ------------------------------------------------------------- internals
     def _on_executor_lost(self, executor_id: str, host: str,

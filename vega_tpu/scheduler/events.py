@@ -64,6 +64,11 @@ class TaskEnd(Event):
     success: bool = True
     duration_s: float = 0.0
     executor: str = "local"
+    # Dispatch-plane accounting from the distributed backend (task_v2:
+    # header/binary/result bytes, binaries shipped, cache hits,
+    # need_binary re-ships; legacy: full-envelope bytes). None when the
+    # backend doesn't measure (local threads).
+    dispatch: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -241,6 +246,22 @@ class MetricsListener(Listener):
         self.fetch_wall_s = 0.0
         self.fetch_net_s = 0.0
         self.fetch_overlap_s = 0.0
+        # Task-dispatch-plane counters (TaskEnd.dispatch): driver-side
+        # serialized bytes per leg, stage binaries actually shipped vs
+        # worker cache hits, need_binary recoveries. benchmarks/
+        # dispatch_ab.py and bench.py surface these as `dispatch`.
+        self.dispatch: Dict[str, int] = {
+            "tasks_v2": 0,
+            "tasks_legacy": 0,
+            "header_bytes": 0,
+            "binary_bytes": 0,
+            "binaries_shipped": 0,
+            "binary_cache_hits": 0,
+            "need_binary": 0,
+            "legacy_task_bytes": 0,
+            "result_bytes": 0,
+            "driver_serialized_bytes": 0,
+        }
         self._lock = named_lock("scheduler.events.MetricsListener._lock")
 
     def on_event(self, event: Event) -> None:
@@ -267,6 +288,23 @@ class MetricsListener(Listener):
                 self.total_task_time_s += event.duration_s
                 if not event.success:
                     self.task_failures += 1
+                d = event.dispatch
+                if d:
+                    dd = self.dispatch
+                    if d.get("mode") == "v2":
+                        dd["tasks_v2"] += 1
+                        dd["header_bytes"] += d.get("header_bytes", 0)
+                        dd["binary_bytes"] += d.get("binary_bytes", 0)
+                        dd["binaries_shipped"] += d.get("binaries_shipped", 0)
+                        dd["binary_cache_hits"] += d.get("cache_hit", 0)
+                        dd["need_binary"] += d.get("need_binary", 0)
+                        dd["driver_serialized_bytes"] += (
+                            d.get("header_bytes", 0) + d.get("binary_bytes", 0))
+                    else:
+                        dd["tasks_legacy"] += 1
+                        dd["legacy_task_bytes"] += d.get("task_bytes", 0)
+                        dd["driver_serialized_bytes"] += d.get("task_bytes", 0)
+                    dd["result_bytes"] += d.get("result_bytes", 0)
             elif isinstance(event, ExecutorLost):
                 self.executors_lost += 1
             elif isinstance(event, ExecutorRestarted):
@@ -314,4 +352,5 @@ class MetricsListener(Listener):
                     "net_s": round(self.fetch_net_s, 6),
                     "overlap_s": round(self.fetch_overlap_s, 6),
                 },
+                "dispatch": dict(self.dispatch),
             }

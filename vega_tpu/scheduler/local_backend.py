@@ -5,6 +5,13 @@ pool (:336-352) and round-trip through bincode even locally (:345-351) to
 catch unserializable tasks early. vega_tpu mirrors both (the round-trip is
 opt-in via Configuration.serialize_tasks_locally; the numeric tier releases
 the GIL inside XLA so threads parallelize the hot path).
+
+The round-trip rides the deduplicated dispatch split (scheduler/task.py):
+the stage binary — the whole lineage — serializes once per stage and
+deserializes once per distinct stage (TaskBinaryCache), while the tiny
+per-task header still round-trips per task. The reference (and the old
+opt-in here) re-pickled the full lineage per task, so a 64-partition stage
+paid 64x the serialization for one correctness check.
 """
 
 from __future__ import annotations
@@ -16,7 +23,12 @@ from typing import Callable
 from vega_tpu import serialization
 from vega_tpu.env import Env
 from vega_tpu.scheduler.dag import TaskBackend
-from vega_tpu.scheduler.task import Task, TaskEndEvent
+from vega_tpu.scheduler.task import (
+    Task,
+    TaskBinaryCache,
+    TaskEndEvent,
+    run_from_header,
+)
 
 log = logging.getLogger("vega_tpu")
 
@@ -31,6 +43,10 @@ class LocalBackend(TaskBackend):
             if serialize_tasks is None
             else serialize_tasks
         )
+        # Deserialized stage binaries shared across this backend's task
+        # threads — the same object-sharing local threads already have on
+        # the non-serializing path.
+        self._binaries = TaskBinaryCache(conf.task_binary_cache_entries)
         self._pool = ThreadPoolExecutor(
             max_workers=self._num_workers, thread_name_prefix="vega-task"
         )
@@ -39,17 +55,20 @@ class LocalBackend(TaskBackend):
     def parallelism(self) -> int:
         return self._num_workers
 
+    @property
+    def preserialize_stage_binaries(self) -> bool:
+        # The serializing round-trip wants the lineage pickled once per
+        # stage at submit_missing_tasks time; the plain threaded path
+        # must never pay the pickle at all.
+        return self._serialize
+
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         def run():
             import time
 
             t_start = time.time()
             try:
-                t = task
-                if self._serialize:
-                    # Reference: local_scheduler.rs:345-351.
-                    t = serialization.loads(serialization.dumps(task))
-                result = t.run()
+                result = self._run_one(task)
                 callback(TaskEndEvent(task=task, success=True, result=result,
                                       duration_s=time.time() - t_start))
             except BaseException as exc:  # noqa: BLE001 — report, don't die
@@ -58,6 +77,23 @@ class LocalBackend(TaskBackend):
                                       duration_s=time.time() - t_start))
 
         self._pool.submit(run)
+
+    def _run_one(self, task: Task):
+        if not self._serialize:
+            return task.run()
+        binary = task.stage_binary
+        if binary is None:
+            # Tasks submitted outside the DAG scheduler (no stage binary):
+            # the legacy full round-trip (reference: local_scheduler.rs:
+            # 345-351).
+            return serialization.loads(serialization.dumps(task)).run()
+        payload = binary.ensure_serialized()  # cached: once per stage
+        obj = self._binaries.get(binary.sha)
+        if obj is None:
+            obj = self._binaries.load(binary.sha, payload)
+        # The header is the only thing still round-tripped per task.
+        header = serialization.loads(serialization.dumps(task.header()))
+        return run_from_header(header, obj)
 
     def stop(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

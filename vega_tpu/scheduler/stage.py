@@ -22,6 +22,16 @@ class Stage:
         self.parents = parents
         self.num_partitions = rdd.num_partitions
         self.output_locs: List[List[str]] = [[] for _ in range(self.num_partitions)]
+        # The stage-level task binary (scheduler/task.py StageBinary),
+        # built lazily at first submit_missing_tasks and reused across
+        # retries, resubmissions, and later jobs over a cached map stage:
+        # the lineage serializes once per stage, not once per task. The
+        # token fingerprints the mutable lineage state the binary
+        # snapshotted (persist flags, checkpoint materialization) — a
+        # mismatch at resubmission rebuilds the binary instead of shipping
+        # stale bytes (dag.py _lineage_token).
+        self.task_binary = None
+        self.task_binary_token = None
 
     @property
     def is_shuffle_map(self) -> bool:

@@ -3,14 +3,40 @@
 Reference: src/scheduler/task.rs (TaskContext :12-26, TaskOption/TaskResult
 envelope :76-103, run dispatch :105-111), result_task.rs (ResultTask::run
 :159-165), shuffle_map_task.rs (ShuffleMapTask::run :86-91).
+
+The reference ships the WHOLE serialized task — lineage, closure and all —
+in every per-task capnp envelope (serialized_data.capnp), so an N-partition
+stage pays N times the lineage serialization on the driver and N
+deserializations per executor. vega_tpu splits that envelope:
+
+  * ``StageBinary`` — the stage-invariant closure, ``(rdd, func)`` for a
+    result stage or ``(rdd, shuffle_dep)`` for a map stage, cloudpickled
+    ONCE per stage and content-hashed. Built by the DAG scheduler at
+    submit_missing_tasks time, off the per-task path.
+  * ``TaskHeader`` — the per-task residue (ids, split, attempt, binary
+    hash): the only thing serialized per task.
+  * ``TaskBinaryCache`` — the executor-side bounded LRU of *deserialized*
+    binaries, so a stage's lineage is unpickled once per executor, not
+    once per task (the same object-sharing semantics local threaded mode
+    has). A miss on a hash the driver believed cached (fresh respawn, LRU
+    eviction) recovers via the wire-level ``need_binary`` re-ship — see
+    distributed/protocol.py.
+
+The Task classes themselves stay fully picklable (minus the attached
+binary) so ``task_binary_dedup=0`` keeps the legacy one-envelope-per-task
+protocol alive for A/B runs and fallback.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, List, Optional
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from vega_tpu.dependency import ShuffleDependency
+from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.split import Split
 
 
@@ -26,6 +52,220 @@ class TaskContext:
 _task_ids = iter(range(1, 1 << 62))
 
 
+class StageBinary:
+    """The stage-invariant half of a task: ``(kind, rdd, func | dep)``,
+    serialized lazily exactly once and addressed by content hash.
+
+    Lazy because local non-serializing backends never need the bytes;
+    cached on the Stage object so retries, resubmissions and later jobs
+    over a cached map stage reuse one payload (the binary snapshots the
+    lineage at first submission — stages are immutable units of work).
+    """
+
+    # Test hook: total lineage serializations this process (asserting the
+    # once-per-stage contract needs a global observation point).
+    total_serializations = 0
+
+    def __init__(self, kind: str, rdd, aux):
+        assert kind in ("result", "shuffle")
+        self.kind = kind
+        self.rdd = rdd
+        self.aux = aux  # func (result) | ShuffleDependency (shuffle)
+        # (payload, sha) swapped as ONE tuple so readers never see a torn
+        # pair across a concurrent release_payload/re-serialize.
+        self._frozen: Optional[Tuple[bytes, str]] = None
+        self._lock = named_lock("scheduler.task.StageBinary._lock")
+
+    def _materialize(self) -> Tuple[bytes, str]:
+        """Serialize once; every later caller gets the cached bytes. Also
+        the unserializability check: a lineage that cannot pickle fails
+        here, once per stage instead of once per task."""
+        frozen = self._frozen
+        if frozen is None:
+            with self._lock:
+                frozen = self._frozen
+                if frozen is None:
+                    from vega_tpu import serialization
+
+                    payload = serialization.dumps(
+                        (self.kind, self.rdd, self.aux)
+                    )
+                    StageBinary.total_serializations += 1
+                    frozen = self._frozen = (
+                        payload, hashlib.sha256(payload).hexdigest()
+                    )
+        return frozen
+
+    def ensure_serialized(self) -> bytes:
+        return self._materialize()[0]
+
+    def release_payload(self) -> None:
+        """Drop the serialized bytes (live (rdd, aux) refs stay): shuffle-
+        map Stages are cached for the driver's lifetime, and keeping every
+        stage's pickled lineage pinned (a parallelize() source embeds the
+        whole dataset) grows driver RSS without bound across jobs. A later
+        resubmission lazily re-serializes — and re-hashes, so the shipped
+        (payload, sha) pair is always self-consistent."""
+        with self._lock:
+            self._frozen = None
+
+    @property
+    def payload(self) -> bytes:
+        return self._materialize()[0]
+
+    @property
+    def sha(self) -> str:
+        return self._materialize()[1]
+
+    def __repr__(self):
+        frozen = self._frozen
+        state = "lazy" if frozen is None else f"{len(frozen[0])}B"
+        return f"StageBinary({self.kind}, rdd={self.rdd.rdd_id}, {state})"
+
+
+@dataclasses.dataclass
+class TaskHeader:
+    """The per-task residue once the stage binary is factored out: what
+    `task_v2` actually serializes per task (reference ships the full
+    envelope per task, serialized_data.capnp)."""
+
+    task_id: int
+    stage_id: int
+    partition: int
+    split: Split
+    attempt: int
+    binary_sha: str
+    kind: str  # "result" | "shuffle" (observability; binary is authoritative)
+    output_id: Optional[int] = None  # driver-side bookkeeping only
+
+
+def run_from_header(header: TaskHeader, binary: Tuple[str, Any, Any]) -> Any:
+    """Execute a task from its header plus the (shared) deserialized stage
+    binary — the executor-side mirror of ResultTask.run/ShuffleMapTask.run."""
+    kind, rdd, aux = binary
+    tc = TaskContext(header.stage_id, header.split.index, header.attempt)
+    if kind == "result":
+        return aux(tc, rdd.iterator(header.split, tc))
+    return aux.do_shuffle_task(header.split, tc)
+
+
+class TaskBinaryCache:
+    """Bounded LRU of *deserialized* stage binaries, keyed by content hash.
+
+    Shared by executor workers (one per process) and the serializing
+    LocalBackend. Concurrent arrivals of the same hash deserialize once:
+    the first loader claims a pending event, racers wait on it briefly
+    instead of redundantly unpickling (or prematurely answering
+    `need_binary` while the payload-carrying sibling connection is mid-
+    load). Deserialization happens OUTSIDE the lock."""
+
+    _LOAD_WAIT_S = 5.0
+
+    def __init__(self, capacity: int):
+        self._capacity = max(1, int(capacity))
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self._pending: Dict[str, threading.Event] = {}
+        self._lock = named_lock("scheduler.task.TaskBinaryCache._lock")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, sha: str):
+        with self._lock:
+            obj = self._entries.get(sha)
+            if obj is not None:
+                self._entries.move_to_end(sha)
+            return obj
+
+    def wait_for(self, sha: str, timeout: Optional[float] = None):
+        """Cached object, or None. If a sibling is mid-deserialize for this
+        hash, wait for it (bounded) instead of reporting a miss."""
+        with self._lock:
+            obj = self._entries.get(sha)
+            if obj is not None:
+                self._entries.move_to_end(sha)
+                return obj
+            event = self._pending.get(sha)
+        if event is None:
+            return None
+        event.wait(self._LOAD_WAIT_S if timeout is None else timeout)
+        return self.get(sha)
+
+    def claim(self, sha: str):
+        """Announce an in-flight remote transfer of `sha` BEFORE its payload
+        is read off the wire: sibling `binary_cached` dispatches that land
+        mid-transfer park in wait_for instead of each answering
+        `need_binary` — without this, the stage-start thundering herd on a
+        cold executor re-ships exactly the multi-MB payload the dedup plane
+        exists to avoid (window scales with binary size). Returns an
+        ownership token to pass to load()/abandon(), or None when the hash
+        is already cached or another transfer/deserialize holds the claim.
+        """
+        with self._lock:
+            if sha in self._entries or sha in self._pending:
+                return None
+            event = self._pending[sha] = threading.Event()
+            return event
+
+    def abandon(self, sha: str, token) -> None:
+        """Release a claim whose transfer failed or was consumed; parked
+        waiters re-check and self-heal via their own need_binary round."""
+        if token is None:
+            return
+        with self._lock:
+            if self._pending.get(sha) is token:
+                self._pending.pop(sha)
+        token.set()
+
+    def load(self, sha: str, raw: bytes, token=None):
+        """Deserialize-and-insert, coalescing concurrent loaders. `token`
+        (from claim()) marks this caller as the owning transfer, so its own
+        pending event does not make it wait on itself."""
+        with self._lock:
+            obj = self._entries.get(sha)
+            if obj is not None:
+                self._entries.move_to_end(sha)
+                return obj
+            event = self._pending.get(sha)
+            owner = event is None or event is token
+            if event is None:
+                event = self._pending[sha] = threading.Event()
+        if not owner:
+            event.wait(self._LOAD_WAIT_S)
+            obj = self.get(sha)
+            if obj is not None:
+                return obj
+            # The owning loader failed or stalled: load independently.
+        from vega_tpu import serialization
+
+        try:
+            obj = serialization.loads(raw)
+        except BaseException:
+            if owner:
+                with self._lock:
+                    pending = self._pending.pop(sha, None)
+                if pending is not None:
+                    pending.set()  # unblock waiters; they will re-miss
+            raise
+        self.put(sha, obj)
+        return obj
+
+    def put(self, sha: str, obj) -> None:
+        with self._lock:
+            self._entries[sha] = obj
+            self._entries.move_to_end(sha)
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+            pending = self._pending.pop(sha, None)
+        if pending is not None:
+            pending.set()
+
+    def drop(self, sha: str) -> None:
+        with self._lock:
+            self._entries.pop(sha, None)
+
+
 class Task:
     """Common task surface (reference: task.rs:28-74)."""
 
@@ -39,6 +279,25 @@ class Task:
         self.preferred_locs = preferred_locs or []
         self.pinned = pinned
         self.attempt = 0
+        # Attached by the DAG scheduler at submit_missing_tasks time;
+        # deliberately NOT pickled (legacy envelopes ship the lineage
+        # inline via the rdd/func fields instead).
+        self.stage_binary: Optional[StageBinary] = None
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["stage_binary"] = None
+        return state
+
+    def header(self) -> TaskHeader:
+        binary = self.stage_binary
+        return TaskHeader(
+            task_id=self.task_id, stage_id=self.stage_id,
+            partition=self.partition, split=self.split, attempt=self.attempt,
+            binary_sha=binary.sha if binary is not None else "",
+            kind=binary.kind if binary is not None else "",
+            output_id=getattr(self, "output_id", None),
+        )
 
     def run(self) -> Any:
         raise NotImplementedError
@@ -92,3 +351,7 @@ class TaskEndEvent:
     result: Any = None
     error: Optional[BaseException] = None
     duration_s: float = 0.0
+    # Dispatch-plane accounting (distributed backend): header/binary/result
+    # bytes, ships, cache hits — aggregated by MetricsListener into the
+    # `dispatch` summary section. None for backends that don't measure.
+    dispatch: Optional[dict] = None

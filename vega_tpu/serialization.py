@@ -61,15 +61,35 @@ def loads_oob(header: bytes, buffers):
 _FRAME = struct.Struct("<Q")
 
 
-def write_frame(stream: io.RawIOBase, payload: bytes) -> None:
+def write_frame(stream: io.RawIOBase, payload) -> None:
     stream.write(_FRAME.pack(len(payload)))
     stream.write(payload)
 
 
-def read_frame(stream: io.RawIOBase) -> bytes:
+def frame_bytes(payload: bytes) -> bytes:
+    """One frame as bytes, for callers that coalesce several frames into
+    a single socket write (the task_v2 dispatch hot path)."""
+    return _FRAME.pack(len(payload)) + payload
+
+
+def frame_prefix(n: int) -> bytes:
+    """Just the length prefix, for coalescing a frame header with earlier
+    frames while sending a large payload in its own write (no join copy)."""
+    return _FRAME.pack(n)
+
+
+def read_frame_len(stream: io.RawIOBase) -> int:
+    """Read just the 8-byte length prefix. Callers that want the payload
+    landed somewhere other than a fresh bytes object (protocol.recv_buffer
+    reads straight into a writable bytearray for the zero-copy out-of-band
+    result path) split the frame read here."""
     head = _read_exact(stream, _FRAME.size)
     (n,) = _FRAME.unpack(head)
-    return _read_exact(stream, n)
+    return n
+
+
+def read_frame(stream: io.RawIOBase) -> bytes:
+    return _read_exact(stream, read_frame_len(stream))
 
 
 def _read_exact(stream, n: int) -> bytes:
