@@ -564,6 +564,12 @@ def main():
         # vs tasks_v2 gap is the dedup win (benchmarks/dispatch_ab.py
         # measures it A/B over real sockets).
         detail["dispatch"] = ctx.metrics_summary().get("dispatch", {})
+        # Straggler-plane counters (duplicates launched / which copy won /
+        # completions discarded by the first-wins dedup): all zeros unless
+        # speculation_enabled, but always reported so a bench run under
+        # the knob is attributable (benchmarks/straggler_ab.py is the
+        # dedicated A/B).
+        detail["speculation"] = ctx.metrics_summary().get("speculation", {})
         _leg_history_compare_and_append(detail)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "

@@ -1,0 +1,153 @@
+"""A/B: one 10x-slow executor, straggler plane OFF vs ON.
+
+The dominant real-world failure mode at scale is not the executor that
+dies (PR 2) but the one that is merely SLOW — it gates every stage end to
+end. arXiv:1802.03049 (PAPERS.md) prescribes redundancy on both sides:
+extra copies of outlier tasks (speculation) and map outputs a reducer can
+read from any of k sources (replicated shuffle reads). This benchmark
+injects ONE deterministic 10x-slow executor — slow to COMPUTE
+(VEGA_TPU_FAULT_SLOW_TASKS: its first task sleeps 10x the task work) and
+slow to SERVE (VEGA_TPU_FAULT_FETCH_DELAY_S on every bucket it serves) —
+into a real two-worker fleet and measures the same shuffle job three ways:
+
+  baseline      no fault, plane off      (what the job costs healthy)
+  straggler_off fault,    plane off      (the slow node gates the job)
+  straggler_on  fault,    speculation_enabled=1 + shuffle_replication=2
+                                         + fetch_slow_server_s
+
+Acceptance: straggler_on <= 2x baseline (vs many-x with the plane off),
+bit-identical results on every leg, and ZERO duplicate task completions
+on the event bus (the cancelled straggler must never double-commit).
+
+Each (leg, rep) gets a FRESH context: the fault counters are
+per-process-lifetime, so reusing a fleet would let the injection budget
+leak across legs. Legs are interleaved per repetition so host-level drift
+on this shared 1-core sandbox hits all three equally. Prints ONE JSON
+line (medians of 3).
+
+Usage:
+
+  python benchmarks/straggler_ab.py [n_map_tasks] [task_work_s]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Importing vega_tpu must never probe a (possibly wedged) TPU backend:
+# force the CPU mesh first, like every benchmark here.
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)
+
+REPS = 3
+SLOW_MULT = 10.0       # the injected straggler: 10x the task work
+FETCH_DELAY_S = 1.0    # serve-side slowness per bucket on the slow node
+REDUCE_WORK_S = 0.8    # real reduce-side work (the straggler gates BOTH
+#                        stages: compute on the map side, serving on the
+#                        reduce side — the bound is against the whole job)
+
+FAULT_VARS = ("VEGA_TPU_FAULT_SLOW_TASKS", "VEGA_TPU_FAULT_SLOW_TASK_S",
+              "VEGA_TPU_FAULT_EXECUTOR", "VEGA_TPU_FAULT_FETCH_DELAY_S")
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+def _clear_fault_env():
+    for name in FAULT_VARS:
+        os.environ.pop(name, None)
+
+
+def main():
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    work_s = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+
+    import vega_tpu as v
+    from vega_tpu import faults
+
+    expected = None
+
+    def one_rep(faulted: bool, plane_on: bool):
+        nonlocal expected
+        _clear_fault_env()
+        if faulted:
+            os.environ["VEGA_TPU_FAULT_SLOW_TASKS"] = "1"
+            os.environ["VEGA_TPU_FAULT_SLOW_TASK_S"] = str(
+                SLOW_MULT * work_s)
+            os.environ["VEGA_TPU_FAULT_EXECUTOR"] = "exec-0"
+            os.environ["VEGA_TPU_FAULT_FETCH_DELAY_S"] = str(FETCH_DELAY_S)
+        faults.reset()
+        kw = {}
+        if plane_on:
+            kw = dict(speculation_enabled=True, speculation_min_s=0.3,
+                      speculation_multiplier=1.2, shuffle_replication=2,
+                      fetch_slow_server_s=0.5)
+        ctx = v.Context("distributed", num_workers=2, **kw)
+        try:
+            pairs = (ctx.parallelize(list(range(n_tasks * 8)), n_tasks)
+                     .map_partitions(lambda it, _w=work_s:
+                                     (time.sleep(_w), it)[1])
+                     .map(lambda x: (x % 4, x)))
+            reduced = (pairs.reduce_by_key(lambda a, b: a + b, 4)
+                       .map_partitions(lambda it, _w=REDUCE_WORK_S:
+                                       (time.sleep(_w), it)[1]))
+            t0 = time.time()
+            got = dict(reduced.collect())
+            wall = time.time() - t0
+            if expected is None:
+                expected = got
+            assert got == expected, "legs disagree on results"
+            spec = dict(ctx.metrics_summary()["speculation"])
+            return wall, spec
+        finally:
+            ctx.stop()
+            _clear_fault_env()
+            faults.reset()
+
+    # Warm the worker-spawn/import path once before timing.
+    one_rep(faulted=False, plane_on=False)
+
+    walls = {"baseline": [], "straggler_off": [], "straggler_on": []}
+    on_spec = {"launched": 0, "won": 0, "lost": 0,
+               "duplicate_completions": 0}
+    for _ in range(REPS):
+        w, _ = one_rep(faulted=False, plane_on=False)
+        walls["baseline"].append(w)
+        w, _ = one_rep(faulted=True, plane_on=False)
+        walls["straggler_off"].append(w)
+        w, spec = one_rep(faulted=True, plane_on=True)
+        walls["straggler_on"].append(w)
+        for k in on_spec:
+            on_spec[k] += spec.get(k, 0)
+
+    base = median(walls["baseline"])
+    off = median(walls["straggler_off"])
+    on = median(walls["straggler_on"])
+    print(json.dumps({
+        "metric": "shuffle-job wall with one injected 10x-slow executor "
+                  "(compute + serve), straggler plane off vs "
+                  "speculation+replicated-reads on (two real worker "
+                  "processes; medians of 3, legs interleaved per rep)",
+        "map_tasks": n_tasks,
+        "task_work_s": work_s,
+        "slow_mult": SLOW_MULT,
+        "baseline_s": round(base, 3),
+        "straggler_off_s": round(off, 3),
+        "straggler_on_s": round(on, 3),
+        "off_vs_baseline": round(off / base, 2) if base else None,
+        "on_vs_baseline": round(on / base, 2) if base else None,
+        "bounded_2x": bool(base and on <= 2.0 * base),
+        "speculation": on_spec,
+        "duplicate_completions": on_spec["duplicate_completions"],
+        "results_identical": True,  # asserted every rep
+    }))
+
+
+if __name__ == "__main__":
+    main()

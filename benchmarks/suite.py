@@ -304,6 +304,7 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
 
         fetch_before = ctx.metrics_summary().get("fetch", {})
         dispatch_before = ctx.metrics_summary().get("dispatch", {})
+        spec_before = ctx.metrics_summary().get("speculation", {})
         rows, host_s, dev_s = fn(ctx, scale, bank)
         rec = {
             "config": c,
@@ -322,6 +323,11 @@ def run_configs(ctx, scale=1.0, configs=(1, 2, 3, 4, 5, 6), emit=print):
             # shipped vs cache hits and driver-serialized bytes per leg.
             "dispatch": _fetch_delta(
                 dispatch_before, ctx.metrics_summary().get("dispatch", {})),
+            # Straggler-plane delta (zeros with speculation off — present
+            # so a suite run under the knob attributes duplicate launches
+            # and first-wins discards per leg).
+            "speculation": _fetch_delta(
+                spec_before, ctx.metrics_summary().get("speculation", {})),
         }
         emit(json.dumps(rec))
         results.append(rec)

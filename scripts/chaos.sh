@@ -7,3 +7,8 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
   -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+
+# Straggler plane A/B (PR 6): one injected 10x-slow executor, plane off vs
+# speculation + replicated shuffle reads on. One JSON line; the acceptance
+# bound (straggler_on <= 2x baseline) rides the "bounded_2x" field.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/straggler_ab.py

@@ -455,7 +455,7 @@ def test_resolve_timeout_escalates_as_fetch_failed(ctx):
     original = env.map_output_tracker
 
     class StuckTracker:
-        def get_server_uris(self, shuffle_id, timeout=60.0):
+        def get_server_uri_lists(self, shuffle_id, timeout=60.0):
             raise MapOutputError("timed out waiting for map outputs")
 
     env.map_output_tracker = StuckTracker()
@@ -483,7 +483,128 @@ def test_unregister_server_outputs_bulk_invalidation():
     assert t.generation == gen + 1
     assert not t.has_outputs(0)
     assert not t.has_outputs(1)
-    # survivors untouched
-    assert t._outputs[0][1] == "b:1"
+    # survivors untouched (location LISTS since shuffle_replication)
+    assert t._outputs[0][1] == ["b:1"]
     assert t.unregister_server_outputs("nope:9") == 0
     assert t.generation == gen + 1  # no spurious bump
+
+
+# ---------------------------------------------------------------- PR 6:
+# straggler mitigation — speculative tasks (first result wins) and the
+# deterministic slow-task injection that makes them testable.
+
+
+def test_slow_task_fault_deterministic_and_cancel_aware():
+    """VEGA_TPU_FAULT_SLOW_TASKS: counter-based (exactly N tasks slowed,
+    like the kill/hang hooks), bounded (unlike hang, the task finishes),
+    and a driver-side cancel interrupts the sleep mid-injection."""
+    import threading
+
+    from vega_tpu.errors import TaskCancelledError
+
+    inj = faults.configure(slow_tasks=2, slow_task_s=0.05)
+    t0 = time.monotonic()
+    inj.maybe_slow_task()
+    inj.maybe_slow_task()
+    slowed = time.monotonic() - t0
+    assert slowed >= 0.1  # both injections slept
+    t0 = time.monotonic()
+    inj.maybe_slow_task()  # budget spent: a no-op now
+    assert time.monotonic() - t0 < 0.05
+
+    inj = faults.configure(slow_tasks=1, slow_task_s=30.0)
+    cancel = threading.Event()
+    timer = threading.Timer(0.1, cancel.set)
+    timer.start()
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        inj.maybe_slow_task(cancel)  # driver cancel lands mid-sleep
+    assert time.monotonic() - t0 < 5.0
+    timer.cancel()
+
+
+def test_speculative_copy_wins_and_straggler_cancelled(monkeypatch, tmp_path):
+    """(a) The speculative duplicate WINS: one executor's task is slowed
+    10x (deterministic fault); the duplicate on the healthy executor
+    commits first, the straggler is cancelled mid-sleep, results are
+    bit-identical to a fault-free run, and the event bus accounts the
+    partition exactly once (zero duplicate completions)."""
+    expected = sorted(x * 3 for x in range(64))
+
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_SLOW_TASKS", "1")
+    monkeypatch.setenv("VEGA_TPU_FAULT_SLOW_TASK_S", "8.0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(speculation_enabled=True,
+                         speculation_min_s=0.3,
+                         speculation_multiplier=2.0)
+    try:
+        t0 = time.time()
+        got = sorted(
+            ctx.parallelize(list(range(64)), 8).map(lambda x: x * 3)
+            .collect())
+        elapsed = time.time() - t0
+        assert got == expected  # bit-identical despite the straggler
+        assert elapsed < 6.0, (
+            f"speculation did not rescue the slowed executor "
+            f"({elapsed:.1f}s vs the 8s injected sleep)")
+        slowed = [s for s in faults.read_stats(stats_dir)
+                  if s["fault"] == "slow_task"]
+        assert slowed, "the slow-task injection never fired"
+        summary = ctx.metrics_summary()
+        spec = summary["speculation"]
+        assert spec["launched"] >= 1
+        assert spec["won"] >= 1  # the duplicate committed first
+        # Exactly-once: the cancelled straggler never double-commits.
+        assert spec["duplicate_completions"] == 0
+    finally:
+        ctx.stop()
+
+
+def test_original_wins_and_cancel_races_completion(monkeypatch):
+    """(b) The ORIGINAL wins and the cancel RACES the duplicate's
+    completion: both attempts of the straggling partition sleep the same
+    wall (the duplicate starts later, so the original always commits
+    first); the cancel cannot interrupt user code mid-sleep, so the
+    duplicate completes anyway — and must be discarded by the
+    (stage_id, partition) dedup, visible as duplicate_completions on the
+    bus, with bit-identical results and a sane tracker afterwards."""
+    ctx = _chaos_context(speculation_enabled=True,
+                         speculation_min_s=0.3,
+                         speculation_multiplier=2.0)
+    try:
+        def straggle(idx, it):
+            if idx == 3:
+                time.sleep(1.2)  # BOTH attempts sleep: original wins
+            return it
+
+        pairs = (ctx.parallelize(list(range(40)), 4)
+                 .map_partitions_with_index(straggle)
+                 .map(lambda x: (x % 4, 1)))
+
+        def slow_reduce(idx, it):
+            time.sleep(1.0)  # keep the job alive past the loser's finish
+            return it
+
+        got = dict(pairs.reduce_by_key(lambda a, b: a + b, 4)
+                   .map_partitions_with_index(slow_reduce).collect())
+        assert got == {0: 10, 1: 10, 2: 10, 3: 10}
+        deadline = time.time() + 10.0
+        spec = ctx.metrics_summary()["speculation"]
+        while (spec["launched"] and not spec["lost"]
+               and time.time() < deadline):
+            time.sleep(0.2)  # listener bus drains asynchronously
+            spec = ctx.metrics_summary()["speculation"]
+        assert spec["launched"] >= 1, "no duplicate was ever launched"
+        assert spec["lost"] >= 1  # the original committed first
+        assert spec["won"] == 0
+        # The losing duplicate completed after the commit and was
+        # discarded — exactly-once accounting, not a double commit.
+        assert spec["duplicate_completions"] >= 1
+        # A second job over the same shuffle: tracker/output_locs sane.
+        assert dict(pairs.reduce_by_key(lambda a, b: a + b, 4)
+                    .collect()) == got
+    finally:
+        ctx.stop()

@@ -341,3 +341,164 @@ def test_fetch_stream_concurrent_reducers(tmp_path):
         env.map_output_tracker, env.shuffle_server = old
         server.stop()
         store.close()
+
+
+# ---------------------------------------------------------------- PR 6:
+# replicated shuffle reads — ordered location lists, replica push, and
+# mid-stream failover (data-side redundancy of arXiv:1802.03049).
+
+def _dead_uri() -> str:
+    """A URI nothing listens on (bound then closed: connect refuses)."""
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def test_tracker_keeps_ordered_location_lists():
+    """MapOutputTracker generalizes one-URI-per-map to an ordered list:
+    primaries keep the old contract, replicas keep an output AVAILABLE
+    through the loss of any one copy."""
+    from vega_tpu.errors import MapOutputError
+    from vega_tpu.map_output_tracker import MapOutputTracker
+
+    t = MapOutputTracker()
+    t.register_shuffle(7, 3)
+    t.register_map_outputs(
+        7, [["a:1", "b:1"], "b:1", ["c:1", "a:1"]])
+    assert t.get_server_uris(7, timeout=1) == ["a:1", "b:1", "c:1"]
+    assert t.get_server_uri_lists(7, timeout=1) == [
+        ["a:1", "b:1"], ["b:1"], ["c:1", "a:1"]]
+    gen0 = t.generation
+
+    # Losing ONE replica neither blocks reducers nor hides the output.
+    t.unregister_map_output(7, 0, "a:1")
+    assert t.generation > gen0
+    assert t.has_outputs(7)
+    assert t.get_server_uris(7, timeout=1)[0] == "b:1"
+
+    # Bulk server loss drops that server everywhere; outputs with a
+    # surviving copy stay available, fully-lost ones block.
+    t.unregister_server_outputs("b:1")
+    assert not t.has_outputs(7)  # map 0 and 1 both lost their last copy
+    with pytest.raises(MapOutputError):
+        t.get_server_uris(7, timeout=0.1)
+
+
+def test_put_many_replica_push_roundtrip(tmp_path):
+    """push_buckets_remote lands a map task's full bucket row in a PEER
+    store in one round trip, keyed and served like local writes."""
+    from vega_tpu.distributed.shuffle_server import push_buckets_remote
+
+    store = ShuffleStore(spill_dir=str(tmp_path / "spill"))
+    server = ShuffleServer(store)
+    try:
+        row = [bytes([r]) * (64 + r) for r in range(5)]
+        push_buckets_remote(server.uri, 3, 2, row)
+        for r, blob in enumerate(row):
+            assert fetch_remote(server.uri, 3, 2, r) == blob
+    finally:
+        server.stop()
+        store.close()
+
+
+def _register_lists(tracker_lists, shuffle_id=0):
+    """Point the process Env's tracker at explicit location lists."""
+    from vega_tpu.map_output_tracker import MapOutputTracker
+
+    env = Env.get()
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(shuffle_id, len(tracker_lists))
+    tracker.register_map_outputs(shuffle_id, tracker_lists)
+    old = env.map_output_tracker, env.shuffle_server
+    env.map_output_tracker = tracker
+    env.shuffle_server = None
+    return old
+
+
+def test_fetch_stream_fails_over_to_replica_mid_stream(tmp_path):
+    """A dead primary's buckets are re-requested from their replica
+    locations MID-STREAM: every bucket arrives exactly once, no stage
+    resubmission machinery involved, and the failover is counted."""
+    store = ShuffleStore(spill_dir=str(tmp_path / "spill"))
+    n = 16
+    blobs = {m: bytes([m % 251]) * (256 + m) for m in range(n)}
+    for m, data in blobs.items():
+        store.put(0, m, 0, data)  # the replica server holds EVERY bucket
+    server = ShuffleServer(store)
+    dead = _dead_uri()
+    # Maps 0-7: dead primary, live replica. Maps 8-15: live primary.
+    lists = [[dead, server.uri] if m < 8 else [server.uri]
+             for m in range(n)]
+    env = Env.get()
+    old = _register_lists(lists)
+    old_retries = env.conf.fetch_retries
+    env.conf.fetch_retries = 1  # dead primary escalates on first refusal
+    try:
+        got = list(ShuffleFetcher.fetch_stream(0, 0))
+        assert sorted(got) == sorted(blobs.values())
+        assert len(got) == n  # exactly once each
+        stats = fetcher_mod.stats_snapshot()
+        assert stats["failovers"] >= 1
+        assert stats["failover_buckets"] == 8
+        assert stats["duplicates"] == 0
+    finally:
+        env.conf.fetch_retries = old_retries
+        env.map_output_tracker, env.shuffle_server = old
+        server.stop()
+        store.close()
+
+
+def test_fetch_slow_server_deadline_fails_over(tmp_path):
+    """fetch_slow_server_s: a server that accepts but never answers is
+    abandoned after the deadline — NOT the 120s socket timeout — and its
+    buckets come from the replica; unreplicated buckets keep the patient
+    path (the deadline only arms when failover is possible)."""
+    import socket as _socket
+
+    store = ShuffleStore(spill_dir=str(tmp_path / "spill"))
+    n = 8
+    blobs = {m: bytes([m % 251]) * 128 for m in range(n)}
+    for m, data in blobs.items():
+        store.put(0, m, 0, data)
+    server = ShuffleServer(store)
+
+    # A black hole: accepts connections, never replies.
+    hole = _socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(8)
+    hole_uri = f"127.0.0.1:{hole.getsockname()[1]}"
+
+    lists = [[hole_uri, server.uri] if m < 4 else [server.uri]
+             for m in range(n)]
+    env = Env.get()
+    old = _register_lists(lists)
+    old_slow = env.conf.fetch_slow_server_s
+    old_batched = env.conf.fetch_batch_enabled
+    env.conf.fetch_slow_server_s = 0.5
+    # The deadline arms only on the batched get_many path (the unbatched
+    # leg keeps the patient fetch_retries behavior); pin the knob in case
+    # an earlier test's context left the legacy leg enabled.
+    env.conf.fetch_batch_enabled = True
+    try:
+        import time as _time
+
+        t0 = _time.monotonic()
+        got = list(ShuffleFetcher.fetch_stream(0, 0))
+        wall = _time.monotonic() - t0
+        assert sorted(got) == sorted(blobs.values())
+        assert len(got) == n
+        assert wall < 20.0, f"slow-server deadline never fired ({wall:.1f}s)"
+        stats = fetcher_mod.stats_snapshot()
+        assert stats["failovers"] >= 1
+        assert stats["failover_buckets"] == 4
+    finally:
+        env.conf.fetch_slow_server_s = old_slow
+        env.conf.fetch_batch_enabled = old_batched
+        env.map_output_tracker, env.shuffle_server = old
+        server.stop()
+        store.close()
+        hole.close()

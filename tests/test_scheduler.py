@@ -361,7 +361,7 @@ def test_speculative_execution():
     """A straggling task gets a speculative duplicate; the job finishes on
     the duplicate's result long before the straggler would have
     (opt-in straggler mitigation; the reference has none)."""
-    context = v.Context("local", num_workers=4, speculation=True,
+    context = v.Context("local", num_workers=4, speculation_enabled=True,
                         speculation_min_s=0.3, speculation_multiplier=2.0)
     try:
         first_run = {}
@@ -390,7 +390,7 @@ def test_speculative_execution():
 def test_speculation_duplicate_completion_on_shuffle_stage():
     """Both copies of a speculated ShuffleMapTask complete inside the job;
     the duplicate completion must not double-register the stage or abort."""
-    context = v.Context("local", num_workers=4, speculation=True,
+    context = v.Context("local", num_workers=4, speculation_enabled=True,
                         speculation_min_s=0.2, speculation_multiplier=2.0)
     try:
         runs = {}
@@ -439,3 +439,131 @@ def test_session_log_file(tmp_path):
     ctx2.stop()
     remaining = glob.glob(str(tmp_path / "session-*" / "driver.log"))
     assert len(remaining) == 1  # only the first (uncleaned) session's log
+
+
+def test_speculative_failure_does_not_burn_max_failures():
+    """A FAILED speculative duplicate must not count against the stage's
+    max_failures budget while the original is still running: with
+    max_failures=1 a counted failure would abort the job instantly, so a
+    passing job proves the duplicate's crash was absorbed."""
+    context = v.Context("local", num_workers=4, speculation_enabled=True,
+                        speculation_min_s=0.3, speculation_multiplier=2.0,
+                        max_failures=1)
+    try:
+        runs = {}
+        lock = threading.Lock()
+
+        def straggle_then_crash(idx, it):
+            with lock:
+                calls = runs.get(idx, 0)
+                runs[idx] = calls + 1
+            if idx == 3:
+                if calls == 0:
+                    time.sleep(3.0)  # original straggles (stays running)
+                else:
+                    raise RuntimeError("speculative duplicate crashes")
+            return it
+
+        rdd = context.make_rdd(list(range(40)), 4).map_partitions_with_index(
+            straggle_then_crash
+        )
+        assert sorted(rdd.collect()) == list(range(40))
+        assert runs[3] >= 2, "the duplicate never launched"
+        summary = context.metrics_summary()
+        assert summary["speculation"]["launched"] >= 1
+        # The original committed the partition (the duplicate crashed).
+        assert summary["speculation"]["lost"] >= 1
+    finally:
+        context.stop()
+
+
+def test_pick_executor_speculation_rules():
+    """Speculative duplicates are strict about placement: never the
+    straggler's own executor, never a blacklisted one — with no eligible
+    target the launch is skipped (raises), never relaxed. Ordinary tasks
+    keep the advisory blacklist (flaky beats none)."""
+    from types import SimpleNamespace
+
+    from vega_tpu.distributed.backend import DistributedBackend, _Executor
+    from vega_tpu.env import Configuration
+    from vega_tpu.errors import NetworkError
+    from vega_tpu.lint.sync_witness import named_lock
+
+    backend = DistributedBackend.__new__(DistributedBackend)
+    backend.conf = Configuration()
+    backend._lock = named_lock("test.pick_executor")
+    import itertools
+
+    backend._rr = itertools.count(0)
+    e0 = _Executor("exec-0", "127.0.0.1:1", "127.0.0.1")
+    e1 = _Executor("exec-1", "127.0.0.1:2", "127.0.0.1")
+    backend._executors = {"exec-0": e0, "exec-1": e1}
+
+    def task(speculative=False, exclude=()):
+        return SimpleNamespace(speculative=speculative,
+                               exclude_executors=frozenset(exclude),
+                               pinned=False, preferred_locs=[])
+
+    # A duplicate excluding the straggler's executor always lands on the
+    # other one.
+    for _ in range(4):
+        chosen = backend._pick_executor(task(True, {"exec-0"}))
+        assert chosen.executor_id == "exec-1"
+
+    # Blacklisted survivor: the speculative launch is SKIPPED (raises)...
+    e1.failures = backend.conf.executor_blacklist_threshold
+    with pytest.raises(NetworkError):
+        backend._pick_executor(task(True, {"exec-0"}))
+    # ...while an ordinary task still runs somewhere (advisory blacklist).
+    assert backend._pick_executor(task()) is not None
+
+    # Everything excluded: skip, never "relax" onto the straggler.
+    e1.alive = False
+    with pytest.raises(NetworkError):
+        backend._pick_executor(task(True, {"exec-0"}))
+
+
+def test_task_duration_excludes_dispatch_latency():
+    """TaskEnd.duration_s must be execution wall measured where the task
+    ran — NOT dispatch latency. A lineage whose pickle is artificially
+    slow inflates the job wall but must leave per-task durations honest
+    (speculation's outlier detection reads them)."""
+    from vega_tpu.scheduler import events as ev
+
+    class SlowPickle:
+        def __getstate__(self):
+            time.sleep(0.4)  # serialization cost = dispatch latency
+            return {}
+
+    captured = []
+
+    class Capture(ev.Listener):
+        def on_event(self, event):
+            if isinstance(event, ev.TaskEnd) and event.success:
+                captured.append(event.duration_s)
+
+    context = v.Context("local", num_workers=2,
+                        serialize_tasks_locally=True)
+    try:
+        context.bus.add_listener(Capture())
+        heavy = SlowPickle()
+
+        def work(x, _h=heavy):
+            time.sleep(0.02)
+            return x
+
+        t0 = time.time()
+        assert context.parallelize([1, 2, 3, 4], 2).map(work).collect() \
+            == [1, 2, 3, 4]
+        wall = time.time() - t0
+        deadline = time.time() + 5.0
+        while len(captured) < 2 and time.time() < deadline:
+            time.sleep(0.05)  # the listener bus drains asynchronously
+        assert len(captured) >= 2
+        # The slow pickle really happened (once per stage, driver-side)...
+        assert wall >= 0.4, f"slow pickle never fired ({wall:.2f}s)"
+        # ...but no task's measured duration includes it.
+        assert max(captured) < 0.35, (
+            f"duration_s contains dispatch latency: {captured}")
+    finally:
+        context.stop()

@@ -28,6 +28,36 @@ log = logging.getLogger("vega_tpu")
 NATIVE_MAGIC = b"VN01"
 NATIVE_GROUP_MAGIC = b"VG01"
 
+# Replica-peer discovery cache (shuffle_replication > 1): the live-peer
+# map is fleet-level state, not per-task — a 64-task map stage must not
+# pay 64 driver round trips for it. Keyed on the tracker object so a new
+# Context in the same process never reads a dead fleet's peers; a short
+# TTL (plus invalidation on any push failure) keeps respawn staleness
+# bounded, and staleness is benign anyway — a failed push just degrades
+# to fewer replicas. Races on the cache dict are harmless (worst case:
+# two threads both refresh).
+_PEER_CACHE_TTL_S = 5.0
+_peer_cache: dict = {"tracker": None, "peers": None, "expires": 0.0}
+
+
+def _live_shuffle_peers(tracker) -> List[str]:
+    """All live executors' shuffle-server URIs (self included; callers
+    filter), via `list_shuffle_peers` — cached per process."""
+    import time
+
+    now = time.monotonic()
+    if (_peer_cache["tracker"] is tracker
+            and now < _peer_cache["expires"]):
+        return _peer_cache["peers"]
+    peers = [u for u in tracker.list_shuffle_peers().values() if u]
+    _peer_cache.update(tracker=tracker, peers=peers,
+                       expires=now + _PEER_CACHE_TTL_S)
+    return peers
+
+
+def _invalidate_peer_cache() -> None:
+    _peer_cache["expires"] = 0.0
+
 _SENTINEL = object()
 
 
@@ -171,13 +201,13 @@ class ShuffleDependency(Dependency):
                     if result is not None:
                         blobs, all_int = result
                         flag = b"\x01" if all_int else b"\x00"
-                        for reduce_id, blob in enumerate(blobs):
+                        row = [magic + flag + blob for blob in blobs]
+                        for reduce_id, blob in enumerate(row):
                             env.shuffle_store.put(
                                 self.shuffle_id, split.index, reduce_id,
-                                magic + flag + blob,
+                                blob,
                             )
-                        return (env.shuffle_server.uri
-                                if env.shuffle_server else "local")
+                        return self._publish(env, split.index, row)
                     # mixed-type stream or int64 overflow: exact redo
                     source = self.rdd.iterator(split, task_context)
                 else:
@@ -197,12 +227,65 @@ class ShuffleDependency(Dependency):
             else:
                 bucket[k] = create(v)
 
-        for reduce_id, bucket in enumerate(buckets):
-            env.shuffle_store.put(
-                self.shuffle_id,
-                split.index,
-                reduce_id,
-                serialization.dumps(list(bucket.items())),
-            )
-        server_uri = env.shuffle_server.uri if env.shuffle_server else "local"
-        return server_uri
+        row = [serialization.dumps(list(bucket.items()))
+               for bucket in buckets]
+        for reduce_id, blob in enumerate(row):
+            env.shuffle_store.put(self.shuffle_id, split.index, reduce_id,
+                                  blob)
+        return self._publish(env, split.index, row)
+
+    def _publish(self, env, map_id: int, row: List[bytes]):
+        """Locally-stored bucket row -> this output's location(s).
+
+        With `shuffle_replication` <= 1 (or no shuffle server to replicate
+        between: local mode) this is the pre-replication contract — the
+        single server URI. Otherwise the full row is ALSO pushed to up to
+        k-1 live peer executors' stores (ONE `put_many` round trip each,
+        rotated by map_id so replicas spread across the fleet) and the
+        ordered [primary, replica, ...] list is returned: the data-side
+        redundancy of arXiv:1802.03049 — a reducer can be satisfied by any
+        surviving/responsive copy instead of the one server that happens
+        to be slow or dead. A failed push degrades to fewer replicas,
+        never fails the map task (the primary is already durable)."""
+        primary = env.shuffle_server.uri if env.shuffle_server else "local"
+        k = int(getattr(env.conf, "shuffle_replication", 1) or 1)
+        if k <= 1 or env.shuffle_server is None:
+            return primary
+        peers_fn = getattr(env.map_output_tracker, "list_shuffle_peers", None)
+        if peers_fn is None:
+            return primary
+        from vega_tpu.distributed.shuffle_server import push_buckets_remote
+        from vega_tpu.errors import NetworkError
+
+        try:
+            # Sorted for a stable rotation; self excluded (the primary
+            # copy already lives here). Cached per process: the peer map
+            # is per-fleet, not per-task.
+            peers = sorted(
+                u for u in _live_shuffle_peers(env.map_output_tracker)
+                if u != primary)
+        except NetworkError as e:
+            log.warning("replica peer discovery failed (%s); shipping "
+                        "primary-only map output", e)
+            return primary
+        locs = [primary]
+        for i in range(len(peers)):
+            if len(locs) >= k:
+                break
+            uri = peers[(map_id + i) % len(peers)]
+            if uri in locs:
+                continue
+            try:
+                push_buckets_remote(uri, self.shuffle_id, map_id, row)
+            except NetworkError as e:
+                log.warning("replica push of shuffle %d map %d to %s "
+                            "failed (%s); continuing with %d cop%s",
+                            self.shuffle_id, map_id, uri, e, len(locs),
+                            "y" if len(locs) == 1 else "ies")
+                # The cached peer map just proved stale (dead peer):
+                # re-discover on the next task instead of riding out
+                # the TTL against a shrunken fleet.
+                _invalidate_peer_cache()
+                continue
+            locs.append(uri)
+        return locs if len(locs) > 1 else primary

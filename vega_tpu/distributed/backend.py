@@ -102,6 +102,10 @@ class DistributedBackend(TaskBackend):
         # the chaos suite drives exactly that staleness.
         self._known_hashes: Dict[str, Set[str]] = {}
         self._rr = itertools.count(0)
+        # task_id -> executor_id currently running it (set per dispatch
+        # attempt, dropped when the dispatch thread finishes): the target
+        # map for cancel_task — the losing copy of a speculated pair.
+        self._running_on: Dict[int, str] = {}
         self._lock = named_lock("distributed.backend.DistributedBackend._lock")
         self._stopped = False
         self._stop_event = threading.Event()
@@ -168,6 +172,12 @@ class DistributedBackend(TaskBackend):
                     "1" if self.conf.task_binary_dedup else "0"),
                 VEGA_TPU_TASK_BINARY_CACHE_ENTRIES=str(
                     self.conf.task_binary_cache_entries),
+                # Straggler plane: map tasks replicate buckets, reduce
+                # tasks fail slow/dead servers over to the replicas.
+                VEGA_TPU_SHUFFLE_REPLICATION=str(
+                    self.conf.shuffle_replication),
+                VEGA_TPU_FETCH_SLOW_SERVER_S=str(
+                    self.conf.fetch_slow_server_s),
                 # Respawned incarnations disarm one-shot fault injections
                 # (faults.py): a chaos-killed slot comes back healthy.
                 VEGA_TPU_FAULT_INCARNATION=str(incarnation),
@@ -196,6 +206,8 @@ class DistributedBackend(TaskBackend):
             + ("1" if self.conf.task_binary_dedup else "0"),
             "VEGA_TPU_TASK_BINARY_CACHE_ENTRIES="
             + str(self.conf.task_binary_cache_entries),
+            f"VEGA_TPU_SHUFFLE_REPLICATION={self.conf.shuffle_replication}",
+            f"VEGA_TPU_FETCH_SLOW_SERVER_S={self.conf.fetch_slow_server_s}",
             f"VEGA_TPU_FAULT_INCARNATION={incarnation}",
             sys.executable, "-m",
             "vega_tpu.distributed.worker",
@@ -460,15 +472,38 @@ class DistributedBackend(TaskBackend):
     def _pick_executor(self, task: Task) -> _Executor:
         """Round-robin + pinned-host seek
         (reference: distributed_scheduler.rs:447-469), skipping blacklisted
-        repeat offenders while any clean executor is alive."""
+        repeat offenders while any clean executor is alive.
+
+        Speculative duplicates are stricter on BOTH counts: they must land
+        on a different executor than the straggling original
+        (task.exclude_executors) and must never target a blacklisted
+        executor — a duplicate stacked on a struggling node mitigates
+        nothing, so with no eligible executor the launch is skipped
+        (raises; the DAG ignores the failure since the original still
+        runs) rather than relaxed."""
+        speculative = bool(getattr(task, "speculative", False))
+        exclude = getattr(task, "exclude_executors", None) or ()
         with self._lock:
             alive = [e for e in self._executors.values() if e.alive]
             if not alive:
                 raise NetworkError("no live executors")
             threshold = self.conf.executor_blacklist_threshold
-            clean = [e for e in alive if e.failures < threshold]
-            if clean:
-                alive = clean  # blacklist is advisory: better flaky than none
+            if exclude:
+                eligible = [e for e in alive
+                            if e.executor_id not in exclude]
+                if eligible or speculative:
+                    alive = eligible  # advisory for ordinary retries only
+            if speculative:
+                alive = [e for e in alive if e.failures < threshold]
+                if not alive:
+                    raise NetworkError(
+                        "no eligible executor for speculative attempt "
+                        f"(excluded={set(exclude) or '{}'})"
+                    )
+            else:
+                clean = [e for e in alive if e.failures < threshold]
+                if clean:
+                    alive = clean  # blacklist advisory: better flaky than none
             if task.pinned and task.preferred_locs:
                 for e in alive:
                     if e.host in task.preferred_locs or \
@@ -486,6 +521,30 @@ class DistributedBackend(TaskBackend):
         # submit_missing_tasks time (off the per-task path); the legacy
         # leg pickles whole tasks below and never touches it.
         return bool(self.conf.task_binary_dedup)
+
+    def cancel_task(self, task_id: int) -> None:
+        """Best-effort cancel of a running attempt (the losing copy of a
+        speculated pair): one `cancel_task` message to the executor that
+        holds it, fired from a throwaway thread so the DAG event loop
+        never blocks on a wedged worker's connect timeout. Correctness
+        never depends on delivery — completions are deduped driver-side."""
+        with self._lock:
+            executor_id = self._running_on.get(task_id)
+            ex = self._executors.get(executor_id) if executor_id else None
+        if ex is None or not ex.alive:
+            return
+
+        def _send(uri=ex.task_uri):
+            try:
+                host, port = protocol.parse_uri(uri)
+                with protocol.connect(host, port, timeout=5.0) as sock:
+                    protocol.send_msg(sock, "cancel_task", task_id)
+                    protocol.recv_msg(sock)
+            except NetworkError:
+                pass  # loser keeps running; its completion is ignored
+
+        threading.Thread(target=_send, daemon=True,
+                         name=f"cancel-{task_id}").start()
 
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         binary = task.stage_binary
@@ -519,6 +578,9 @@ class DistributedBackend(TaskBackend):
                 log.exception("dispatch for %s failed", task)
                 callback(TaskEndEvent(task=task, success=False, error=exc,
                                       dispatch=stats))
+            finally:
+                with self._lock:
+                    self._running_on.pop(task.task_id, None)
 
         def _send_task(sock: socket.socket, executor: _Executor) -> None:
             if not dedup:
@@ -602,6 +664,14 @@ class DistributedBackend(TaskBackend):
                 try:
                     executor = self._pick_executor(task)
                 except NetworkError as e:
+                    if task.speculative:
+                        # A duplicate with nowhere eligible to run is a
+                        # skipped launch, not a task failure worth waiting
+                        # on: the original is still running and the DAG
+                        # ignores this event while it lives.
+                        callback(TaskEndEvent(task=task, success=False,
+                                              error=e, dispatch=stats))
+                        return
                     if not self._stopped and self._respawn_possible():
                         if no_executor_deadline is None:
                             conf = self.conf
@@ -617,6 +687,13 @@ class DistributedBackend(TaskBackend):
                                           dispatch=stats))
                     return
                 no_executor_deadline = None
+                # Where this attempt runs: the speculation sweep reads
+                # dispatched_to to exclude the straggler's executor from
+                # its duplicate; cancel_task resolves task_id through
+                # _running_on to reach the right worker.
+                task.dispatched_to = executor.executor_id
+                with self._lock:
+                    self._running_on[task.task_id] = executor.executor_id
                 try:
                     host, port = protocol.parse_uri(executor.task_uri)
                     with protocol.connect(host, port) as sock:

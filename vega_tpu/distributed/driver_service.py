@@ -90,6 +90,18 @@ class DriverService:
         if msg_type == "get_server_uris":
             shuffle_id, timeout = payload
             return self.map_output_tracker.get_server_uris(shuffle_id, timeout)
+        if msg_type == "get_server_uri_lists":
+            shuffle_id, timeout = payload
+            return self.map_output_tracker.get_server_uri_lists(
+                shuffle_id, timeout)
+        if msg_type == "list_shuffle_peers":
+            # Replica placement (shuffle_replication > 1): map tasks ask
+            # which live executors can hold a copy of their buckets.
+            return {
+                wid: info["shuffle_uri"]
+                for wid, info in self.live_workers().items()
+                if info.get("shuffle_uri")
+            }
         if msg_type == "has_outputs":
             return self.map_output_tracker.has_outputs(payload)
         if msg_type == "generation":
@@ -159,6 +171,13 @@ class RemoteTrackerClient:
     # MapOutputTracker interface used by ShuffleFetcher
     def get_server_uris(self, shuffle_id: int, timeout: float = 60.0):
         return self._call("get_server_uris", (shuffle_id, timeout))
+
+    def get_server_uri_lists(self, shuffle_id: int, timeout: float = 60.0):
+        return self._call("get_server_uri_lists", (shuffle_id, timeout))
+
+    def list_shuffle_peers(self) -> dict:
+        """Live executors' shuffle-server URIs (replica targets)."""
+        return self._call("list_shuffle_peers")
 
     def has_outputs(self, shuffle_id: int) -> bool:
         return self._call("has_outputs", shuffle_id)

@@ -52,6 +52,31 @@ into a tiny per-task header plus a stage-level binary shipped once per
 
 The legacy `task` reply stays ("result", None) + one pickled frame, so
 `task_binary_dedup=0` exercises the complete old envelope end to end.
+
+Straggler-mitigation messages (PR 6; both single request/response rounds):
+
+    -> ("cancel_task", task_id)                     (driver -> worker task
+                                                     port: best-effort
+                                                     cancel of the LOSING
+                                                     copy of a speculated
+                                                     pair — flips the
+                                                     attempt's cancel
+                                                     event; completions
+                                                     are deduped driver-
+                                                     side so delivery is
+                                                     never load-bearing)
+    <- ("ok", was_running: bool)
+
+    -> ("put_many", (shuffle_id, map_id, n_buckets))
+       + n_buckets raw bytes frames in reduce_id order
+                                                    (map task -> PEER
+                                                     shuffle server:
+                                                     replica push under
+                                                     shuffle_replication
+                                                     > 1 — same keying
+                                                     and tiers as locally
+                                                     written buckets)
+    <- ("ok", n_buckets)
 """
 
 from __future__ import annotations

@@ -79,6 +79,18 @@ class _Handler(socketserver.BaseRequestHandler):
                             return
                         protocol.send_bucket(sock, map_id, data)
                     protocol.send_batch_end(sock, len(map_ids))
+                elif msg_type == "put_many":
+                    # Replica push (shuffle_replication > 1): a peer map
+                    # task stores its full bucket row here so reducers can
+                    # fail over to this server if the primary dies or
+                    # stalls. Payload frames follow in reduce_id order;
+                    # same keying, same tiers, same checksummed disk path
+                    # as locally-written buckets.
+                    shuffle_id, map_id, n_buckets = payload
+                    for reduce_id in range(n_buckets):
+                        data = protocol.recv_bytes(sock)
+                        store.put(shuffle_id, map_id, reduce_id, data)
+                    protocol.send_msg(sock, "ok", n_buckets)
                 elif msg_type == "status":
                     # Tier occupancy + spill counters (store.status());
                     # "entries" keeps the original healthcheck contract.
@@ -124,14 +136,21 @@ class ShuffleServer:
 _pool = threading.local()
 
 
-def _pooled_connection(uri: str) -> socket.socket:
+def _pooled_connection(uri: str,
+                       connect_timeout: Optional[float] = None
+                       ) -> socket.socket:
     conns = getattr(_pool, "conns", None)
     if conns is None:
         conns = _pool.conns = {}
     sock = conns.get(uri)
     if sock is None:
         host, port = protocol.parse_uri(uri)
-        sock = protocol.connect(host, port)
+        # A slow-server deadline must also bound the CONNECT: a
+        # SYN-blackholed primary (firewall drop, partition) would
+        # otherwise stall the full CONNECT_TIMEOUT before the failover
+        # logic ever saw a timeout.
+        sock = protocol.connect(
+            host, port, timeout=connect_timeout or protocol.CONNECT_TIMEOUT)
         conns[uri] = sock
     return sock
 
@@ -185,8 +204,29 @@ def fetch_remote(uri: str, shuffle_id: int, map_id: int, reduce_id: int) -> byte
     ) from last_error
 
 
+def push_buckets_remote(uri: str, shuffle_id: int, map_id: int,
+                        blobs) -> None:
+    """Replicate one map task's full bucket row to a peer's shuffle store
+    in ONE `put_many` round trip (shuffle_replication > 1). Raises
+    NetworkError on failure — the caller degrades to fewer replicas, never
+    fails the map task."""
+    clean = False
+    try:
+        sock = _pooled_connection(uri)
+        protocol.send_msg(sock, "put_many", (shuffle_id, map_id, len(blobs)))
+        for blob in blobs:
+            protocol.send_bytes(sock, blob)
+        reply_type, _ = protocol.recv_msg(sock)
+        if reply_type != "ok":
+            raise NetworkError(f"replica push refused: {reply_type!r}")
+        clean = True
+    finally:
+        if not clean:
+            _drop_connection(uri)
+
+
 def fetch_many_remote(uri: str, shuffle_id: int, map_ids, reduce_id: int,
-                      deliver) -> int:
+                      deliver, deadline_s: Optional[float] = None) -> int:
     """Batched fetch: ONE `get_many` round trip for every bucket this
     reducer needs from `uri`, with per-bucket replies streamed back and
     handed to `deliver(map_id, data)` as they come off the wire (the
@@ -198,19 +238,29 @@ def fetch_many_remote(uri: str, shuffle_id: int, map_ids, reduce_id: int,
     `deliver` are never refetched or re-merged (exactly-once per bucket).
     A "bucket_missing" reply escalates FetchFailedError immediately, same
     as the single-get "missing". Returns the number of round trips spent
-    (1 on the fault-free path, whatever M buckets it carried)."""
+    (1 on the fault-free path, whatever M buckets it carried).
+
+    `deadline_s` is the slow-server escape hatch (fetch_slow_server_s):
+    when set — the caller verified every requested bucket has a replica
+    location — the round runs under that per-IO socket deadline with NO
+    in-place retries, so an unresponsive server escalates in deadline_s
+    seconds and the stream fails its undelivered tail over to the
+    replicas instead of gating the reducer on the slowest source."""
     from vega_tpu.env import Env
 
     conf = Env.get().conf
     attempts = max(1, int(getattr(conf, "fetch_retries", 3)))
     interval = float(getattr(conf, "fetch_retry_interval_s", 0.2))
+    if deadline_s:
+        attempts = 1
     remaining = dict.fromkeys(map_ids)  # ordered set of undelivered ids
     round_trips = 0
     last_error: Optional[NetworkError] = None
     for attempt in range(attempts):
         try:
             return _get_many_round(uri, shuffle_id, remaining, reduce_id,
-                                   deliver, round_trips)
+                                   deliver, round_trips,
+                                   deadline_s=deadline_s)
         except NetworkError as e:
             _drop_connection(uri)
             last_error = e
@@ -230,16 +280,20 @@ def fetch_many_remote(uri: str, shuffle_id: int, map_ids, reduce_id: int,
 
 
 def _get_many_round(uri, shuffle_id, remaining, reduce_id, deliver,
-                    round_trips):
+                    round_trips, deadline_s=None):
     """One get_many request/stream round. Raises NetworkError for
     transient faults (caller retries the tail); anything else — a
     bucket_missing escalation, or an exception out of the caller's
     `deliver` — drops the pooled connection first, because the socket
     still holds unconsumed stream frames and the next pooled request on
-    this thread would read them as its own reply."""
+    this thread would read them as its own reply. With `deadline_s`, each
+    socket IO runs under that timeout (slow-server failover; the pooled
+    socket's normal IO_TIMEOUT is restored on clean exit)."""
     clean = False
     try:
-        sock = _pooled_connection(uri)
+        sock = _pooled_connection(uri, connect_timeout=deadline_s)
+        if deadline_s:
+            sock.settimeout(deadline_s)
         protocol.send_msg(sock, "get_many",
                           (shuffle_id, list(remaining), reduce_id))
         round_trips += 1
@@ -261,6 +315,8 @@ def _get_many_round(uri, shuffle_id, remaining, reduce_id, deliver,
                     f"unexpected get_many reply {reply_type!r}")
         if not remaining:
             clean = True
+            if deadline_s:
+                sock.settimeout(protocol.IO_TIMEOUT)
             return round_trips
         # A well-framed batch_end with buckets still undelivered means
         # the server never saw them in the request — protocol breakage,

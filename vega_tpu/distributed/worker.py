@@ -28,15 +28,30 @@ import sys
 import threading
 import time
 import traceback
+from collections import OrderedDict
+
 from vega_tpu import faults, serialization
 from vega_tpu.distributed import protocol
 from vega_tpu.distributed.driver_service import RemoteTrackerClient
 from vega_tpu.distributed.shuffle_server import ShuffleServer
 from vega_tpu.env import Configuration, DeploymentMode, Env
 from vega_tpu.errors import NetworkError
+from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.scheduler.task import TaskBinaryCache, run_from_header
 
 log = logging.getLogger("vega_tpu")
+
+
+def _pre_run_cancel_gate(cancel_event) -> None:
+    """A cancel that RACED the dispatch (the driver committed the twin
+    while this attempt was still on the wire) lands via the
+    recently-cancelled memory — don't burn the work, fail the attempt
+    crisply; the driver's (stage_id, partition) dedup expects nothing
+    from it."""
+    if cancel_event.is_set():
+        from vega_tpu.errors import TaskCancelledError
+
+        raise TaskCancelledError("attempt cancelled before it started")
 
 
 class _TaskHandler(socketserver.BaseRequestHandler):
@@ -56,6 +71,14 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         if msg_type == "ping":
             protocol.send_msg(sock, "ok", worker.executor_id)
             return
+        if msg_type == "cancel_task":
+            # Best-effort cancel of a running attempt (the losing copy of
+            # a speculated pair): flips the attempt's cancel event — the
+            # chaos slow-task sleep and the pre-run gate observe it; a
+            # task already past both simply finishes and the driver's
+            # (stage_id, partition) dedup discards the result.
+            protocol.send_msg(sock, "ok", worker.cancel_task(payload))
+            return
         if msg_type == "task_v2":
             self._handle_task_v2(sock, worker, payload)
             return
@@ -67,16 +90,27 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         # thread — not a bounded pool — matters: a reduce task can block
         # waiting for recomputed map outputs, and a bounded pool would let it
         # starve the very map task that unblocks it.
-        t0 = time.time()
         try:
             faults.get().maybe_hang_task()  # chaos: wedged-but-alive worker
             task = serialization.loads(payload)
-            result = task.run()
+            cancel_event = worker.begin_task(task.task_id)
+            try:
+                _pre_run_cancel_gate(cancel_event)
+                # Execution wall starts HERE — after the envelope decode —
+                # so the duration shipped back is what the task itself
+                # cost, not dispatch latency (speculation's outlier
+                # detection and the metrics summary read it).
+                t0 = time.monotonic()
+                faults.get().maybe_slow_task(cancel_event)  # chaos straggler
+                result = task.run()
+                duration = time.monotonic() - t0
+            finally:
+                worker.end_task(task.task_id)
             # Chaos kill point: AFTER the task computed (shuffle buckets
             # may be registered locally) but BEFORE the driver hears back —
             # the loss mode that exercises re-dispatch + output recovery.
             faults.get().maybe_kill_worker()
-            reply = serialization.dumps(("success", result, time.time() - t0))
+            reply = serialization.dumps(("success", result, duration))
             protocol.send_msg(sock, "result", None)
             protocol.send_bytes(sock, reply)
         except BaseException as exc:  # noqa: BLE001 — ship error to driver
@@ -115,7 +149,6 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         except NetworkError:
             worker.binaries.abandon(sha, claim)
             return
-        t0 = time.time()
         try:
             faults.get().maybe_hang_task()  # chaos: wedged-but-alive worker
             if marker == "binary_cached" and faults.get().maybe_drop_binary():
@@ -146,12 +179,26 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             if binary is None:
                 binary = worker.binaries.load(sha, raw, claim)
             header = serialization.loads(header_bytes)
-            result = run_from_header(header, binary)
+            cancel_event = worker.begin_task(header.task_id)
+            try:
+                _pre_run_cancel_gate(cancel_event)
+                # Execution wall starts HERE — after the binary transfer
+                # (including any need_binary re-ship round trip) and the
+                # lineage unpickle, which are dispatch-plane latency, not
+                # task work. A task whose binary took seconds to arrive
+                # must not look like a straggler to speculation's
+                # duration tracking.
+                t0 = time.monotonic()
+                faults.get().maybe_slow_task(cancel_event)  # chaos straggler
+                result = run_from_header(header, binary)
+                duration = time.monotonic() - t0
+            finally:
+                worker.end_task(header.task_id)
             # Chaos kill point: computed but unacknowledged (see legacy
             # path above).
             faults.get().maybe_kill_worker()
             head, buffers = serialization.dumps_oob(
-                ("success", result, time.time() - t0)
+                ("success", result, duration)
             )
         except BaseException as exc:  # noqa: BLE001 — ship error to driver
             # Release the transfer claim if the load never consumed it
@@ -211,6 +258,13 @@ class Worker:
         self.host = host
         self.port = self._server.server_address[1]
         self._shutdown = threading.Event()
+        # Cancellation registry: running attempts' cancel events plus a
+        # small memory of recently-cancelled ids, so a cancel racing the
+        # task's arrival (driver committed the twin while this dispatch
+        # was still on the wire) still lands.
+        self._cancel_lock = named_lock("distributed.worker.Worker._cancel_lock")
+        self._cancel_events: dict = {}
+        self._cancelled_recently: "OrderedDict[int, float]" = OrderedDict()
 
         from vega_tpu.env import attach_session_logger
 
@@ -228,6 +282,34 @@ class Worker:
     @property
     def task_uri(self) -> str:
         return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------- task cancel
+    def begin_task(self, task_id: int) -> threading.Event:
+        """Register a starting attempt; pre-set if a cancel beat it here."""
+        with self._cancel_lock:
+            event = self._cancel_events.get(task_id)
+            if event is None:
+                event = self._cancel_events[task_id] = threading.Event()
+            if task_id in self._cancelled_recently:
+                event.set()
+            return event
+
+    def end_task(self, task_id: int) -> None:
+        with self._cancel_lock:
+            self._cancel_events.pop(task_id, None)
+
+    def cancel_task(self, task_id: int) -> bool:
+        """Flip the attempt's cancel event (True if it was running here);
+        otherwise remember the id briefly for a racing arrival."""
+        with self._cancel_lock:
+            event = self._cancel_events.get(task_id)
+            if event is not None:
+                event.set()
+                return True
+            self._cancelled_recently[task_id] = time.time()
+            while len(self._cancelled_recently) > 256:
+                self._cancelled_recently.popitem(last=False)
+            return False
 
     def request_shutdown(self) -> None:
         self._shutdown.set()

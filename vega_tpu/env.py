@@ -132,15 +132,36 @@ class Configuration:
     # src/hosts.rs); None -> VEGA_TPU_HOSTS_FILE -> ~/hosts.conf -> local.
     hosts_file: Optional[str] = None
     # Speculative execution (straggler mitigation; the reference has none):
-    # when a stage has completions and a pending task has run longer than
-    # max(speculation_min_s, speculation_multiplier * median), launch a
-    # duplicate; first completion wins. NOTE: like task retries, this gives
-    # at-least-once semantics for user side effects (for_each etc.) —
-    # framework-owned writes (save_as_text_file, shuffle buckets) are
-    # duplicate-safe.
-    speculation: bool = False
+    # once a quorum of a stage's tasks has finished (speculation_quorum
+    # fraction of its submitted tasks), a pending task that has run longer
+    # than max(speculation_min_s, speculation_multiplier * median task
+    # duration) gets ONE duplicate attempt launched — on a different,
+    # non-blacklisted executor in distributed mode. First completion wins
+    # (dedup by (stage_id, partition)); the loser is cancelled best-effort
+    # via the `cancel_task` protocol message. NOTE: like task retries,
+    # this gives at-least-once semantics for user side effects (for_each
+    # etc.) — framework-owned writes (save_as_text_file, shuffle buckets)
+    # are duplicate-safe.
+    speculation_enabled: bool = False
     speculation_multiplier: float = 3.0
     speculation_min_s: float = 1.0
+    # Fraction of a stage's tasks that must have COMPLETED before any of
+    # its stragglers are eligible for speculation (the median is garbage
+    # on two data points).
+    speculation_quorum: float = 0.75
+    # Replicated shuffle writes (the data-side redundancy of
+    # arXiv:1802.03049): each map task's buckets are written to this many
+    # executors' stores (1 = primary only). Reducers treat the extra
+    # locations as failover targets — a dead or slow server's undelivered
+    # buckets are re-requested from a replica mid-stream, with no stage
+    # resubmission and no map recompute.
+    shuffle_replication: int = 1
+    # When > 0 and every bucket requested from a server has at least one
+    # replica location, the batched get_many round runs under this socket
+    # deadline with no in-place retries: a server unresponsive past it
+    # fails over to the replicas instead of gating the reduce task on the
+    # slowest source. 0 keeps the normal fetch_retries behavior.
+    fetch_slow_server_s: float = 0.0
     # Dense-tier HBM budget in bytes (per chip). Sources stream through
     # the mesh in chunks (tpu/stream.py) when estimated block bytes times
     # the exchange footprint factor (~6: operand + sorted copy + send
@@ -204,19 +225,21 @@ class Configuration:
                      "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
                      "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
                      "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
-                     "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES"):
+                     "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES",
+                     "SHUFFLE_REPLICATION"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
-                     "SPECULATION", "FETCH_BATCH_ENABLED",
+                     "SPECULATION_ENABLED", "FETCH_BATCH_ENABLED",
                      "TASK_BINARY_DEDUP"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
         for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",
                      "SPECULATION_MULTIPLIER", "SPECULATION_MIN_S",
+                     "SPECULATION_QUORUM",
                      "HEARTBEAT_INTERVAL_S", "EXECUTOR_LIVENESS_TIMEOUT_S",
                      "EXECUTOR_REAP_INTERVAL_S", "EXECUTOR_RESTART_BACKOFF_S",
-                     "FETCH_RETRY_INTERVAL_S"):
+                     "FETCH_RETRY_INTERVAL_S", "FETCH_SLOW_SERVER_S"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), float(env[pref + name]))
         return cfg

@@ -71,6 +71,13 @@ class TaskError(VegaError):
         self.remote_traceback = remote_traceback
 
 
+class TaskCancelledError(VegaError):
+    """A running task attempt was cancelled by the driver — the losing copy
+    of a speculated (stage_id, partition) after its twin committed first.
+    Never counts toward a stage's max_failures budget: the partition is
+    already done."""
+
+
 class TraceFallbackError(VegaError):
     """A user function could not be traced for the TPU tier.
 

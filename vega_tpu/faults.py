@@ -8,6 +8,11 @@ the distributed plane consults at its natural failure seams:
 
   - worker.py      -> maybe_kill_worker() (SIGKILL self after N tasks),
                       maybe_hang_task() (wedge: alive but not progressing),
+                      maybe_slow_task() (straggler: the first N tasks
+                      sleep SLOW_TASK_S seconds — deterministic, bounded,
+                      and cancel-aware, so speculation is testable without
+                      wall-clock flakiness; distinct from hang, which
+                      never finishes),
                       suppress_heartbeat() (wedge: alive but silent)
   - shuffle_server -> serve_fetch() (drop the connection / delay the reply
                       for the first N bucket gets — a transient network
@@ -32,6 +37,12 @@ tests:
                                      (empty -> every process)
   VEGA_TPU_FAULT_KILL_AFTER_TASKS    SIGKILL self after N completed tasks
   VEGA_TPU_FAULT_HANG_TASKS          1 -> task handlers sleep forever
+  VEGA_TPU_FAULT_SLOW_TASKS          slow the first N tasks this process
+                                     runs (straggler injection; combine
+                                     with ..._EXECUTOR to slow one node)
+  VEGA_TPU_FAULT_SLOW_TASK_S         seconds each slowed task sleeps
+                                     (default 5.0); a driver-side
+                                     cancel_task interrupts the sleep
   VEGA_TPU_FAULT_SUPPRESS_HEARTBEATS 1 -> stop heartbeating (stay alive)
   VEGA_TPU_FAULT_FETCH_DROP_N        drop the first N shuffle-bucket gets
   VEGA_TPU_FAULT_FETCH_DELAY_S       delay every served get by S seconds
@@ -101,6 +112,8 @@ class FaultInjector:
         self.executor_filter: Optional[str] = env.get(pref + "EXECUTOR") or None
         self.kill_after_tasks = _int("KILL_AFTER_TASKS") if armed else 0
         self.hang_tasks = armed and _flag("HANG_TASKS")
+        self.slow_tasks = _int("SLOW_TASKS") if armed else 0
+        self.slow_task_s = _float("SLOW_TASK_S", 5.0)
         self.suppress_heartbeats = armed and _flag("SUPPRESS_HEARTBEATS")
         self.fetch_drop_n = _int("FETCH_DROP_N") if armed else 0
         self.fetch_delay_s = _float("FETCH_DELAY_S") if armed else 0.0
@@ -118,7 +131,7 @@ class FaultInjector:
     def active(self) -> bool:
         """Cheap gate for the hot paths: anything armed at all?"""
         return bool(
-            self.kill_after_tasks or self.hang_tasks
+            self.kill_after_tasks or self.hang_tasks or self.slow_tasks
             or self.suppress_heartbeats or self.fetch_drop_n
             or self.fetch_delay_s or self.corrupt_spill_n
             or self.fetch_stream_drop_n or self.drop_binary_n
@@ -143,6 +156,34 @@ class FaultInjector:
         log.warning("FAULT: hanging task handler (wedged executor)")
         while True:
             time.sleep(3600.0)
+
+    def maybe_slow_task(self, cancel_event=None) -> None:
+        """worker.py, inside the timed execution window: make this task a
+        STRAGGLER — a bounded, deterministic sleep (unlike hang, the task
+        finishes and delivers its result, so first-result-wins dedup and
+        loser accounting are exercised end to end). The sleep waits on the
+        attempt's cancel event when one is supplied: a driver-side
+        cancel_task interrupts it and the attempt exits early with
+        TaskCancelledError instead of sleeping out the injection."""
+        if not (self.active and self.slow_tasks and self._targets_me()):
+            return
+        with self._lock:
+            if self.slow_tasks <= 0:
+                return
+            self.slow_tasks -= 1
+        self._record("slow_task", sleep_s=self.slow_task_s)
+        log.warning("FAULT: slowing task by %.1fs (straggler)",
+                    self.slow_task_s)
+        if cancel_event is not None:
+            if cancel_event.wait(self.slow_task_s):
+                from vega_tpu.errors import TaskCancelledError
+
+                log.warning("FAULT: slowed task cancelled mid-sleep")
+                raise TaskCancelledError(
+                    "straggling attempt cancelled by the driver"
+                )
+        else:
+            time.sleep(self.slow_task_s)
 
     def maybe_kill_worker(self) -> None:
         """worker.py, after a task computes but BEFORE its result is sent:

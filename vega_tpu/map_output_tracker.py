@@ -1,26 +1,47 @@
 """Map-output location registry (reference: src/map_output_tracker.rs).
 
-The driver records, per shuffle_id, the server URI of every map partition's
+The driver records, per shuffle_id, the server URIs of every map partition's
 output (register/unregister, map_output_tracker.rs:168-211) and bumps a
 generation counter on invalidation (:267-281). Workers query over the control
 plane instead of busy-waiting with 1ms sleeps like the reference
 (:122-132,227-244) — vega_tpu uses a condition variable locally and a blocking
 RPC in distributed mode.
+
+Where the reference stores exactly ONE location per map output, vega_tpu
+keeps an ORDERED LIST per map_id (primary first, then the replicas written
+under `shuffle_replication > 1`): a reducer can be satisfied by any of the
+k sources instead of the one that happens to be slow or dead
+(arXiv:1802.03049's data-side redundancy). `get_server_uris` keeps the old
+primary-per-map contract; `get_server_uri_lists` exposes the full lists to
+the failover-aware fetch path. An output is "available" while ANY location
+remains, so losing one replica neither blocks reducers nor forces a map
+recompute.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from vega_tpu.errors import MapOutputError
+
+Locs = Union[None, str, List[str]]
+
+
+def _as_list(uri: Locs) -> List[str]:
+    if uri is None:
+        return []
+    if isinstance(uri, str):
+        return [uri]
+    return [u for u in uri if u]
 
 
 class MapOutputTracker:
     """Driver-side (master) tracker; also the local-mode implementation."""
 
     def __init__(self):
-        self._outputs: Dict[int, List[Optional[str]]] = {}
+        # shuffle_id -> per-map_id ordered location list (empty = missing).
+        self._outputs: Dict[int, List[List[str]]] = {}
         self._generation = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -29,42 +50,45 @@ class MapOutputTracker:
     def register_shuffle(self, shuffle_id: int, num_maps: int) -> None:
         with self._lock:
             if shuffle_id not in self._outputs:
-                self._outputs[shuffle_id] = [None] * num_maps
+                self._outputs[shuffle_id] = [[] for _ in range(num_maps)]
 
-    def register_map_output(self, shuffle_id: int, map_id: int, uri: str) -> None:
+    def register_map_output(self, shuffle_id: int, map_id: int,
+                            uri: Locs) -> None:
         with self._cond:
-            self._outputs[shuffle_id][map_id] = uri
+            self._outputs[shuffle_id][map_id] = _as_list(uri)
             self._cond.notify_all()
 
-    def register_map_outputs(self, shuffle_id: int, uris: List[Optional[str]]) -> None:
-        """Reference: map_output_tracker.rs:192-199."""
+    def register_map_outputs(self, shuffle_id: int, uris: List[Locs]) -> None:
+        """Reference: map_output_tracker.rs:192-199. Each entry may be a
+        bare URI, an ordered [primary, replica, ...] list, or None."""
         with self._cond:
-            self._outputs[shuffle_id] = list(uris)
+            self._outputs[shuffle_id] = [_as_list(u) for u in uris]
             self._cond.notify_all()
 
     def unregister_map_output(self, shuffle_id: int, map_id: int, uri: str) -> None:
         """Called on fetch failure; bumps generation
-        (reference: map_output_tracker.rs:201-211)."""
+        (reference: map_output_tracker.rs:201-211). Only the failed
+        location is dropped — surviving replicas keep serving."""
         with self._cond:
             locs = self._outputs.get(shuffle_id)
             if locs is None:
                 raise MapOutputError(f"unknown shuffle {shuffle_id}")
-            if locs[map_id] == uri:
-                locs[map_id] = None
+            locs[map_id] = [u for u in locs[map_id] if u != uri]
             self._generation += 1
             self._cond.notify_all()
 
     def unregister_server_outputs(self, uri: str) -> int:
-        """Executor loss: null every map output served by `uri` across all
-        shuffles in one sweep, bumping the generation ONCE so reducers
-        refetch (the reaper's bulk edition of unregister_map_output).
-        Returns the number of outputs invalidated."""
+        """Executor loss: drop `uri` from every map output's location list
+        across all shuffles in one sweep, bumping the generation ONCE so
+        reducers refetch (the reaper's bulk edition of
+        unregister_map_output). Returns the number of entries the server
+        was dropped from; outputs with surviving replicas stay available."""
         removed = 0
         with self._cond:
             for locs in self._outputs.values():
-                for i, u in enumerate(locs):
-                    if u == uri:
-                        locs[i] = None
+                for i, lst in enumerate(locs):
+                    if uri in lst:
+                        locs[i] = [u for u in lst if u != uri]
                         removed += 1
             if removed:
                 self._generation += 1
@@ -76,24 +100,37 @@ class MapOutputTracker:
             self._outputs.pop(shuffle_id, None)
 
     # --- queries (workers / reduce tasks) ----------------------------------
-    def get_server_uris(self, shuffle_id: int, timeout: float = 60.0) -> List[str]:
-        """Block until every map output of the shuffle has a location."""
-        with self._cond:
-            ok = self._cond.wait_for(
-                lambda: shuffle_id in self._outputs
-                and all(u is not None for u in self._outputs[shuffle_id]),
-                timeout=timeout,
+    def _wait_complete(self, shuffle_id: int, timeout: float) -> None:
+        ok = self._cond.wait_for(
+            lambda: shuffle_id in self._outputs
+            and all(self._outputs[shuffle_id]),
+            timeout=timeout,
+        )
+        if not ok:
+            raise MapOutputError(
+                f"timed out waiting for map outputs of shuffle {shuffle_id}"
             )
-            if not ok:
-                raise MapOutputError(
-                    f"timed out waiting for map outputs of shuffle {shuffle_id}"
-                )
-            return list(self._outputs[shuffle_id])
+
+    def get_server_uris(self, shuffle_id: int, timeout: float = 60.0) -> List[str]:
+        """Block until every map output of the shuffle has a location;
+        return each output's PRIMARY (first) location — the pre-replication
+        contract, still what single-location callers consume."""
+        with self._cond:
+            self._wait_complete(shuffle_id, timeout)
+            return [lst[0] for lst in self._outputs[shuffle_id]]
+
+    def get_server_uri_lists(self, shuffle_id: int,
+                             timeout: float = 60.0) -> List[List[str]]:
+        """Block like get_server_uris, but return the full ordered location
+        list per map output (primary first) for failover-aware fetching."""
+        with self._cond:
+            self._wait_complete(shuffle_id, timeout)
+            return [list(lst) for lst in self._outputs[shuffle_id]]
 
     def has_outputs(self, shuffle_id: int) -> bool:
         with self._lock:
             locs = self._outputs.get(shuffle_id)
-            return locs is not None and all(u is not None for u in locs)
+            return locs is not None and all(locs)
 
     @property
     def generation(self) -> int:

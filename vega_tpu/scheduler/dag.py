@@ -85,6 +85,12 @@ class TaskBackend:
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         raise NotImplementedError
 
+    def cancel_task(self, task_id: int) -> None:
+        """Best-effort: ask whichever executor is running `task_id` to
+        abandon it (the losing copy of a speculated pair). Correctness
+        never depends on it — completions are deduped driver-side — so
+        the default is a no-op (local threads cannot be interrupted)."""
+
     def stop(self) -> None:
         pass
 
@@ -114,12 +120,19 @@ class _Job:
         self.pending_tasks: Dict[int, Set[int]] = {}  # stage_id -> partitions
         self.task_attempts: Dict[tuple, int] = {}  # (stage_id, partition) -> tries
         self.last_fetch_failure: float = 0.0
-        # speculation bookkeeping
-        self.inflight: Dict[tuple, tuple] = {}  # (stage,part) -> (task, t0)
-        self.outstanding: Dict[tuple, int] = {}  # (stage,part) -> live copies
+        # speculation bookkeeping: every live attempt of a partition is
+        # tracked individually so the copies of a speculated pair can be
+        # told apart (first-result-wins settle, loser cancellation).
+        # (stage,part) -> {task_id: (task, submit_t0)}
+        self.inflight: Dict[tuple, Dict[int, tuple]] = {}
         self.durations: Dict[int, List[float]] = {}  # stage_id -> task secs
+        self.stage_task_counts: Dict[int, int] = {}  # submitted tasks/stage
         self.speculated: Set[tuple] = set()
+        self.spec_task_ids: Dict[tuple, int] = {}  # key -> duplicate's id
         self.last_speculation_sweep: float = 0.0
+
+    def live_copies(self, key: tuple) -> int:
+        return len(self.inflight.get(key, ()))
 
 
 class DAGScheduler:
@@ -357,12 +370,14 @@ class DAGScheduler:
                 stage_id=stage.id, num_tasks=len(tasks),
                 is_shuffle_map=stage.is_shuffle_map,
             ))
+            job.stage_task_counts[stage.id] = (
+                job.stage_task_counts.get(stage.id, 0) + len(tasks))
             for task in tasks:
                 pending.add(task.partition)
             for task in tasks:
                 tkey = (task.stage_id, task.partition)
-                job.inflight[tkey] = (task, time.time())
-                job.outstanding[tkey] = job.outstanding.get(tkey, 0) + 1
+                job.inflight.setdefault(tkey, {})[task.task_id] = (
+                    task, time.time())
                 self._submit_task(task, event_queue)
 
         def stage_of(task: Task) -> Optional[Stage]:
@@ -372,6 +387,34 @@ class DAGScheduler:
                 if s.id == task.stage_id:
                     return s
             return self._stage_by_id(task.stage_id)
+
+        def committed(task: Task) -> bool:
+            """Has this task's (stage, partition) already been committed by
+            an earlier completion? Drives both the dedup guard and the
+            `duplicate` flag on the TaskEnd bus event."""
+            if isinstance(task, ResultTask):
+                return job.finished[task.output_id]
+            pending = job.pending_tasks.get(task.stage_id)
+            return pending is not None and task.partition not in pending
+
+        def settle_speculation(winner: Task):
+            """First commit of a speculated partition: record which copy
+            won and cancel the still-running losers best-effort. The event
+            loop already removed the winner from inflight, so whatever
+            remains under the key is a loser."""
+            key = (winner.stage_id, winner.partition)
+            if key in job.speculated:
+                spec_id = job.spec_task_ids.get(key)
+                if winner.task_id == spec_id:
+                    self.bus.post(ev.SpeculativeWon(
+                        stage_id=key[0], partition=key[1]))
+                else:
+                    self.bus.post(ev.SpeculativeLost(
+                        stage_id=key[0], partition=key[1]))
+            for task_id in list(job.inflight.get(key, ())):
+                log.info("cancelling losing attempt %d of stage %d "
+                         "partition %d", task_id, key[0], key[1])
+                self.backend.cancel_task(task_id)
 
         def on_success(event: TaskEndEvent):
             """Reference: base_scheduler.rs:202-345."""
@@ -383,6 +426,7 @@ class DAGScheduler:
                     job.results[out_id] = event.result
                     job.finished[out_id] = True
                     job.num_finished += 1
+                    settle_speculation(task)
                     if job.on_task_success is not None:
                         job.on_task_success(out_id, event.result)
             else:  # ShuffleMapTask
@@ -398,6 +442,7 @@ class DAGScheduler:
                 stage.add_output_loc(task.partition, event.result)
                 if pending is not None:
                     pending.discard(task.partition)
+                settle_speculation(task)
                 if pending is not None and not pending:
                     self._finish_map_stage(job, stage, submit_stage,
                                            submit_missing_tasks, stage_starts)
@@ -441,11 +486,48 @@ class DAGScheduler:
                 job.last_fetch_failure = time.time()
                 return
             key = (task.stage_id, task.partition)
-            if job.outstanding.get(key, 0) > 0:
-                # Another copy of this task (speculative twin or an earlier
-                # retry) is still running — let it decide the partition's
-                # fate instead of stacking more attempts.
+            if job.live_copies(key) > 0:
+                # Another copy of this task is still running — let it
+                # decide the partition's fate instead of stacking more
+                # attempts. This is also what keeps a failed SPECULATIVE
+                # duplicate from burning the stage's max_failures budget
+                # while the original straggles on. Only the last copy
+                # standing counts (both copies genuinely failing is one
+                # partition failure, not two).
+                if task.speculative:
+                    log.info("speculative attempt of %s failed (%s); "
+                             "original still running — not counted against "
+                             "max_failures", task, err)
+                    # The duplicate is gone — settle its Launched event
+                    # NOW (failed/skipped = lost: wasted work either way)
+                    # and drop the speculation markers so (a) the
+                    # original's eventual commit doesn't settle a second
+                    # time, and (b) a later sweep may duplicate again if
+                    # the original keeps straggling (e.g. the
+                    # skipped-launch case heals once an executor leaves
+                    # the blacklist). Restart the survivor's straggler
+                    # clock so the next duplicate waits out a full
+                    # threshold instead of re-firing on the very next
+                    # 0.1s sweep.
+                    if key in job.speculated:
+                        self.bus.post(ev.SpeculativeLost(
+                            stage_id=key[0], partition=key[1]))
+                    job.speculated.discard(key)
+                    job.spec_task_ids.pop(key, None)
+                    copies = job.inflight.get(key)
+                    if copies:
+                        now = time.time()
+                        for tid, (t, _t0) in list(copies.items()):
+                            copies[tid] = (t, now)
                 return
+            if task.speculative:
+                # Last copy standing: fall through to the normal retry
+                # path, but strip the speculation markers so the retry is
+                # an ordinary attempt (any executor, settles normally).
+                task.speculative = False
+                task.exclude_executors = frozenset()
+                job.speculated.discard(key)
+                job.spec_task_ids.pop(key, None)
             tries = job.task_attempts.get(key, 0) + 1
             job.task_attempts[key] = tries
             conf_max = Env.get().conf.max_failures
@@ -455,9 +537,10 @@ class DAGScheduler:
                 task.attempt = tries
                 # Retries rejoin the inflight map so speculation can still
                 # cover a straggling retry.
-                job.inflight[key] = (task, time.time())
-                job.outstanding[key] = job.outstanding.get(key, 0) + 1
+                job.inflight.setdefault(key, {})[task.task_id] = (
+                    task, time.time())
                 job.speculated.discard(key)
+                job.spec_task_ids.pop(key, None)
                 self._submit_task(task, event_queue)
             else:
                 raise TaskError(
@@ -479,11 +562,15 @@ class DAGScheduler:
                     task_id=event.task.task_id, stage_id=event.task.stage_id,
                     partition=event.task.partition, success=event.success,
                     duration_s=event.duration_s, dispatch=event.dispatch,
+                    speculative=event.task.speculative,
+                    duplicate=bool(event.success and committed(event.task)),
                 ))
                 key = (event.task.stage_id, event.task.partition)
-                job.outstanding[key] = max(0, job.outstanding.get(key, 1) - 1)
-                if job.outstanding[key] == 0:
-                    job.inflight.pop(key, None)
+                copies = job.inflight.get(key)
+                if copies is not None:
+                    copies.pop(event.task.task_id, None)
+                    if not copies:
+                        job.inflight.pop(key, None)
                 if event.success:
                     job.durations.setdefault(
                         event.task.stage_id, []
@@ -564,9 +651,14 @@ class DAGScheduler:
             job.running.discard(stage)
             job.failed.discard(stage)
             if tracker is not None:
+                # Full ordered location lists (primary first, then the
+                # replicas written under shuffle_replication > 1): the
+                # fetch plane fails a dead or slow server's undelivered
+                # buckets over to a replica instead of resubmitting.
                 tracker.register_map_outputs(
                     stage.shuffle_dep.shuffle_id,
-                    [locs[0] if locs else None for locs in stage.output_locs],
+                    [list(locs) if locs else None
+                     for locs in stage.output_locs],
                 )
             self.bus.post(ev.StageCompleted(
                 stage_id=stage.id,
@@ -605,10 +697,25 @@ class DAGScheduler:
             submit_stage(stage)
 
     def _maybe_speculate(self, job: _Job, conf, event_queue) -> None:
-        """Straggler mitigation (opt-in; absent from the reference): when a
-        pending task has run far beyond the stage's median task duration,
-        launch one duplicate — completions are idempotent, first wins."""
-        if not getattr(conf, "speculation", False):
+        """Straggler mitigation (opt-in; absent from the reference): once a
+        quorum of a stage's tasks has completed, a pending task that has
+        run far beyond the stage's median task duration gets ONE duplicate
+        attempt — a fresh task_id on a different executor (the clone's
+        exclude_executors carries the straggler's host). Completions are
+        deduped by (stage_id, partition): first result wins, the loser is
+        cancelled best-effort via TaskBackend.cancel_task.
+
+        Honest-inputs caveat: the MEDIAN side of the comparison is pure
+        execution wall (workers measure it around the task body), but a
+        still-RUNNING task's age can only be observed driver-side from
+        its submit time — the driver has no mid-task progress signal —
+        so the elapsed side necessarily includes dispatch latency
+        (queueing, binary transfer). A task parked in dispatch can
+        therefore look like a straggler; `speculation_min_s` is the
+        floor that keeps ordinary dispatch jitter below the trigger, and
+        a spurious duplicate is bounded waste (one ~100-byte header,
+        first-result-wins dedup)."""
+        if not getattr(conf, "speculation_enabled", False):
             return
         now = time.time()
         # Sweep at most ~10x/sec and compute each stage's median once —
@@ -617,21 +724,33 @@ class DAGScheduler:
         if now - job.last_speculation_sweep < 0.1:
             return
         job.last_speculation_sweep = now
+        quorum = max(0.0, min(1.0, getattr(conf, "speculation_quorum", 0.75)))
         medians: Dict[int, float] = {}
         for stage_id, durs in job.durations.items():
-            if durs:
+            total = job.stage_task_counts.get(stage_id, 0)
+            # Quorum gate: with too few completions the median is noise
+            # and everything still running looks like an outlier.
+            if durs and total and len(durs) >= max(1, int(quorum * total)):
                 medians[stage_id] = sorted(durs)[len(durs) // 2]
-        for key, (task, t0) in list(job.inflight.items()):
-            if key in job.speculated or key[0] not in medians:
+        for key, copies in list(job.inflight.items()):
+            if key in job.speculated or key[0] not in medians \
+                    or len(copies) != 1:
                 continue
+            (task, t0), = copies.values()
             threshold = max(conf.speculation_min_s,
                             conf.speculation_multiplier * medians[key[0]])
-            if now - t0 > threshold:
-                job.speculated.add(key)
-                job.outstanding[key] = job.outstanding.get(key, 0) + 1
-                log.info("speculating duplicate of %s (%.2fs > %.2fs)",
-                         task, now - t0, threshold)
-                self.backend.submit(task, event_queue.put)
+            if now - t0 <= threshold:
+                continue
+            clone = task.speculative_copy()
+            job.speculated.add(key)
+            job.spec_task_ids[key] = clone.task_id
+            copies[clone.task_id] = (clone, now)
+            log.info("speculating duplicate of %s (%.2fs > %.2fs), "
+                     "excluding %s", task, now - t0, threshold,
+                     set(clone.exclude_executors) or "{}")
+            self.bus.post(ev.SpeculativeLaunched(
+                stage_id=key[0], partition=key[1], task_id=clone.task_id))
+            self.backend.submit(clone, event_queue.put)
 
     def _submit_task(self, task: Task,
                      event_queue: "queue.Queue[TaskEndEvent]") -> None:

@@ -62,8 +62,19 @@ class TaskEnd(Event):
     stage_id: int = -1
     partition: int = -1
     success: bool = True
+    # Execution wall measured where the task ran (worker-side on both
+    # distributed legs, pool-thread-side locally) — never dispatch
+    # latency: queue waits, binary transfers and need_binary round trips
+    # are excluded so speculation's outlier detection has honest inputs.
     duration_s: float = 0.0
     executor: str = "local"
+    # This attempt was a speculative duplicate (straggler mitigation).
+    speculative: bool = False
+    # A completion for a (stage_id, partition) that had already committed
+    # (the losing copy of a speculated pair, or a late straggler after a
+    # resubmission): its result was discarded — output_locs, accumulators
+    # and job results are single-shot per partition.
+    duplicate: bool = False
     # Dispatch-plane accounting from the distributed backend (task_v2:
     # header/binary/result bytes, binaries shipped, cache hits,
     # need_binary re-ships; legacy: full-envelope bytes). None when the
@@ -100,6 +111,49 @@ class StageResubmitted(Event):
     distinction."""
 
     stage_id: int = -1
+
+
+@dataclasses.dataclass
+class SpeculativeLaunched(Event):
+    """A straggling task crossed the stage's outlier threshold and got a
+    duplicate attempt on another executor (first result wins)."""
+
+    stage_id: int = -1
+    partition: int = -1
+    task_id: int = -1  # the duplicate attempt's task id
+
+
+@dataclasses.dataclass
+class SpeculativeWon(Event):
+    """The speculative duplicate committed first — the straggler's result
+    will be discarded (and the straggler cancelled best-effort)."""
+
+    stage_id: int = -1
+    partition: int = -1
+
+
+@dataclasses.dataclass
+class SpeculativeLost(Event):
+    """The speculative duplicate was wasted work: the original attempt
+    committed first (duplicate cancelled best-effort), or the duplicate
+    failed/could not be placed while the original was still running.
+    Every SpeculativeLaunched settles as exactly one Won or Lost."""
+
+    stage_id: int = -1
+    partition: int = -1
+
+
+@dataclasses.dataclass
+class FetchFailedOver(Event):
+    """A reduce task abandoned an unreachable/slow shuffle server
+    mid-stream and re-requested its undelivered buckets from replica
+    locations (shuffle_replication > 1) — no stage resubmission, no map
+    recompute."""
+
+    shuffle_id: int = -1
+    reduce_id: int = -1
+    from_uri: str = ""
+    buckets: int = 0  # undelivered buckets moved to a replica
 
 
 @dataclasses.dataclass
@@ -237,6 +291,18 @@ class MetricsListener(Listener):
         self.executors_lost = 0
         self.executors_restarted = 0
         self.stages_resubmitted = 0
+        # Straggler-mitigation counters: duplicates launched / which copy
+        # committed first / completions whose result was discarded by the
+        # (stage_id, partition) dedup. benchmarks/straggler_ab.py and the
+        # chaos suite key exactly-once accounting on these.
+        self.speculation: Dict[str, int] = {
+            "launched": 0, "won": 0, "lost": 0, "duplicate_completions": 0,
+        }
+        # Replicated-read failovers (FetchFailedOver): undelivered buckets
+        # re-requested from replica locations instead of resubmitting the
+        # producing stage.
+        self.fetch_failovers = 0
+        self.fetch_failover_buckets = 0
         # Shuffle-fetch pipeline counters (ShuffleFetchCompleted): bench.py
         # and benchmarks/suite.py surface these as the `fetch` detail.
         self.fetch_streams = 0
@@ -288,6 +354,8 @@ class MetricsListener(Listener):
                 self.total_task_time_s += event.duration_s
                 if not event.success:
                     self.task_failures += 1
+                if event.duplicate:
+                    self.speculation["duplicate_completions"] += 1
                 d = event.dispatch
                 if d:
                     dd = self.dispatch
@@ -305,6 +373,15 @@ class MetricsListener(Listener):
                         dd["legacy_task_bytes"] += d.get("task_bytes", 0)
                         dd["driver_serialized_bytes"] += d.get("task_bytes", 0)
                     dd["result_bytes"] += d.get("result_bytes", 0)
+            elif isinstance(event, SpeculativeLaunched):
+                self.speculation["launched"] += 1
+            elif isinstance(event, SpeculativeWon):
+                self.speculation["won"] += 1
+            elif isinstance(event, SpeculativeLost):
+                self.speculation["lost"] += 1
+            elif isinstance(event, FetchFailedOver):
+                self.fetch_failovers += 1
+                self.fetch_failover_buckets += event.buckets
             elif isinstance(event, ExecutorLost):
                 self.executors_lost += 1
             elif isinstance(event, ExecutorRestarted):
@@ -343,6 +420,7 @@ class MetricsListener(Listener):
                 "promotes": self.promote_count,
                 "spilled_bytes": dict(self.spilled_bytes),
                 "promoted_bytes": dict(self.promoted_bytes),
+                "speculation": dict(self.speculation),
                 "fetch": {
                     "streams": self.fetch_streams,
                     "buckets": self.fetch_buckets,
@@ -351,6 +429,8 @@ class MetricsListener(Listener):
                     "wall_s": round(self.fetch_wall_s, 6),
                     "net_s": round(self.fetch_net_s, 6),
                     "overlap_s": round(self.fetch_overlap_s, 6),
+                    "failovers": self.fetch_failovers,
+                    "failover_buckets": self.fetch_failover_buckets,
                 },
                 "dispatch": dict(self.dispatch),
             }

@@ -64,36 +64,49 @@ class LocalBackend(TaskBackend):
 
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         def run():
-            import time
-
-            t_start = time.time()
             try:
-                result = self._run_one(task)
+                result, duration = self._run_one(task)
                 callback(TaskEndEvent(task=task, success=True, result=result,
-                                      duration_s=time.time() - t_start))
+                                      duration_s=duration))
             except BaseException as exc:  # noqa: BLE001 — report, don't die
                 log.debug("task %s failed", task, exc_info=True)
-                callback(TaskEndEvent(task=task, success=False, error=exc,
-                                      duration_s=time.time() - t_start))
+                callback(TaskEndEvent(task=task, success=False, error=exc))
 
         self._pool.submit(run)
 
     def _run_one(self, task: Task):
+        """Returns (result, execution_wall_s). The wall clock starts at the
+        task's actual execution — after the serialization round-trips and
+        lineage unpickles of the dispatch plane — mirroring the worker-side
+        measurement in distributed mode, so TaskEnd.duration_s means the
+        same thing on every backend and speculation's outlier detection
+        never mistakes dispatch latency for task time."""
+        import time
+
+        from vega_tpu import faults
+
         if not self._serialize:
-            return task.run()
+            t0 = time.monotonic()
+            faults.get().maybe_slow_task()  # chaos straggler injection
+            return task.run(), time.monotonic() - t0
         binary = task.stage_binary
         if binary is None:
             # Tasks submitted outside the DAG scheduler (no stage binary):
             # the legacy full round-trip (reference: local_scheduler.rs:
             # 345-351).
-            return serialization.loads(serialization.dumps(task)).run()
+            clone = serialization.loads(serialization.dumps(task))
+            t0 = time.monotonic()
+            faults.get().maybe_slow_task()
+            return clone.run(), time.monotonic() - t0
         payload = binary.ensure_serialized()  # cached: once per stage
         obj = self._binaries.get(binary.sha)
         if obj is None:
             obj = self._binaries.load(binary.sha, payload)
         # The header is the only thing still round-tripped per task.
         header = serialization.loads(serialization.dumps(task.header()))
-        return run_from_header(header, obj)
+        t0 = time.monotonic()
+        faults.get().maybe_slow_task()
+        return run_from_header(header, obj), time.monotonic() - t0
 
     def stop(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

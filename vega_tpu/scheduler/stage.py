@@ -48,8 +48,14 @@ class Stage:
             return not self.parents
         return self.num_available_outputs == self.num_partitions
 
-    def add_output_loc(self, partition: int, uri: str) -> None:
-        self.output_locs[partition].insert(0, uri)
+    def add_output_loc(self, partition: int, uri) -> None:
+        """`uri` is a single server URI or — with shuffle_replication > 1 —
+        the ordered [primary, replica, ...] list a map task returned.
+        Newest placement first, duplicates collapsed."""
+        uris = [uri] if isinstance(uri, str) else list(uri)
+        self.output_locs[partition] = uris + [
+            u for u in self.output_locs[partition] if u not in uris
+        ]
 
     def remove_output_loc(self, partition: int, uri: str) -> None:
         self.output_locs[partition] = [

@@ -269,6 +269,15 @@ class TaskBinaryCache:
 class Task:
     """Common task surface (reference: task.rs:28-74)."""
 
+    # Speculation plumbing (class attrs so pre-existing pickles and
+    # hand-built tasks stay valid): a speculative_copy() clone flips
+    # `speculative` and records executors it must avoid; the backend
+    # stamps `dispatched_to` with the executor it last picked so the
+    # clone can exclude the straggling original's host.
+    speculative = False
+    exclude_executors: frozenset = frozenset()
+    dispatched_to: Optional[str] = None
+
     def __init__(self, stage_id: int, partition: int, split: Split,
                  preferred_locs: Optional[List[str]] = None,
                  pinned: bool = False):
@@ -288,6 +297,23 @@ class Task:
         state = dict(self.__dict__)
         state["stage_binary"] = None
         return state
+
+    def speculative_copy(self) -> "Task":
+        """A duplicate attempt of this task with its own task_id (so the
+        event loop and cancel protocol can tell the copies apart) and a
+        bumped attempt number. Shares the stage_binary, so in distributed
+        mode the copy costs a ~100-byte header on the wire, not a
+        re-pickled lineage."""
+        import copy as _copy
+
+        clone = _copy.copy(self)
+        clone.task_id = next(_task_ids)
+        clone.attempt = self.attempt + 1
+        clone.speculative = True
+        clone.exclude_executors = frozenset(
+            e for e in (self.dispatched_to,) if e
+        )
+        return clone
 
     def header(self) -> TaskHeader:
         binary = self.stage_binary

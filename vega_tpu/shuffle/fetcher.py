@@ -56,7 +56,8 @@ _totals_lock = named_lock("shuffle.fetcher._totals_lock")
 _TOTALS = {
     "streams": 0, "buckets": 0, "bytes": 0, "round_trips": 0,
     "net_s": 0.0, "wait_s": 0.0, "overlap_s": 0.0, "wall_s": 0.0,
-    "peak_queued": 0, "duplicates": 0,
+    "peak_queued": 0, "duplicates": 0, "failovers": 0,
+    "failover_buckets": 0,
 }
 
 
@@ -75,7 +76,8 @@ def _bank_totals(stats: dict) -> None:
     with _totals_lock:
         _TOTALS["streams"] += 1
         for k in ("buckets", "bytes", "round_trips", "net_s", "wait_s",
-                  "overlap_s", "wall_s", "duplicates"):
+                  "overlap_s", "wall_s", "duplicates", "failovers",
+                  "failover_buckets"):
             _TOTALS[k] += stats[k]
         if stats["peak_queued"] > _TOTALS["peak_queued"]:
             _TOTALS["peak_queued"] = stats["peak_queued"]
@@ -92,8 +94,15 @@ class ShuffleFetcher:
         Recovery contract (reproven for a drop MID-STREAM): a dropped
         connection is first retried in place against the same server,
         re-requesting only the undelivered tail (fetch_many_remote /
-        fetch_remote); if that escalates to FetchFailedError, the
-        locations may simply be stale (the liveness reaper unregistered a
+        fetch_remote); if that escalates to FetchFailedError and the
+        affected buckets have REPLICA locations (shuffle_replication > 1),
+        the undelivered tail fails over to the next untried replica —
+        same exactly-once machinery, no stage resubmission, no map
+        recompute (FetchFailedOver). With `fetch_slow_server_s` set, a
+        fully-replicated server that stays unresponsive past that
+        deadline escalates the same way instead of gating the reduce task
+        on the slowest source. Only when no replica remains are the
+        locations treated as stale (the liveness reaper unregistered a
         lost executor's outputs and a survivor — or a respawn —
         re-registered them elsewhere): re-resolve them ONCE and refetch
         the undelivered buckets only — buckets already yielded are never
@@ -106,7 +115,7 @@ class ShuffleFetcher:
         if tracker is None:
             raise ShuffleError("no map output tracker configured")
         try:
-            uris = tracker.get_server_uris(shuffle_id)
+            uri_lists = tracker.get_server_uri_lists(shuffle_id)
         except VegaError as e:
             # Timed out waiting for locations: outputs were invalidated
             # (executor loss) and nothing has recomputed them yet. Must
@@ -118,35 +127,49 @@ class ShuffleFetcher:
                 None, shuffle_id, None, reduce_id,
                 f"map output locations unavailable: {e}",
             ) from e
-        return ShuffleFetcher._stream(env, tracker, list(uris),
+        return ShuffleFetcher._stream(env, tracker,
+                                      [list(lst) for lst in uri_lists],
                                       shuffle_id, reduce_id)
 
     @staticmethod
-    def _stream(env, tracker, uris: List[str], shuffle_id: int,
+    def _stream(env, tracker, uri_lists: List[List[str]], shuffle_id: int,
                 reduce_id: int) -> Iterator[bytes]:
         conf = env.conf
         batched = bool(getattr(conf, "fetch_batch_enabled", True))
         maxq = max(1, int(getattr(conf, "fetch_queue_buckets", 32)))
+        slow_s = float(getattr(conf, "fetch_slow_server_s", 0.0) or 0.0)
         stats = {"buckets": 0, "bytes": 0, "round_trips": 0, "net_s": 0.0,
                  "wait_s": 0.0, "peak_queued": 0, "duplicates": 0,
-                 "batched": batched}
+                 "failovers": 0, "failover_buckets": 0, "batched": batched}
         t_start = time.monotonic()
         delivered = set()
-        total = len(uris)
+        total = len(uri_lists)
+        # Per-map cursor into its ordered location list (primary first).
+        # Failover advances a bucket's cursor to the next untried replica;
+        # a cursor past the end means every known copy has been tried.
+        cursor = [0] * total
         abandoned = {"flag": False}
         counter_lock = named_lock("shuffle.fetcher.stream_counters")
         resolved_once = False
         local_store = env.shuffle_store
+
+        def current_uri(map_id: int):
+            lst = uri_lists[map_id]
+            return lst[cursor[map_id]] if cursor[map_id] < len(lst) else None
+
+        def replicas_behind(map_id: int) -> bool:
+            return cursor[map_id] + 1 < len(uri_lists[map_id])
 
         try:
             while True:
                 # -- split undelivered buckets into local vs per-server
                 local_ids: List[int] = []
                 by_server: dict = {}
-                for map_id, uri in enumerate(uris):
+                for map_id in range(total):
                     if map_id in delivered:
                         continue
-                    if uri is None:
+                    uri = current_uri(map_id)
+                    if not uri:
                         raise FetchFailedError(
                             None, shuffle_id, map_id, reduce_id,
                             "missing map output location")
@@ -156,6 +179,22 @@ class ShuffleFetcher:
                         local_ids.append(map_id)
                     else:
                         by_server.setdefault(uri, []).append(map_id)
+
+                # Slow-server escape hatch: a server whose every assigned
+                # bucket still has an untried replica behind it runs its
+                # get_many round under the fetch_slow_server_s deadline
+                # with no in-place retries — unresponsiveness escalates in
+                # deadline seconds and the tail fails over below, instead
+                # of gating this reduce task on the slowest source. A
+                # server holding any UNREPLICATED bucket keeps the patient
+                # fetch_retries behavior (failing it over is impossible,
+                # so escalating early would only burn a stage resubmit).
+                deadline_for = {
+                    uri: (slow_s if slow_s and batched
+                          and all(replicas_behind(m) for m in ids)
+                          else None)
+                    for uri, ids in by_server.items()
+                }
 
                 failures: List[FetchFailedError] = []
                 threads: List[threading.Thread] = []
@@ -209,7 +248,8 @@ class ShuffleFetcher:
                                 if batched:
                                     rts = fetch_many_remote(
                                         uri, shuffle_id, ids, reduce_id,
-                                        deliver)
+                                        deliver,
+                                        deadline_s=deadline_for.get(uri))
                                 else:
                                     rts = 0
                                     for m in ids:
@@ -262,7 +302,7 @@ class ShuffleFetcher:
                     if data is None:
                         with counter_lock:
                             failures.append(FetchFailedError(
-                                uris[map_id], shuffle_id, map_id,
+                                current_uri(map_id), shuffle_id, map_id,
                                 reduce_id,
                                 "bucket missing from local store"))
                         continue
@@ -309,6 +349,46 @@ class ShuffleFetcher:
 
                 if not failures:
                     break
+                # -- replica failover first (shuffle_replication > 1):
+                # every undelivered bucket whose current location just
+                # failed and that still has an untried replica moves its
+                # cursor forward — the next round re-requests only those
+                # buckets from the replicas, riding the same exactly-once
+                # delivery dedup. No stage resubmission, no map
+                # recompute, and the re-resolve budget stays unspent for
+                # a genuine total loss.
+                failed_uris = {f.server_uri for f in failures
+                               if f.server_uri}
+                moved: dict = {}  # from_uri -> buckets failed over
+                for map_id in range(total):
+                    if map_id in delivered:
+                        continue
+                    uri = current_uri(map_id)
+                    if uri in failed_uris and replicas_behind(map_id):
+                        cursor[map_id] += 1
+                        moved[uri] = moved.get(uri, 0) + 1
+                if moved:
+                    stats["failovers"] += len(moved)
+                    stats["failover_buckets"] += sum(moved.values())
+                    sink = getattr(env, "fetch_event_sink", None)
+                    for from_uri, n in moved.items():
+                        log.warning(
+                            "shuffle %d reduce %d: failing %d undelivered "
+                            "bucket(s) over from %s to replica locations",
+                            shuffle_id, reduce_id, n, from_uri)
+                        if sink is not None:
+                            try:
+                                from vega_tpu.scheduler.events import (
+                                    FetchFailedOver)
+
+                                sink(FetchFailedOver(
+                                    shuffle_id=shuffle_id,
+                                    reduce_id=reduce_id,
+                                    from_uri=from_uri, buckets=n))
+                            except Exception:  # noqa: BLE001 — observability must not break IO
+                                log.debug("failover event emit failed",
+                                          exc_info=True)
+                    continue
                 failure = failures[0]
                 if resolved_once:
                     raise failure  # fresher and no less actionable
@@ -322,8 +402,12 @@ class ShuffleFetcher:
                     # new locations register (or immediately when nothing
                     # was unregistered); the full 5s is only burned when
                     # recovery needs this very task's failure to start.
-                    uris = list(tracker.get_server_uris(shuffle_id,
-                                                        timeout=5.0))
+                    uri_lists = [list(lst) for lst in
+                                 tracker.get_server_uri_lists(shuffle_id,
+                                                              timeout=5.0)]
+                    # Fresh registry: restart every undelivered bucket at
+                    # its (possibly relocated) primary.
+                    cursor = [0] * total
                 except VegaError:
                     # Re-resolve timed out (the lost outputs have no new
                     # homes yet — only the scheduler's resubmit path
