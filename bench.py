@@ -570,6 +570,19 @@ def main():
         # the knob is attributable (benchmarks/straggler_ab.py is the
         # dedicated A/B).
         detail["speculation"] = ctx.metrics_summary().get("speculation", {})
+        # Job-server plane (PR 7): every bench action routes through the
+        # multi-job arbiter, so report the mode it ran under plus the
+        # job-level accounting (count / cancelled / failed tasks) — a run
+        # under scheduler_mode=fair or with concurrent tenants is
+        # attributable (benchmarks/multijob_ab.py is the dedicated
+        # fifo-vs-fair latency A/B).
+        _summary = ctx.metrics_summary()
+        detail["jobs"] = {
+            "scheduler_mode": ctx.job_server.scheduler_mode,
+            "jobs": _summary.get("jobs", 0),
+            "jobs_cancelled": _summary.get("jobs_cancelled", 0),
+            "task_failures": _summary.get("task_failures", 0),
+        }
         _leg_history_compare_and_append(detail)
         result = {
             "metric": "group_by+join rows/sec/chip (reduce_by_key(add) + "

@@ -7,6 +7,9 @@ Configs (BASELINE.md "Self-measured baseline plan", reference workloads):
   3. reduce_by_key count over parquet input    examples/parquet_column_read.rs
   4. cogroup + cartesian                       co_grouped_rdd.rs / cartesian_rdd.rs
   5. sort_by_key + take_ordered, i64 keys      rdd.rs take_ordered
+  6. cache spill round-trip                    (PR 1 tiered store)
+  7. multi-job short-job p50, fifo vs fair     (PR 7 job server; host_s =
+     fifo p50, device_s = fair p50 — CPU-only, see config docstring)
 
 Prints ONE JSON line per config:
   {"config": N, "name": ..., "rows": ..., "host_s": ..., "device_s": ...,
@@ -270,6 +273,26 @@ def config6_spill_roundtrip(ctx, scale, bank=None):
         rdd.unpersist()
 
 
+def config7_multijob_latency(ctx, scale=1.0, bank=None):
+    """PR 7 job server: short-job p50 submit->done latency with one long
+    batch job saturating the fleet, scheduler_mode=fifo (the reference-
+    shaped global-order dispatch) vs fair (weighted pool shares). Reuses
+    benchmarks/multijob_ab.py's interleaved solo/fifo/fair legs (medians
+    of 3, results asserted identical across legs). Reported through the
+    standard columns: host_s = fifo p50, device_s = fair p50, so
+    device_vs_host reads as the fair-scheduling latency win. Pure
+    sleep-bound scheduling work — no device leg, excluded from the
+    TPU-window default config set."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from multijob_ab import run_legs
+
+    n_long = max(16, int(64 * scale))
+    out = run_legs(ctx, n_long, 6)
+    if bank:
+        bank(n_long, out["fair_short_p50_s"])
+    return n_long, out["fifo_short_p50_s"], out["fair_short_p50_s"]
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -278,6 +301,7 @@ CONFIGS = {
     5: ("sort_by_key + take_ordered i64", config5_sort_take),
     6: ("cache spill round-trip (recompute vs spilled read)",
         config6_spill_roundtrip),
+    7: ("multi-job short-job p50, fifo vs fair", config7_multijob_latency),
 }
 
 
@@ -344,7 +368,11 @@ def _fetch_delta(before: dict, after: dict) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
-    ap.add_argument("--configs", type=str, default="1,2,3,4,5,6")
+    # Config 7 (multi-job fifo-vs-fair) runs by default on CPU but stays
+    # out of run_configs' default tuple: the TPU capture (tpu_capture.py
+    # phase 5) uses that default, and a scarce tunnel window should not
+    # spend ~20s on sleep-bound scheduling legs with no device relevance.
+    ap.add_argument("--configs", type=str, default="1,2,3,4,5,6,7")
     args = ap.parse_args()
 
     # Same tunnel-wedge protection bench.py carries: standalone runs in

@@ -5,7 +5,12 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+# The sync-witness run (VEGA_TPU_DEBUG_SYNC=1) adds per-acquisition
+# bookkeeping to every named lock in the hot task path; it is the
+# correctness double-check, not the timing gate, so it gets headroom.
+budget=870
+[ "${VEGA_TPU_DEBUG_SYNC:-0}" = "1" ] && budget=1500
+timeout -k 10 "$budget" env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 # Invariant gate: tier-1 is only green if vegalint is clean too

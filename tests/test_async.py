@@ -1,13 +1,25 @@
-"""Runtime-reentrancy tests (reference: tests/test_async.rs — the same job
-under a pre-existing tokio runtime and under async-std, validating
-Env::run_in_async_rt). The Python analogues: jobs driven from inside an
-asyncio event loop and from multiple concurrent driver threads (the
-scheduler's job lock serializes them without deadlock)."""
+"""Async/concurrent-job tests.
+
+Part 1 — runtime reentrancy (reference: tests/test_async.rs — the same
+job under a pre-existing tokio runtime and under async-std, validating
+Env::run_in_async_rt): jobs driven from inside an asyncio event loop and
+from multiple concurrent driver threads.
+
+Part 2 — the PR 7 job server (scheduler/jobserver.py): the *_async()
+actions and JobFuture protocol, genuine wall-clock overlap between
+concurrently submitted jobs (the reference serializes every action on one
+scheduler_lock, distributed_scheduler.rs:183-187 — these tests prove
+vega_tpu does not), shared-lineage stage ownership, fair-scheduler pool
+quotas, per-job event scoping, cancellation, and failure isolation."""
 
 import asyncio
 import threading
+import time
+
+import pytest
 
 import vega_tpu as v
+from vega_tpu.scheduler import events as ev
 
 
 def test_jobs_from_asyncio_event_loop(ctx):
@@ -57,3 +69,266 @@ def test_nested_job_from_action(ctx):
     random.Random(0).shuffle(data)
     assert ctx.parallelize(data, 4).sort_by_key(num_partitions=3).collect() \
         == sorted(data)
+
+
+# ---------------------------------------------------------------------------
+# Job server (PR 7): async actions, overlap, pools, scoping, cancellation
+# ---------------------------------------------------------------------------
+
+class _Recorder:
+    """Bus listener capturing scheduler events with their post times."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def on_event(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def of(self, kind):
+        with self._lock:
+            return [e for e in self.events if isinstance(e, kind)]
+
+
+def test_async_actions_match_blocking(ctx):
+    """collect_async/count_async/reduce_async return JobFutures whose
+    results are bit-identical to the blocking actions, and the future
+    protocol (done/exception/add_done_callback) behaves."""
+    rdd = ctx.make_rdd(list(range(257)), 4).map(lambda x: x * 3)
+    fc = rdd.collect_async()
+    fn = rdd.count_async()
+    fr = rdd.reduce_async(lambda a, b: a + b)
+    assert fc.result(30) == rdd.collect()
+    assert fn.result(30) == rdd.count() == 257
+    assert fr.result(30) == rdd.reduce(lambda a, b: a + b)
+    assert fc.done() and not fc.cancelled() and fc.exception(1) is None
+    fired = []
+    fc.add_done_callback(fired.append)  # already done -> fires inline
+    assert fired == [fc]
+    # Empty-RDD reduce surfaces VegaError through the future, not a hang.
+    empty = ctx.make_rdd([1, 2], 2).filter(lambda x: x > 9)
+    assert isinstance(empty.reduce_async(lambda a, b: a + b).exception(30),
+                      v.VegaError)
+
+
+def test_concurrent_jobs_overlap_wallclock(ctx):
+    """The tentpole acceptance: N driver threads submitting overlapping
+    jobs — two sharing one shuffle lineage, two disjoint — interleave in
+    wall-clock under the fair scheduler (every pair of job windows
+    overlaps), produce bit-identical results to serial execution, the
+    shared map stage is computed exactly once, and the tracker serves a
+    follow-up job sanely."""
+    ctx.job_server.set_scheduler_mode("fair")
+    rec = _Recorder()
+    ctx.bus.add_listener(rec)
+
+    def slow_ident(kv):
+        time.sleep(0.1)
+        return kv
+
+    base = ctx.parallelize([(i % 4, 1) for i in range(64)], 4).map(slow_ident)
+    reduced = base.reduce_by_key(lambda a, b: a + b, 2)
+
+    def slow_mul(x):
+        time.sleep(0.1)
+        return x * 2
+
+    disjoint_a = ctx.make_rdd(list(range(40)), 4).map(slow_mul)
+    disjoint_b = ctx.make_rdd(list(range(40)), 4).map(lambda x: x + 1)
+
+    jobs = {
+        "shared-collect": lambda: sorted(reduced.collect()),
+        "shared-mapped": lambda: sorted(
+            reduced.map(lambda kv: (kv[0], kv[1] * 10)).collect()),
+        "disjoint-a": disjoint_a.collect,
+        "disjoint-b": lambda: sorted(disjoint_b.collect()),
+    }
+    results, errors = {}, []
+    barrier = threading.Barrier(len(jobs))
+
+    def drive(name, action):
+        try:
+            # Thread-local pool selection tags this thread's JobStart with
+            # the pool name — the per-job window key below.
+            ctx.set_local_property("pool", name)
+            barrier.wait(timeout=30)
+            results[name] = action()
+        except Exception as exc:  # noqa: BLE001
+            errors.append((name, exc))
+
+    threads = [threading.Thread(target=drive, args=item, daemon=True)
+               for item in jobs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads)
+    assert not errors
+
+    # Bit-identical vs serial: fresh identical lineages run one at a time.
+    serial_base = ctx.parallelize([(i % 4, 1) for i in range(64)], 4)
+    serial_reduced = serial_base.reduce_by_key(lambda a, b: a + b, 2)
+    assert results["shared-collect"] == sorted(serial_reduced.collect())
+    assert results["shared-mapped"] == sorted(
+        serial_reduced.map(lambda kv: (kv[0], kv[1] * 10)).collect())
+    assert results["disjoint-a"] == [x * 2 for x in range(40)]
+    assert results["disjoint-b"] == sorted(x + 1 for x in range(40))
+
+    assert ctx.bus.flush()
+    # Wall-clock overlap: every pair of the four concurrent jobs'
+    # [JobStart, JobEnd] windows intersects (each job sleeps >= 0.2s of
+    # task time; submission was barrier-aligned).
+    starts = {e.pool: e.time for e in rec.of(ev.JobStart)
+              if e.pool in jobs}
+    # JobEnd carries no pool; map back through job_id via JobStart.
+    job_pool = {e.job_id: e.pool for e in rec.of(ev.JobStart)
+                if e.pool in jobs}
+    ends = {}
+    for e in rec.of(ev.JobEnd):
+        pool = job_pool.get(e.job_id)
+        if pool is not None:
+            ends[pool] = e.time
+    assert set(starts) == set(jobs) and set(ends) == set(jobs)
+    names = sorted(jobs)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            assert starts[a] < ends[b] and starts[b] < ends[a], \
+                f"jobs {a} and {b} did not overlap in wall-clock"
+
+    # The shared map stage was submitted (and its 4 tasks run) exactly
+    # once across both jobs — the stage-ownership handshake, not a
+    # double-compute. The serial re-run adds its own distinct shuffle.
+    shared_shuffle = [e for e in rec.of(ev.StageSubmitted)
+                      if e.is_shuffle_map]
+    by_stage = {}
+    for e in shared_shuffle:
+        by_stage[e.stage_id] = by_stage.get(e.stage_id, 0) + e.num_tasks
+    assert all(n == 4 for n in by_stage.values()), by_stage
+
+    # Tracker sane for a follow-up job: the cached shuffle still serves,
+    # and a brand-new shuffle lineage works.
+    assert sorted(reduced.collect()) == results["shared-collect"]
+    follow = ctx.parallelize([(i % 3, i) for i in range(30)], 3) \
+        .group_by_key(2).map(lambda kv: (kv[0], sum(kv[1]))).collect()
+    assert sorted(follow) == sorted(
+        (k, sum(i for i in range(30) if i % 3 == k)) for k in range(3))
+
+
+def test_pool_quota_caps_inflight(ctx):
+    """A pool's max_concurrent_tasks is a hard in-flight cap: a 4-worker
+    backend never runs more than 1 task of the quota-1 pool at once."""
+    ctx.set_pool("tenant", weight=1, max_concurrent_tasks=1)
+    gauge = {"now": 0, "max": 0}
+    lock = threading.Lock()
+
+    def tracked(x):
+        with lock:
+            gauge["now"] += 1
+            gauge["max"] = max(gauge["max"], gauge["now"])
+        time.sleep(0.05)
+        with lock:
+            gauge["now"] -= 1
+        return x
+
+    rdd = ctx.make_rdd(list(range(8)), 8).map(tracked)
+    future = ctx.submit_job(rdd, lambda _tc, it: list(it), pool="tenant")
+    assert sorted(sum(future.result(60), [])) == list(range(8))
+    assert gauge["max"] == 1, gauge
+
+
+def test_per_job_event_scoping(ctx):
+    """A per-job listener observes ONLY its job's events, and
+    MetricsListener.job_summary keeps per-tenant task counts apart."""
+    rec = _Recorder()
+    slow = ctx.make_rdd(list(range(12)), 4).map(
+        lambda x: (time.sleep(0.05), x)[1])
+    other = ctx.make_rdd(list(range(6)), 3)
+    fut = slow.collect_async()
+    ctx.bus.add_job_listener(fut.job_id, rec)
+    other_fut = other.count_async()
+    assert fut.result(60) == list(range(12))
+    assert other_fut.result(60) == 6
+    assert ctx.bus.flush()
+    assert rec.events, "per-job listener saw nothing"
+    assert all(getattr(e, "job_id", fut.job_id) == fut.job_id
+               for e in rec.events)
+    ctx.bus.remove_job_listener(fut.job_id, rec)
+    mine = ctx.metrics.job_summary(fut.job_id)
+    theirs = ctx.metrics.job_summary(other_fut.job_id)
+    assert mine["tasks"] == 4 and theirs["tasks"] == 3
+    assert mine["succeeded"] and theirs["succeeded"]
+
+
+def test_failed_job_does_not_poison_concurrent_job(ctx):
+    """Failure isolation: a job whose tasks exhaust max_failures fails
+    ITS future; an unrelated concurrent job completes untouched."""
+    def boom(x):
+        raise ValueError("tenant bug")
+
+    bad = ctx.make_rdd(list(range(8)), 4).map(boom)
+    good = ctx.make_rdd(list(range(200)), 4).map(
+        lambda x: (time.sleep(0.02), x * 2)[1])
+    bad_fut = bad.collect_async()
+    good_fut = good.collect_async()
+    assert good_fut.result(60) == [x * 2 for x in range(200)]
+    exc = bad_fut.exception(60)
+    assert isinstance(exc, v.TaskError)
+    with pytest.raises(v.TaskError):
+        bad_fut.result(1)
+    # The fleet is still healthy for a fresh job.
+    assert ctx.make_rdd(list(range(10)), 2).count() == 10
+
+
+def test_cancel_multistage_job_fleet_reusable(ctx):
+    """Acceptance: JobFuture.cancel() on a running multi-stage job stops
+    its work and leaves the fleet fully reusable — no leaked queued or
+    in-flight arbiter entries, no leaked stage ownership/user refs, and a
+    fresh job over the SAME lineage completes correctly."""
+    def slow_pair(i):
+        time.sleep(0.25)
+        return (i % 4, i)
+
+    lineage = ctx.make_rdd(list(range(16)), 8).map(slow_pair) \
+        .reduce_by_key(lambda a, b: a + b, 4)
+    fut = lineage.collect_async()
+    time.sleep(0.4)  # mid map stage
+    assert fut.cancel()
+    assert isinstance(fut.exception(30), v.CancelledError)
+    assert fut.cancelled()
+    assert not fut.cancel(), "cancel on a settled future must return False"
+
+    # The arbiter drains: cancelled job's queued tasks were purged and
+    # in-flight ones complete into a dead queue; nothing leaks.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = ctx.job_server.arbiter.stats()
+        if st["running"] == 0 and st["queued"] == 0:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"arbiter did not drain: {st}")
+    sched = ctx.scheduler
+    assert not sched._stage_owners and not sched._stage_users
+
+    # Fresh jobs — same lineage and a disjoint one — run correctly.
+    expect = {k: sum(i for i in range(16) if i % 4 == k) for k in range(4)}
+    assert dict(lineage.collect()) == expect
+    assert ctx.make_rdd(list(range(64)), 4).map(lambda x: x * x).count() == 64
+    assert ctx.metrics.jobs_cancelled >= 1
+
+
+def test_context_stop_settles_parked_futures():
+    """The DAGScheduler.stop() satellite: stopping the context with a job
+    in flight cancels it and settles its future crisply — a caller parked
+    in result() unparks with CancelledError instead of waiting forever."""
+    ctx = v.Context("local", num_workers=4)
+    try:
+        slow = ctx.make_rdd(list(range(8)), 8).map(
+            lambda x: (time.sleep(0.5), x)[1])
+        fut = slow.collect_async()
+        time.sleep(0.3)
+        ctx.stop()
+        assert isinstance(fut.exception(10), v.CancelledError)
+    finally:
+        ctx.stop()

@@ -608,3 +608,118 @@ def test_original_wins_and_cancel_races_completion(monkeypatch):
                     .collect()) == got
     finally:
         ctx.stop()
+
+
+# ---------------------------------------------------------------- PR 7:
+# the concurrent-job plane under faults — executor loss must recover EVERY
+# running job (not one singleton _active_job), and cancellation mid-stage
+# must leave the fleet reusable.
+
+
+def test_executor_killed_while_two_jobs_run_concurrently(
+        monkeypatch, tmp_path):
+    """Tentpole acceptance: SIGKILL one of 2 workers while TWO jobs with
+    disjoint shuffle lineages are mid-flight. _on_executor_lost fails the
+    affected stages of BOTH running jobs (pre-PR-7 only the singleton
+    _active_job recovered; the concurrent tenant stalled until timeouts
+    burned max_failures) — both futures complete with results identical
+    to a fault-free run."""
+    ctx = _chaos_context()
+    try:
+        expected_a = sorted(
+            ctx.parallelize([(i % 5, i) for i in range(40)], 8)
+            .reduce_by_key(lambda a, b: a + b, 4).collect())
+        expected_b = sorted(
+            ctx.parallelize(list(range(60)), 8).map(lambda x: (x % 3, 1))
+            .reduce_by_key(lambda a, b: a + b, 3).collect())
+    finally:
+        ctx.stop()
+
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_KILL_AFTER_TASKS", "3")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context()
+    try:
+        # Sleepy map tasks (locally-defined: cloudpickle ships them by
+        # value — a module-level test helper would need the workers to
+        # import test_chaos) keep both jobs mid-map-stage when the third
+        # dispatched task SIGKILLs exec-0.
+        def slow_pair_a(x):
+            time.sleep(0.15)
+            return (x % 5, x)
+
+        def slow_pair_b(x):
+            time.sleep(0.15)
+            return (x % 3, 1)
+
+        rdd_a = ctx.parallelize(list(range(40)), 8).map(slow_pair_a) \
+            .reduce_by_key(lambda a, b: a + b, 4)
+        rdd_b = ctx.parallelize(list(range(60)), 8).map(slow_pair_b) \
+            .reduce_by_key(lambda a, b: a + b, 3)
+        fut_a = rdd_a.collect_async()
+        fut_b = rdd_b.collect_async()
+        assert sorted(fut_a.result(120)) == expected_a
+        assert sorted(fut_b.result(120)) == expected_b
+        kills = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "kill_worker"]
+        assert kills, "the injected SIGKILL never fired"
+        assert ctx.metrics_summary()["executors_lost"] >= 1
+        # The fleet keeps serving a third, fresh job.
+        assert ctx.parallelize(list(range(20)), 4).count() == 20
+    finally:
+        ctx.stop()
+
+
+def test_cancel_mid_stage_leaves_distributed_fleet_reusable():
+    """Acceptance: JobFuture.cancel() on a running multi-stage job over
+    the REAL executor fleet — cancel_task protocol messages fire at the
+    in-flight attempts, queued tasks are purged, the released stage
+    binary drops its payload, and a fresh job (same lineage and disjoint)
+    completes with correct results. A cancel must not look like a fault:
+    no executor loss, no stage resubmission."""
+    ctx = _chaos_context()
+    try:
+        def slower_pair(x):
+            time.sleep(0.5)
+            return (x % 5, x)
+
+        lineage = ctx.parallelize(list(range(32)), 8).map(slower_pair) \
+            .reduce_by_key(lambda a, b: a + b, 4)
+        fut = lineage.collect_async()
+        time.sleep(0.6)  # mid map stage (8 x 0.5s tasks, parallelism 4)
+        assert fut.cancel()
+        assert isinstance(fut.exception(60), v.CancelledError)
+
+        # Arbiter fully drained: no leaked queued or in-flight attempts.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = ctx.job_server.arbiter.stats()
+            if st["running"] == 0 and st["queued"] == 0:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"arbiter did not drain: {st}")
+        assert not ctx.scheduler._stage_owners
+        assert not ctx.scheduler._stage_users
+        # The cancelled job was the map stage's only user: its serialized
+        # payload was released (the live refs stay for lazy re-pickle).
+        shuffle_id = lineage.shuffle_id
+        stage = ctx.scheduler._shuffle_to_map_stage[shuffle_id]
+        assert stage.task_binary is not None
+        assert stage.task_binary._frozen is None, \
+            "cancelled job's stage binary payload was not released"
+
+        # Fresh jobs: the SAME lineage completes correctly (binary lazily
+        # re-serialized), and a disjoint one too.
+        expect = {k: sum(i for i in range(32) if i % 5 == k)
+                  for k in range(5)}
+        assert dict(lineage.collect()) == expect
+        assert ctx.parallelize(list(range(50)), 4).count() == 50
+        summary = ctx.metrics_summary()
+        assert summary["executors_lost"] == 0, \
+            "a cancel must not be mistaken for executor failure"
+        assert summary["jobs_cancelled"] >= 1
+    finally:
+        ctx.stop()

@@ -1,4 +1,4 @@
-"""vegalint self-tests: every rule VG001–VG007 fires on its fixture and
+"""vegalint self-tests: every rule VG001–VG008 fires on its fixture and
 stays silent on the corrected form; pragma suppression requires a
 justification; reporters stay machine-readable; and the runtime
 sync-witness (the dynamic half of VG003) catches inversions a static
@@ -377,6 +377,45 @@ def test_vg007_silent_on_local_pool_or_timeout(tmp_path):
                 return fut.result(timeout=conf.poll_timeout_s)
         """, select=["VG007"])
     assert not res.findings
+
+
+# ---------------------------------------------------------------- VG008
+def test_vg008_fires_on_direct_scheduler_entry(tmp_path):
+    res = _lint(tmp_path, "vega_tpu/tpu/newplane.py", """\
+        def run_now(self, rdd, func):
+            return self.scheduler.run_job(rdd, func)
+
+        def run_listener(scheduler, rdd, func, parts, cb):
+            return scheduler.run_job_with_listener(rdd, func, parts, cb)
+
+        def run_inner(self, rdd, func, parts):
+            return self.sched._run_job_inner(rdd, func, parts, None)
+        """, select=["VG008"])
+    assert _rules(res) == ["VG008", "VG008", "VG008"]
+    assert "job server" in res.findings[0].message
+
+
+def test_vg008_silent_on_context_facade_and_allowed_files(tmp_path):
+    # Context.run_job (the facade that DOES route through the job server)
+    # stays legal everywhere.
+    res = _lint(tmp_path, "vega_tpu/tpu/newplane.py", """\
+        def run_via_facade(ctx, rdd, func):
+            return ctx.run_job(rdd, func)
+
+        def run_via_context_attr(self, rdd, func):
+            return self.context.run_job(rdd, func)
+        """, select=["VG008"])
+    assert not res.findings
+    # The allowed locations themselves: the facade, the rdd actions, and
+    # the job server may touch the scheduler entries directly.
+    for allowed in ("vega_tpu/context.py", "vega_tpu/rdd/newact.py",
+                    "vega_tpu/scheduler/jobserver.py"):
+        res = _lint(tmp_path, allowed, """\
+            def drive(self, rdd, func, parts, job):
+                return self.scheduler._run_job_inner(rdd, func, parts,
+                                                     None, job=job)
+            """, select=["VG008"])
+        assert not res.findings, allowed
 
 
 # ------------------------------------------------------------- pragmas

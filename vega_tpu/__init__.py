@@ -14,6 +14,7 @@ from vega_tpu.aggregator import Aggregator
 from vega_tpu.context import Context
 from vega_tpu.env import Configuration, DeploymentMode, Env
 from vega_tpu.errors import (
+    CancelledError,
     FetchFailedError,
     NetworkError,
     PartialJobError,
@@ -21,6 +22,7 @@ from vega_tpu.errors import (
     TaskError,
     VegaError,
 )
+from vega_tpu.scheduler.jobserver import JobFuture
 from vega_tpu.partial.bounded_double import BoundedDouble
 from vega_tpu.partial.partial_result import PartialResult
 from vega_tpu.partitioner import HashPartitioner, Partitioner, RangePartitioner
@@ -49,12 +51,14 @@ def __dir__():
 __all__ = [
     "Aggregator",
     "BoundedDouble",
+    "CancelledError",
     "Configuration",
     "Context",
     "DeploymentMode",
     "Env",
     "FetchFailedError",
     "HashPartitioner",
+    "JobFuture",
     "NetworkError",
     "PartialJobError",
     "PartialResult",

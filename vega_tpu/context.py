@@ -24,6 +24,7 @@ from vega_tpu.partial.partial_result import PartialResult
 from vega_tpu.rdd.base import RDD
 from vega_tpu.scheduler.dag import DAGScheduler
 from vega_tpu.scheduler.events import LiveListenerBus, MetricsListener
+from vega_tpu.scheduler.jobserver import JobFuture, JobServer
 from vega_tpu.scheduler.local_backend import LocalBackend
 
 log = logging.getLogger("vega_tpu")
@@ -120,6 +121,15 @@ class Context:
 
                 self._backend = DistributedBackend(conf)
             self.scheduler = DAGScheduler(self._backend, self.bus)
+            # Multi-job front door (scheduler/jobserver.py): every action
+            # — blocking or async — routes through it, so fair-scheduling
+            # pools, quotas, and cancellation apply uniformly. Jobs run
+            # concurrently, each on its own event-loop thread.
+            self.job_server = JobServer(self.scheduler, conf)
+            # Thread-local submission properties (Spark's
+            # setLocalProperty): "pool" selects the scheduling pool for
+            # jobs submitted from this thread.
+            self._local_props = threading.local()
             # Attach last: a failed backend init must not leak a file
             # handler on the process-global logger.
             from vega_tpu.env import attach_session_logger
@@ -266,55 +276,101 @@ class Context:
         return Broadcast(self, value)
 
     # ------------------------------------------------------------------ jobs
+    def set_local_property(self, key: str, value) -> None:
+        """Thread-local job-submission property (Spark parity). The one
+        the scheduler reads is ``"pool"``: jobs submitted from this
+        thread land in that fair-scheduling pool. ``None`` clears."""
+        props = getattr(self._local_props, "props", None)
+        if props is None:
+            props = self._local_props.props = {}
+        if value is None:
+            props.pop(key, None)
+        else:
+            props[key] = value
+
+    def get_local_property(self, key: str, default=None):
+        props = getattr(self._local_props, "props", None)
+        return default if props is None else props.get(key, default)
+
+    def set_pool(self, name: str, weight: int = 1,
+                 max_concurrent_tasks: Optional[int] = None):
+        """Declare/configure a scheduling pool (weight skews the fair
+        share; max_concurrent_tasks is a hard per-pool in-flight quota).
+        Select it per thread with ``set_local_property("pool", name)`` or
+        per job with ``submit_job(..., pool=name)``."""
+        return self.job_server.set_pool(name, weight, max_concurrent_tasks)
+
+    def submit_job(self, rdd: RDD, func: Callable,
+                   partitions: Optional[List[int]] = None,
+                   pool: Optional[str] = None,
+                   transform: Optional[Callable[[list], Any]] = None
+                   ) -> JobFuture:
+        """Asynchronous job submission: returns a JobFuture immediately;
+        the job runs on its own event-loop thread, concurrently with any
+        other in-flight jobs, arbitrated by the fair scheduler. `func`
+        runs per partition; `transform` (optional) folds the list of
+        partition results into the future's final value."""
+        self._check_alive()
+        if pool is None:
+            pool = self.get_local_property("pool")
+        return self.job_server.submit(rdd, func, partitions, pool=pool,
+                                      transform=transform)
+
     def run_job(self, rdd: RDD, func: Callable,
                 partitions: Optional[List[int]] = None) -> list:
-        """Reference: context.rs:457-473."""
+        """Reference: context.rs:457-473. Blocking actions are submit +
+        result() on the job server, so pools/quotas/cancellation apply to
+        them exactly as to async submissions."""
         self._check_alive()
-        return self.scheduler.run_job(rdd, func, partitions)
+        if partitions is not None and not partitions:
+            return []
+        future = self.submit_job(rdd, func, partitions)
+        try:
+            return future.result()
+        except BaseException:
+            # The calling thread is unwinding — KeyboardInterrupt in a
+            # REPL, most commonly. Pre-PR-7 the event loop ran on THIS
+            # thread, so the job died with its caller; preserve that by
+            # cancelling the would-be-orphaned job instead of leaving it
+            # holding arbiter slots and pool quota to completion. A
+            # no-op when the exception IS the job's own error re-raise
+            # (the future is already settled; cancel returns False).
+            future.cancel("blocking caller interrupted")
+            raise
 
     def run_approximate_job(self, rdd: RDD, func: Callable, evaluator,
                             timeout_s: float) -> PartialResult:
         """Reference: context.rs:510-524 + approximate_action_listener.rs."""
         self._check_alive()
-        done = threading.Event()
-        failure: List[BaseException] = []
-
-        def runner():
-            try:
-                self.scheduler.run_job_with_listener(
-                    rdd, func, list(range(rdd.num_partitions)), evaluator.merge
-                )
-            except BaseException as exc:  # noqa: BLE001
-                failure.append(exc)
-            finally:
-                done.set()
-
-        thread = threading.Thread(target=runner, name="approx-job", daemon=True)
+        future = self.job_server.submit(
+            rdd, func, list(range(rdd.num_partitions)),
+            pool=self.get_local_property("pool"),
+            on_task_success=evaluator.merge,
+        )
         start = time.time()
-        thread.start()
-        finished = done.wait(timeout_s)
-        if finished and not failure:
-            value = evaluator.current_result()
-            log.debug("approximate job finished in %.3fs", time.time() - start)
-            return PartialResult(value, is_final=True)
-        if finished and failure:
-            result: PartialResult = PartialResult(None, is_final=False)
-            result.set_failure(failure[0])
+        try:
+            future.result(timeout_s)
+        except TimeoutError:
+            # Deadline hit: return the current estimate, deliver the final
+            # value when the background job drains (reference:
+            # approximate_action_listener.rs:58-111).
+            result = PartialResult(evaluator.current_result(), is_final=False)
+
+            def finisher(fut: JobFuture):
+                exc = fut.exception()
+                if exc is not None:
+                    result.set_failure(exc)
+                else:
+                    result.set_final_value(evaluator.current_result())
+
+            future.add_done_callback(finisher)
             return result
-        # Deadline hit: return the current estimate, deliver the final value
-        # when the background job drains (reference:
-        # approximate_action_listener.rs:58-111).
-        result = PartialResult(evaluator.current_result(), is_final=False)
-
-        def finisher():
-            thread.join()
-            if failure:
-                result.set_failure(failure[0])
-            else:
-                result.set_final_value(evaluator.current_result())
-
-        threading.Thread(target=finisher, daemon=True).start()
-        return result
+        except BaseException as exc:  # noqa: BLE001 — folded into the result
+            result = PartialResult(None, is_final=False)
+            result.set_failure(exc)
+            return result
+        log.debug("approximate job finished in %.3fs", time.time() - start)
+        return PartialResult(evaluator.current_result(), is_final=True)
 
     # ----------------------------------------------------------------- admin
     @property
@@ -342,6 +398,10 @@ class Context:
         if self._stopped:
             return
         self._stopped = True
+        # Wind the job plane down first: cancel in-flight jobs and settle
+        # their futures (nobody stays parked on result()) BEFORE the
+        # backend and stores those jobs might still be touching go away.
+        self.job_server.stop()
         self.scheduler.stop()
         env = Env.get()
         env.shuffle_store.close()  # clears both tiers + removes spill dir

@@ -737,13 +737,15 @@ class DistributedBackend(TaskBackend):
                         callback(TaskEndEvent(task=task, success=True,
                                               result=result,
                                               duration_s=duration,
-                                              dispatch=stats))
+                                              dispatch=stats,
+                                              executor=executor.executor_id))
                     else:
                         exc, remote_tb = rest
                         if not isinstance(exc, BaseException):
                             exc = TaskError(repr(exc), remote_traceback=remote_tb)
                         callback(TaskEndEvent(task=task, success=False,
-                                              error=exc, dispatch=stats))
+                                              error=exc, dispatch=stats,
+                                              executor=executor.executor_id))
                     return
                 except NetworkError as e:
                     # Executor lost: mark dead, re-dispatch elsewhere

@@ -72,6 +72,14 @@ class Configuration:
     # Max task retries before failing the job (reference plumbs max_failures
     # but never enforces it, local_scheduler.rs:29,57 — we enforce it).
     max_failures: int = 4
+    # Multi-job task arbitration (scheduler/jobserver.py): "fifo"
+    # dispatches ready tasks of all concurrent jobs in global submission
+    # order (the reference's effective behavior — one long job's backlog
+    # gates every later job); "fair" shares backend slots across pools by
+    # weight, and across jobs within a pool by fewest-running-first, so
+    # short interactive jobs are not starved by a long batch job.
+    # Switchable at runtime via ctx.job_server.set_scheduler_mode(...).
+    scheduler_mode: str = "fifo"
     # --- executor fault tolerance (distributed mode) ---
     # Worker -> driver heartbeat period. Must be well under
     # executor_liveness_timeout_s or healthy workers get reaped.
@@ -217,7 +225,8 @@ class Configuration:
             cfg.deployment_mode = DeploymentMode(env[pref + "DEPLOYMENT_MODE"])
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
                      "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
-                     "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR"):
+                     "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR",
+                     "SCHEDULER_MODE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG007: the project invariants as AST checks.
+"""vegalint rules VG001–VG008: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -709,3 +709,64 @@ def vg007(ctx: FileCtx) -> Iterator[Finding]:
                 f"shared executor '{submits[0][2]}' — on the 1-thread-"
                 "per-task pool this starves (task waits on work queued "
                 "behind itself); drain a locally-created pool instead")
+
+
+# ---------------------------------------------------------------------------
+# VG008 — DAG scheduler job entries must route through the job server
+# ---------------------------------------------------------------------------
+# Since PR 7 every job — blocking or async — goes through
+# scheduler/jobserver.py so fair-scheduling pools, per-pool quotas, and
+# cancellation apply uniformly. A direct DAGScheduler.run_job /
+# run_job_with_listener / _run_job_inner call anywhere else silently
+# bypasses the arbiter: that job's tasks go straight to the backend,
+# monopolizing slots no quota can reclaim. Allowed callers: context.py
+# (the public facade), rdd/ (actions call context.run_job — a Context
+# method, not the scheduler's), jobserver.py (the route itself), and
+# scheduler/dag.py (the implementation's own internals).
+
+_VG008_ALLOWED_SUFFIXES = (
+    "vega_tpu/context.py",
+    "vega_tpu/scheduler/dag.py",
+    "vega_tpu/scheduler/jobserver.py",
+)
+_VG008_ENTRIES = {"run_job", "run_job_with_listener"}
+
+
+@rule("VG008", "DAGScheduler job entry called outside the job-server route")
+def vg008(ctx: FileCtx) -> Iterator[Finding]:
+    if any(ctx.endswith(s) for s in _VG008_ALLOWED_SUFFIXES) \
+            or ctx.in_dir("vega_tpu", "rdd"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr == "_run_job_inner":
+            yield Finding(
+                "VG008", ctx.display, node.lineno, node.col_offset + 1,
+                "_run_job_inner is the job server's private entry — "
+                "submit through Context.submit_job/run_job so pools, "
+                "quotas and cancellation apply (docs/LINTING.md VG008)")
+            continue
+        if attr not in _VG008_ENTRIES:
+            continue
+        # Only scheduler-shaped receivers: `self.scheduler.run_job`,
+        # `ctx.scheduler.run_job`, a local named `scheduler`, or a direct
+        # `DAGScheduler(...)` construction. Context.run_job (the facade
+        # that DOES route through the server) stays legal everywhere.
+        recv = node.func.value
+        qual = (ctx.qualified(recv) or "").lower()
+        last = ""
+        if isinstance(recv, ast.Attribute):
+            last = recv.attr
+        elif isinstance(recv, ast.Name):
+            last = recv.id
+        ctor = _last_name(recv.func) if isinstance(recv, ast.Call) else None
+        if "scheduler" in qual or "scheduler" in last.lower() \
+                or ctor == "DAGScheduler":
+            yield Finding(
+                "VG008", ctx.display, node.lineno, node.col_offset + 1,
+                f"direct DAGScheduler.{attr} call bypasses the job "
+                "server (no pool/quota arbitration, no cancellation) — "
+                "route through Context.submit_job/run_job")

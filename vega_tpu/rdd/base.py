@@ -19,6 +19,7 @@ from typing import Any, Callable, Iterator, List, Optional, TYPE_CHECKING
 
 from vega_tpu.dependency import Dependency
 from vega_tpu.errors import VegaError
+from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.partitioner import Partitioner
 from vega_tpu.rdd.pair import PairOpsMixin
 from vega_tpu.split import Split
@@ -32,6 +33,46 @@ from vega_tpu.utils.random import (
 
 if TYPE_CHECKING:
     from vega_tpu.context import Context
+    from vega_tpu.scheduler.jobserver import JobFuture
+
+# Serializes the claim to materialize a checkpoint: concurrent jobs over
+# the same checkpoint-marked RDD must not both write it. Held only around
+# the flag flip, never across the materialization job itself (that job
+# runs on its own job-server thread — holding a lock across it would
+# deadlock the nested submission).
+_checkpoint_claim_lock = named_lock("rdd.base._checkpoint_claim_lock")
+
+
+def _collect_partition(_tc, it) -> list:
+    return list(it)
+
+
+def _count_partition(_tc, it) -> int:
+    return sum(1 for _ in it)
+
+
+def _reduce_plan(f: Callable):
+    """(per-partition fold, merge-of-partials) for reduce()/reduce_async():
+    empty partitions are skipped; an entirely empty RDD is an error,
+    matching Spark semantics (reference: rdd.rs:274-309)."""
+    _MISSING = _Sentinel
+
+    def reduce_partition(_tc, it):
+        acc = _MISSING
+        for x in it:
+            acc = x if acc is _MISSING else f(acc, x)
+        return acc
+
+    def merge(partials: list):
+        parts = [r for r in partials if r is not _MISSING]
+        if not parts:
+            raise VegaError("reduce() of empty RDD")
+        acc = parts[0]
+        for x in parts[1:]:
+            acc = f(acc, x)
+        return acc
+
+    return reduce_partition, merge
 
 
 class RDD(PairOpsMixin):
@@ -166,11 +207,18 @@ class RDD(PairOpsMixin):
             dep.rdd._do_checkpoint()
         if self._checkpoint_dir is None or self._checkpointed_rdd is not None:
             return
-        if getattr(self, "_checkpointing", False):
-            return  # the materialization job itself re-enters run_job
+        # Atomic claim: with concurrent jobs over the same checkpoint-
+        # marked RDD, exactly one materializes it. Losers proceed with
+        # the untruncated lineage (correct, just not yet truncated) —
+        # waiting here would deadlock the claimant's own nested write job
+        # when it re-enters this method.
+        with _checkpoint_claim_lock:
+            if self._checkpointed_rdd is not None \
+                    or getattr(self, "_checkpointing", False):
+                return  # claimed elsewhere / the write job re-entering
+            self._checkpointing = True
         from vega_tpu.rdd.checkpoint import CheckpointRDD
 
-        self._checkpointing = True
         try:
             self._checkpointed_rdd = CheckpointRDD.write(self, self._checkpoint_dir)
         finally:
@@ -366,37 +414,39 @@ class RDD(PairOpsMixin):
     # ----------------------------------------------------------------- actions
     def collect(self) -> list:
         """Reference: rdd.rs:420-434."""
-        results = self.context.run_job(self, lambda _tc, it: list(it))
+        results = self.context.run_job(self, _collect_partition)
         return list(itertools.chain.from_iterable(results))
+
+    def collect_async(self) -> "JobFuture":
+        """Async collect: returns a JobFuture immediately (result/
+        exception/cancel/done); the job runs concurrently with other
+        submitted jobs under the fair scheduler. `future.result()` is
+        bit-identical to `collect()`."""
+        return self.context.submit_job(
+            self, _collect_partition,
+            transform=lambda parts: list(itertools.chain.from_iterable(parts)),
+        )
 
     def count(self) -> int:
         """Reference: rdd.rs:436-448."""
-        return sum(
-            self.context.run_job(self, lambda _tc, it: sum(1 for _ in it))
-        )
+        return sum(self.context.run_job(self, _count_partition))
+
+    def count_async(self) -> "JobFuture":
+        """Async count — see collect_async."""
+        return self.context.submit_job(self, _count_partition, transform=sum)
 
     def reduce(self, f: Callable):
         """Reference: rdd.rs:274-309 (empty partitions skipped; empty RDD is
         an error, matching Spark semantics)."""
-        _MISSING = _Sentinel
+        reduce_partition, merge = _reduce_plan(f)
+        return merge(self.context.run_job(self, reduce_partition))
 
-        def reduce_partition(_tc, it):
-            acc = _MISSING
-            for x in it:
-                acc = x if acc is _MISSING else f(acc, x)
-            return acc
-
-        parts = [
-            r
-            for r in self.context.run_job(self, reduce_partition)
-            if r is not _MISSING
-        ]
-        if not parts:
-            raise VegaError("reduce() of empty RDD")
-        acc = parts[0]
-        for x in parts[1:]:
-            acc = f(acc, x)
-        return acc
+    def reduce_async(self, f: Callable) -> "JobFuture":
+        """Async reduce — see collect_async. An empty RDD surfaces
+        VegaError through `future.result()`/`future.exception()`."""
+        reduce_partition, merge = _reduce_plan(f)
+        return self.context.submit_job(self, reduce_partition,
+                                       transform=merge)
 
     def fold(self, zero, f: Callable):
         """Reference: rdd.rs:311-337."""

@@ -13,7 +13,16 @@ Improvements over the reference, each flagged inline:
   * FetchFailed is actually raised and recovered (cf. SURVEY.md §5 — the
     reference built the path but nothing emits it, and generic errors panic);
   * max_failures is enforced (plumbed-but-unused in the reference,
-    local_scheduler.rs:29,57).
+    local_scheduler.rs:29,57);
+  * CONCURRENT JOBS: the reference serializes every action behind one
+    scheduler_lock (distributed_scheduler.rs:183-187); vega_tpu runs one
+    event loop per job on its own thread (scheduler/jobserver.py spawns
+    them). Shared state — the cached map-stage registry, stage task
+    binaries, executor-loss recovery — is coordinated by _stages_lock
+    plus per-stage ownership: exactly one running job drives a shared
+    map stage's missing tasks at a time; other jobs needing it park the
+    dependent stage in their waiting set and poll availability on the
+    event-loop timeout (the same cadence the reference polled at).
 """
 
 from __future__ import annotations
@@ -26,7 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 from vega_tpu.dependency import NarrowDependency, ShuffleDependency
 from vega_tpu.env import Env
-from vega_tpu.errors import FetchFailedError, TaskError, VegaError
+from vega_tpu.errors import CancelledError, FetchFailedError, TaskError, VegaError
 from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.stage import Stage
 from vega_tpu.lint.sync_witness import named_lock
@@ -40,6 +49,32 @@ from vega_tpu.scheduler.task import (
 )
 
 log = logging.getLogger("vega_tpu")
+
+# Sentinel pushed into a job's event queue to wake its loop immediately
+# (cancellation, scheduler stop) instead of waiting out the poll timeout.
+_WAKE = object()
+
+
+def _lineage_shuffle_ids(rdd) -> Set[int]:
+    """Every shuffle_id reachable from `rdd`'s lineage (crossing shuffle
+    boundaries). Computed once per job BEFORE checkpoint truncation, so
+    it is a superset of what the job can still need — executor-loss
+    recovery uses it to decide which running jobs a lost map stage
+    affects, and a superset only risks a spare resubmission, never a
+    missed one."""
+    ids: Set[int] = set()
+    seen: Set[int] = set()
+    stack = [rdd]
+    while stack:
+        r = stack.pop()
+        if r.rdd_id in seen:
+            continue
+        seen.add(r.rdd_id)
+        for dep in r.get_dependencies():
+            if isinstance(dep, ShuffleDependency):
+                ids.add(dep.shuffle_id)
+            stack.append(dep.rdd)
+    return ids
 
 
 def _lineage_token(rdd) -> tuple:
@@ -87,9 +122,10 @@ class TaskBackend:
 
     def cancel_task(self, task_id: int) -> None:
         """Best-effort: ask whichever executor is running `task_id` to
-        abandon it (the losing copy of a speculated pair). Correctness
-        never depends on it — completions are deduped driver-side — so
-        the default is a no-op (local threads cannot be interrupted)."""
+        abandon it (the losing copy of a speculated pair, or an attempt
+        of a cancelled job). Correctness never depends on it —
+        completions are deduped driver-side — so the default is a no-op
+        (local threads cannot be interrupted)."""
 
     def stop(self) -> None:
         pass
@@ -100,16 +136,24 @@ class TaskBackend:
 
 
 class _Job:
-    """Per-job state (reference: scheduler/job.rs:49-97)."""
+    """Per-job state (reference: scheduler/job.rs:49-97).
+
+    Every field here is touched only by this job's own event-loop thread,
+    with two narrow exceptions read/written cross-thread: the reaper's
+    executor-loss callback adds to `failed` (sets are mutated, readers
+    snapshot), and cancellation flips `cancel_requested` + pushes _WAKE
+    into `event_queue` (both GIL-atomic)."""
 
     _ids = itertools.count(1)
 
     def __init__(self, final_rdd, func, partitions: List[int],
-                 on_task_success: Optional[Callable[[int, Any], None]] = None):
+                 on_task_success: Optional[Callable[[int, Any], None]] = None,
+                 pool: str = "default"):
         self.job_id = next(_Job._ids)
         self.final_rdd = final_rdd
         self.func = func
         self.partitions = partitions
+        self.pool = pool or "default"
         self.results: List[Any] = [None] * len(partitions)
         self.finished: List[bool] = [False] * len(partitions)
         self.num_finished = 0
@@ -130,6 +174,18 @@ class _Job:
         self.speculated: Set[tuple] = set()
         self.spec_task_ids: Dict[tuple, int] = {}  # key -> duplicate's id
         self.last_speculation_sweep: float = 0.0
+        # Multi-job plumbing (scheduler/jobserver.py): the loop's queue so
+        # cancel/stop can wake it, the cancel flag the loop polls, and the
+        # stages THIS job submitted tasks for (binary refcounting).
+        self.event_queue: Optional["queue.Queue"] = None
+        self.cancel_requested = False
+        self.cancel_reason: Optional[str] = None
+        self.submitted_stages: Set[Stage] = set()
+        self.stage_starts: Dict[int, float] = {}
+        # Filled by _run_job_inner: every shuffle reachable from the
+        # final RDD — the executor-loss reaper keys "does this loss
+        # affect this job?" on it.
+        self.lineage_shuffle_ids: Set[int] = set()
 
     def live_copies(self, key: tuple) -> int:
         return len(self.inflight.get(key, ()))
@@ -151,32 +207,63 @@ class DAGScheduler:
             backend.add_executor_lost_listener(self._on_executor_lost)
         if getattr(backend, "event_sink", False) is None:
             backend.event_sink = self.bus.post
-        # One job at a time, like the reference's scheduler_lock
-        # (distributed_scheduler.rs:183-187). Jobs from multiple driver
-        # threads serialize here. Reentrant: materializing a checkpoint
-        # (_do_checkpoint) legitimately nests a job inside job setup.
-        self._job_lock = named_lock("scheduler.dag.DAGScheduler._job_lock", reentrant=True)
-        # The in-flight job, visible to the reaper callback: executor loss
-        # must proactively fail the affected stages of a RUNNING job (see
-        # _on_executor_lost) — recovery cannot depend on a reducer
-        # happening to observe a FetchFailed.
-        self._active_job: Optional[_Job] = None
+        # Multi-job shared state (replaces the reference-style _job_lock
+        # that serialized whole jobs, distributed_scheduler.rs:183-187):
+        #   _running_jobs    every job whose event loop is live — the
+        #                    executor-loss reaper fails affected stages of
+        #                    ALL of them, not one singleton _active_job;
+        #   _stage_owners    stage_id -> job_id currently driving a SHARED
+        #                    (cached shuffle-map) stage's task submission —
+        #                    two jobs may reuse one map stage's outputs but
+        #                    only one at a time computes its missing tasks;
+        #   _stage_users     stage_id -> count of running jobs that
+        #                    submitted tasks carrying its StageBinary: the
+        #                    serialized payload is released only when the
+        #                    LAST such job ends (a concurrent job's
+        #                    dispatch must never see a released binary).
+        # Reentrant: _get_shuffle_map_stage recurses through nested
+        # shuffle parents while holding it.
+        self._stages_lock = named_lock(
+            "scheduler.dag.DAGScheduler._stages_lock", reentrant=True)
+        self._running_jobs: Dict[int, _Job] = {}
+        self._stage_owners: Dict[int, int] = {}
+        self._stage_users: Dict[int, int] = {}
+        # Set by the JobServer: tasks route through the fair-scheduling
+        # arbiter instead of straight to the backend. None (standalone
+        # scheduler, unit tests) falls back to direct submission.
+        self.task_router = None
 
     # ------------------------------------------------------------- public API
     def run_job(self, rdd, func, partitions: Optional[List[int]] = None) -> list:
+        """Blocking low-level entry: runs the job's event loop on the
+        CALLING thread. Production callers go through the job server
+        (Context.submit_job / rdd actions) so pools, quotas and
+        cancellation apply — vegalint VG008 enforces that routing."""
         if partitions is None:
             partitions = list(range(rdd.num_partitions))
         if not partitions:
             return []
-        with self._job_lock:
-            return self._run_job_inner(rdd, func, partitions, None)
+        return self._run_job_inner(rdd, func, partitions, None)
 
     def run_job_with_listener(self, rdd, func, partitions,
                               on_task_success) -> list:
-        with self._job_lock:
-            return self._run_job_inner(rdd, func, partitions, on_task_success)
+        return self._run_job_inner(rdd, func, partitions, on_task_success)
 
     def stop(self) -> None:
+        """Cancel every in-flight job CRISPLY before tearing the backend
+        down: each running event loop is flagged and woken so it raises
+        CancelledError to its caller/future, instead of the pre-PR-7
+        behavior (stop ignored in-flight work; callers parked forever on
+        queues no completion would ever reach)."""
+        with self._stages_lock:
+            jobs = list(self._running_jobs.values())
+        for job in jobs:
+            job.cancel_reason = job.cancel_reason or \
+                "scheduler stopped with the job in flight"
+            job.cancel_requested = True
+            q = job.event_queue
+            if q is not None:
+                q.put(_WAKE)
         self.backend.stop()
         self.bus.stop()
 
@@ -198,12 +285,16 @@ class DAGScheduler:
 
     def _get_shuffle_map_stage(self, dep: ShuffleDependency) -> Stage:
         """Reference: distributed_scheduler.rs:484-509 — map stages are cached
-        per shuffle_id so their outputs are reused across jobs."""
-        stage = self._shuffle_to_map_stage.get(dep.shuffle_id)
-        if stage is None:
-            stage = self._new_stage(dep.rdd, dep)
-            self._shuffle_to_map_stage[dep.shuffle_id] = stage
-        return stage
+        per shuffle_id so their outputs are reused across jobs. Atomic
+        get-or-create: concurrent jobs over a shared lineage must agree on
+        ONE Stage object per shuffle (torn duplicates would each track
+        half the output locations)."""
+        with self._stages_lock:
+            stage = self._shuffle_to_map_stage.get(dep.shuffle_id)
+            if stage is None:
+                stage = self._new_stage(dep.rdd, dep)
+                self._shuffle_to_map_stage[dep.shuffle_id] = stage
+            return stage
 
     def _get_parent_stages(self, rdd) -> List[Stage]:
         """DFS over deps, cutting at shuffle edges
@@ -275,17 +366,113 @@ class DAGScheduler:
                         return locs
         return []
 
+    # ------------------------------------------------------- stage ownership
+    def _try_claim_stage(self, stage: Stage, job: _Job) -> bool:
+        """Claim the right to drive `stage`'s task submission. Succeeds
+        when the stage is unowned, already ours, or its owner's event
+        loop is gone (job finished/failed/cancelled without completing
+        the stage — the claim transfers so shared work never orphans)."""
+        with self._stages_lock:
+            owner = self._stage_owners.get(stage.id)
+            if owner is None or owner == job.job_id \
+                    or owner not in self._running_jobs:
+                self._stage_owners[stage.id] = job.job_id
+                return True
+            return False
+
+    def _stage_foreign_owned(self, stage: Stage, job: _Job) -> bool:
+        with self._stages_lock:
+            owner = self._stage_owners.get(stage.id)
+            return owner is not None and owner != job.job_id \
+                and owner in self._running_jobs
+
+    def _release_stage_ownership(self, stage: Stage, job: _Job) -> None:
+        with self._stages_lock:
+            if self._stage_owners.get(stage.id) == job.job_id:
+                del self._stage_owners[stage.id]
+
+    def _externally_satisfied(self, stage: Stage) -> bool:
+        """A shuffle-map stage another job completed while we waited on
+        it: available on both the Stage and the tracker side."""
+        if not stage.is_shuffle_map or not stage.is_available:
+            return False
+        tracker = Env.get().map_output_tracker
+        return tracker is None or tracker.has_outputs(
+            stage.shuffle_dep.shuffle_id)
+
+    def _register_job(self, job: _Job) -> None:
+        with self._stages_lock:
+            self._running_jobs[job.job_id] = job
+
+    def _release_job(self, job: _Job) -> None:
+        """Job exit (success, failure, or cancel): drop it from the
+        running set, release its stage ownerships so waiting jobs can
+        take over, purge its queued tasks from the arbiter, and release
+        stage-binary payloads whose LAST using job this was. Shuffle-map
+        Stages outlive the job (_shuffle_to_map_stage caches them for
+        the driver's lifetime); dropping the serialized payload — the
+        live (rdd, dep) refs stay, lazily re-serialized on a rare
+        post-loss resubmission — keeps one full pickled lineage per
+        stage (a parallelize() source embeds the whole dataset) from
+        pinning driver RSS forever."""
+        router = self.task_router
+        if router is not None:
+            router.purge(job.job_id)
+        release: List[Stage] = []
+        with self._stages_lock:
+            self._running_jobs.pop(job.job_id, None)
+            for sid, owner in list(self._stage_owners.items()):
+                if owner == job.job_id:
+                    del self._stage_owners[sid]
+            for stage in job.submitted_stages:
+                left = self._stage_users.get(stage.id, 1) - 1
+                if left <= 0:
+                    self._stage_users.pop(stage.id, None)
+                    release.append(stage)
+                else:
+                    self._stage_users[stage.id] = left
+        for stage in release:
+            if stage.task_binary is not None:
+                stage.task_binary.release_payload()
+
+    def _cancel_inflight(self, job: _Job) -> None:
+        """Fire the best-effort cancel_task protocol (PR 6) at every live
+        attempt of a cancelled job so executors stop burning fleet time
+        on work nobody will read."""
+        for copies in list(job.inflight.values()):
+            for task_id in list(copies):
+                self.backend.cancel_task(task_id)
+
     # ------------------------------------------------------------- event loop
     def _run_job_inner(self, rdd, func, partitions: List[int],
-                       on_task_success) -> list:
+                       on_task_success, job: Optional[_Job] = None) -> list:
         t_start = time.time()
         conf = Env.get().conf
-        rdd._do_checkpoint()
-        job = _Job(rdd, func, partitions, on_task_success)
-        final_stage = self._new_stage(rdd, None)
+        if job is None:
+            job = _Job(rdd, func, partitions, on_task_success)
         event_queue: "queue.Queue[TaskEndEvent]" = queue.Queue()
+        job.event_queue = event_queue
+        job.lineage_shuffle_ids = _lineage_shuffle_ids(rdd)
+        self._register_job(job)
+        try:
+            return self._drive_job(job, rdd, func, partitions,
+                                   event_queue, conf, t_start)
+        finally:
+            self._release_job(job)
 
-        self.bus.post(ev.JobStart(job_id=job.job_id,
+    def _check_cancel(self, job: _Job) -> None:
+        if job.cancel_requested:
+            raise CancelledError(
+                job.cancel_reason or f"job {job.job_id} cancelled")
+
+    def _drive_job(self, job: _Job, rdd, func, partitions: List[int],
+                   event_queue: "queue.Queue", conf, t_start: float) -> list:
+        self._check_cancel(job)
+        rdd._do_checkpoint()
+        on_task_success = job.on_task_success
+        final_stage = self._new_stage(rdd, None)
+
+        self.bus.post(ev.JobStart(job_id=job.job_id, pool=job.pool,
                                   num_stages=1 + len(final_stage.parents)))
 
         # Fast path: single-partition, no-parent final stage runs inline
@@ -305,26 +492,38 @@ class DAGScheduler:
                                     duration_s=time.time() - t_start))
             return [result]
 
-        stage_starts: Dict[int, float] = {}
-        submitted_stages: set = set()
+        stage_starts = job.stage_starts
 
         def submit_stage(stage: Stage):
-            """Reference: base_scheduler.rs:347-375."""
+            """Reference: base_scheduler.rs:347-375, extended with the
+            cross-job ownership handshake: a missing shared stage another
+            running job is already computing is WAITED on (poll-promoted
+            by wake_waiting), not double-submitted."""
             if stage in job.waiting or stage in job.running:
                 return
             missing = self._get_missing_parent_stages(stage)
             if not missing:
-                submit_missing_tasks(stage)
-                job.running.add(stage)
+                if self._try_claim_stage(stage, job):
+                    submit_missing_tasks(stage)
+                    job.running.add(stage)
+                else:
+                    job.waiting.add(stage)  # foreign job is computing it
             else:
                 job.waiting.add(stage)
                 for parent in missing:
-                    submit_stage(parent)
+                    if self._stage_foreign_owned(parent, job):
+                        job.waiting.add(parent)
+                    else:
+                        submit_stage(parent)
 
         def submit_missing_tasks(stage: Stage):
             """Reference: base_scheduler.rs:377-455."""
             stage_starts.setdefault(stage.id, time.time())
-            submitted_stages.add(stage)
+            if stage not in job.submitted_stages:
+                job.submitted_stages.add(stage)
+                with self._stages_lock:
+                    self._stage_users[stage.id] = \
+                        self._stage_users.get(stage.id, 0) + 1
             pending = job.pending_tasks.setdefault(stage.id, set())
             tasks: List[Task] = []
             if stage is final_stage:
@@ -353,7 +552,8 @@ class DAGScheduler:
             # stage here — off the per-task dispatch path — instead of
             # riding inside every task envelope. Rebuilt only when the
             # mutable lineage state the binary snapshotted has changed
-            # (persist/unpersist, checkpoint materialization).
+            # (persist/unpersist, checkpoint materialization). Only the
+            # stage's owning job runs this, so the rebuild is race-free.
             token = _lineage_token(stage.rdd)
             if stage.task_binary is None or stage.task_binary_token != token:
                 if stage is final_stage:
@@ -368,7 +568,7 @@ class DAGScheduler:
                 task.stage_binary = stage.task_binary
             self.bus.post(ev.StageSubmitted(
                 stage_id=stage.id, num_tasks=len(tasks),
-                is_shuffle_map=stage.is_shuffle_map,
+                is_shuffle_map=stage.is_shuffle_map, job_id=job.job_id,
             ))
             job.stage_task_counts[stage.id] = (
                 job.stage_task_counts.get(stage.id, 0) + len(tasks))
@@ -378,12 +578,43 @@ class DAGScheduler:
                 tkey = (task.stage_id, task.partition)
                 job.inflight.setdefault(tkey, {})[task.task_id] = (
                     task, time.time())
-                self._submit_task(task, event_queue)
+                self._submit_task(task, event_queue, job)
+
+        def wake_waiting():
+            """Promote waiting stages whose parents became available —
+            completed by THIS job (_finish_map_stage calls here) or by a
+            FOREIGN job we parked behind (the event-loop poll calls here;
+            same 50ms cadence the reference's whole loop polled at). Also
+            re-drives parents whose foreign owner died mid-compute."""
+            for s in list(job.waiting):
+                if s in job.running:
+                    job.waiting.discard(s)
+                    continue
+                missing = self._get_missing_parent_stages(s)
+                if not missing:
+                    if self._externally_satisfied(s):
+                        # A stage we only ever waited on; its consumers
+                        # in this job promote via their own iteration.
+                        job.waiting.discard(s)
+                    elif self._try_claim_stage(s, job):
+                        job.waiting.discard(s)
+                        job.running.add(s)
+                        submit_missing_tasks(s)
+                    # else: still foreign-owned and unfinished; keep waiting
+                else:
+                    for parent in missing:
+                        if parent in job.running or parent in job.waiting:
+                            continue
+                        if not self._stage_foreign_owned(parent, job):
+                            submit_stage(parent)
+                        else:
+                            job.waiting.add(parent)
 
         def stage_of(task: Task) -> Optional[Stage]:
             if task.stage_id == final_stage.id:
                 return final_stage
-            for s in itertools.chain(job.running, job.waiting, job.failed):
+            for s in itertools.chain(list(job.running), list(job.waiting),
+                                     list(job.failed)):
                 if s.id == task.stage_id:
                     return s
             return self._stage_by_id(task.stage_id)
@@ -407,10 +638,10 @@ class DAGScheduler:
                 spec_id = job.spec_task_ids.get(key)
                 if winner.task_id == spec_id:
                     self.bus.post(ev.SpeculativeWon(
-                        stage_id=key[0], partition=key[1]))
+                        stage_id=key[0], partition=key[1], job_id=job.job_id))
                 else:
                     self.bus.post(ev.SpeculativeLost(
-                        stage_id=key[0], partition=key[1]))
+                        stage_id=key[0], partition=key[1], job_id=job.job_id))
             for task_id in list(job.inflight.get(key, ())):
                 log.info("cancelling losing attempt %d of stage %d "
                          "partition %d", task_id, key[0], key[1])
@@ -444,7 +675,7 @@ class DAGScheduler:
                     pending.discard(task.partition)
                 settle_speculation(task)
                 if pending is not None and not pending:
-                    self._finish_map_stage(job, stage, submit_stage,
+                    self._finish_map_stage(job, stage, wake_waiting,
                                            submit_missing_tasks, stage_starts)
 
         def on_failure(event: TaskEndEvent):
@@ -465,7 +696,8 @@ class DAGScheduler:
                     return
             if isinstance(err, FetchFailedError):
                 log.info("fetch failure: %s", err)
-                map_stage = self._shuffle_to_map_stage.get(err.shuffle_id)
+                with self._stages_lock:
+                    map_stage = self._shuffle_to_map_stage.get(err.shuffle_id)
                 tracker = Env.get().map_output_tracker
                 if map_stage is not None and err.map_id is not None:
                     map_stage.remove_output_loc(err.map_id, err.server_uri)
@@ -511,7 +743,8 @@ class DAGScheduler:
                     # 0.1s sweep.
                     if key in job.speculated:
                         self.bus.post(ev.SpeculativeLost(
-                            stage_id=key[0], partition=key[1]))
+                            stage_id=key[0], partition=key[1],
+                            job_id=job.job_id))
                     job.speculated.discard(key)
                     job.spec_task_ids.pop(key, None)
                     copies = job.inflight.get(key)
@@ -541,7 +774,7 @@ class DAGScheduler:
                     task, time.time())
                 job.speculated.discard(key)
                 job.spec_task_ids.pop(key, None)
-                self._submit_task(task, event_queue)
+                self._submit_task(task, event_queue, job)
             else:
                 raise TaskError(
                     f"task {task} failed {tries} times; aborting job: {err!r}",
@@ -549,14 +782,17 @@ class DAGScheduler:
                 ) from err
 
         try:
-            self._active_job = job
             submit_stage(final_stage)
             while job.num_finished < len(partitions):
+                self._check_cancel(job)
                 try:
                     event = event_queue.get(timeout=conf.poll_timeout_s)
                 except queue.Empty:
                     self._maybe_resubmit_failed(job, submit_stage, conf)
                     self._maybe_speculate(job, conf, event_queue)
+                    wake_waiting()
+                    continue
+                if event is _WAKE:
                     continue
                 self.bus.post(ev.TaskEnd(
                     task_id=event.task.task_id, stage_id=event.task.stage_id,
@@ -564,6 +800,8 @@ class DAGScheduler:
                     duration_s=event.duration_s, dispatch=event.dispatch,
                     speculative=event.task.speculative,
                     duplicate=bool(event.success and committed(event.task)),
+                    job_id=job.job_id,
+                    executor=event.executor or "local",
                 ))
                 key = (event.task.stage_id, event.task.partition)
                 copies = job.inflight.get(key)
@@ -580,24 +818,19 @@ class DAGScheduler:
                     on_failure(event)
                 self._maybe_resubmit_failed(job, submit_stage, conf)
                 self._maybe_speculate(job, conf, event_queue)
+                wake_waiting()
             self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=True,
                                     duration_s=time.time() - t_start))
             return job.results
         except BaseException:
             self.bus.post(ev.JobEnd(job_id=job.job_id, succeeded=False,
+                                    cancelled=job.cancel_requested,
                                     duration_s=time.time() - t_start))
+            if job.cancel_requested:
+                # Stop burning fleet time on attempts nobody will read
+                # (best-effort; completions into the dead queue are inert).
+                self._cancel_inflight(job)
             raise
-        finally:
-            self._active_job = None
-            # Shuffle-map Stages outlive the job (_shuffle_to_map_stage
-            # caches them for the driver's lifetime): drop the serialized
-            # payload now — the binary keeps its live (rdd, dep) refs and
-            # lazily re-serializes on a rare post-loss resubmission —
-            # instead of pinning one full pickled lineage copy per stage
-            # (a parallelize() source embeds the whole dataset) forever.
-            for s in submitted_stages:
-                if s.task_binary is not None:
-                    s.task_binary.release_payload()
 
     # ------------------------------------------------------------- internals
     def _on_executor_lost(self, executor_id: str, host: str,
@@ -607,42 +840,57 @@ class DAGScheduler:
         already invalidated by the backend (generation bump); without this
         scrub, submit_missing_tasks would see the stale location and skip
         recomputing exactly the partitions that died. List replacement is
-        atomic under the GIL, so racing the event loop is safe.
+        atomic under the GIL, so racing the event loops is safe.
 
-        Stages of the RUNNING job that lost outputs are additionally marked
-        failed so the event loop resubmits them proactively. Without this,
-        recovery would hinge on some reduce task observing a FetchFailed —
-        but if the loss lands between map registration and the reducers'
-        location resolve, every reducer parks inside get_server_uris on the
-        nulled entries and no fetch ever fails: the job would stall until
-        resolve timeouts exhaust max_failures."""
+        Stages of EVERY running job that lost outputs are additionally
+        marked failed so each event loop resubmits them proactively —
+        the pre-PR-7 singleton `_active_job` protected one job and let a
+        concurrent tenant stall. Without the proactive mark, recovery
+        would hinge on some reduce task observing a FetchFailed — but if
+        the loss lands between map registration and the reducers'
+        location resolve, every reducer parks inside get_server_uris on
+        the nulled entries and no fetch ever fails: the job would stall
+        until resolve timeouts exhaust max_failures."""
         if not shuffle_uri:
             return
+        with self._stages_lock:
+            stages = list(self._shuffle_to_map_stage.values())
+            jobs = list(self._running_jobs.values())
         lost_stages = []
-        for stage in list(self._shuffle_to_map_stage.values()):
+        for stage in stages:
             before = stage.num_available_outputs
             stage.remove_outputs_on_server(shuffle_uri)
             if stage.num_available_outputs < before:
                 lost_stages.append(stage)
-        job = self._active_job
-        if job is None or not lost_stages:
+        if not lost_stages:
             return
-        for stage in lost_stages:
-            # Only stages this job actually touched (pending_tasks keeps a
-            # per-job record); foreign shuffles recover lazily on their
-            # next submission instead of being recomputed now.
-            if stage.id in job.pending_tasks or stage in job.waiting:
-                job.running.discard(stage)
-                job.failed.add(stage)
-                job.last_fetch_failure = time.time()
+        for job in jobs:
+            for stage in lost_stages:
+                # Every running job whose LINEAGE contains the lost
+                # shuffle — not merely the stages it owns (pending_tasks)
+                # or parks behind (waiting). A job that consumed a shared
+                # map stage another job computed has neither record, yet
+                # its reducers would park inside get_server_uris on the
+                # nulled entries if the loss lands in the
+                # registration->resolve window (the resolve-timeout
+                # second line still escalates, but only after burning the
+                # full timeout). Foreign shuffles — jobs whose lineage
+                # never reaches this stage — recover lazily on their next
+                # submission instead of being recomputed now.
+                if stage.shuffle_dep.shuffle_id in job.lineage_shuffle_ids:
+                    job.running.discard(stage)
+                    job.failed.add(stage)
+                    job.last_fetch_failure = time.time()
 
     def _stage_by_id(self, stage_id: int) -> Optional[Stage]:
-        for stage in self._shuffle_to_map_stage.values():
+        with self._stages_lock:
+            stages = list(self._shuffle_to_map_stage.values())
+        for stage in stages:
             if stage.id == stage_id:
                 return stage
         return None
 
-    def _finish_map_stage(self, job: _Job, stage: Stage, submit_stage,
+    def _finish_map_stage(self, job: _Job, stage: Stage, wake_waiting,
                           submit_missing_tasks, stage_starts) -> None:
         """All pending tasks of a shuffle-map stage drained
         (reference: base_scheduler.rs:232-345)."""
@@ -660,23 +908,21 @@ class DAGScheduler:
                     [list(locs) if locs else None
                      for locs in stage.output_locs],
                 )
+            # Hand the stage back: concurrent jobs parked behind it can
+            # now consume its outputs (their poll sees availability), and
+            # nothing stale blocks a future re-claim after invalidation.
+            self._release_stage_ownership(stage, job)
             self.bus.post(ev.StageCompleted(
-                stage_id=stage.id,
+                stage_id=stage.id, job_id=job.job_id,
                 duration_s=time.time() - stage_starts.get(stage.id, time.time()),
             ))
             # Wake newly-runnable waiting stages.
-            runnable = [
-                s for s in list(job.waiting)
-                if not self._get_missing_parent_stages(s)
-            ]
-            for s in runnable:
-                job.waiting.discard(s)
-                job.running.add(s)
-                submit_missing_tasks(s)
+            wake_waiting()
         else:
             # Some outputs got invalidated while we ran; resubmit the holes
             # (reference: base_scheduler.rs:317-334).
-            self.bus.post(ev.StageResubmitted(stage_id=stage.id))
+            self.bus.post(ev.StageResubmitted(stage_id=stage.id,
+                                              job_id=job.job_id))
             submit_missing_tasks(stage)
             job.running.add(stage)
 
@@ -693,7 +939,8 @@ class DAGScheduler:
         job.failed.difference_update(to_retry)
         log.info("resubmitting failed stages: %s", to_retry)
         for stage in to_retry:
-            self.bus.post(ev.StageResubmitted(stage_id=stage.id))
+            self.bus.post(ev.StageResubmitted(stage_id=stage.id,
+                                              job_id=job.job_id))
             submit_stage(stage)
 
     def _maybe_speculate(self, job: _Job, conf, event_queue) -> None:
@@ -749,9 +996,16 @@ class DAGScheduler:
                      "excluding %s", task, now - t0, threshold,
                      set(clone.exclude_executors) or "{}")
             self.bus.post(ev.SpeculativeLaunched(
-                stage_id=key[0], partition=key[1], task_id=clone.task_id))
-            self.backend.submit(clone, event_queue.put)
+                stage_id=key[0], partition=key[1], task_id=clone.task_id,
+                job_id=job.job_id))
+            self._submit_task(clone, event_queue, job)
 
     def _submit_task(self, task: Task,
-                     event_queue: "queue.Queue[TaskEndEvent]") -> None:
-        self.backend.submit(task, event_queue.put)
+                     event_queue: "queue.Queue[TaskEndEvent]",
+                     job: _Job) -> None:
+        task.job_id = job.job_id
+        router = self.task_router
+        if router is not None:
+            router.submit(task, event_queue.put, job)
+        else:
+            self.backend.submit(task, event_queue.put)

@@ -30,6 +30,9 @@ class Event:
 class JobStart(Event):
     job_id: int = -1
     num_stages: int = 0
+    # Scheduling pool the job was submitted under (jobserver.py): tenant
+    # metrics key on it.
+    pool: str = "default"
 
 
 @dataclasses.dataclass
@@ -37,6 +40,10 @@ class JobEnd(Event):
     job_id: int = -1
     succeeded: bool = True
     duration_s: float = 0.0
+    # The job ended because it was cancelled (JobFuture.cancel / scheduler
+    # stop), not because a task failed — always paired with
+    # succeeded=False.
+    cancelled: bool = False
 
 
 @dataclasses.dataclass
@@ -44,6 +51,10 @@ class StageSubmitted(Event):
     stage_id: int = -1
     num_tasks: int = 0
     is_shuffle_map: bool = False
+    # The job whose event loop submitted these tasks. Shared (cached) map
+    # stages are attributed to the job that DROVE the submission — the
+    # stage owner — not every job reusing its outputs.
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -54,6 +65,7 @@ class StageCompleted(Event):
     # executes — their duration_s measures dispatch latency only and must
     # not be compared against executed-stage timings.
     speculative: bool = False
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -80,6 +92,9 @@ class TaskEnd(Event):
     # need_binary re-ships; legacy: full-envelope bytes). None when the
     # backend doesn't measure (local threads).
     dispatch: Optional[Dict[str, Any]] = None
+    # The job this completion belongs to: per-job listeners and the
+    # per-job MetricsListener aggregation key on it, end to end.
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -111,6 +126,7 @@ class StageResubmitted(Event):
     distinction."""
 
     stage_id: int = -1
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -121,6 +137,7 @@ class SpeculativeLaunched(Event):
     stage_id: int = -1
     partition: int = -1
     task_id: int = -1  # the duplicate attempt's task id
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -130,6 +147,7 @@ class SpeculativeWon(Event):
 
     stage_id: int = -1
     partition: int = -1
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -141,6 +159,7 @@ class SpeculativeLost(Event):
 
     stage_id: int = -1
     partition: int = -1
+    job_id: int = -1
 
 
 @dataclasses.dataclass
@@ -207,6 +226,10 @@ class LiveListenerBus:
     def __init__(self):
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._listeners: List[Listener] = []
+        # Per-job listeners (multi-tenant scoping): registered against a
+        # job_id, they see ONLY events carrying that job_id — a tenant
+        # watching its own job never observes another tenant's tasks.
+        self._job_listeners: Dict[int, List[Listener]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = False
         self._lock = named_lock("scheduler.events.EventBus._lock")
@@ -214,6 +237,27 @@ class LiveListenerBus:
     def add_listener(self, listener: Listener) -> None:
         with self._lock:
             self._listeners.append(listener)
+
+    def add_job_listener(self, job_id: int, listener: Listener) -> None:
+        """Scope `listener` to events of one job (those carrying its
+        job_id: JobStart/JobEnd/StageSubmitted/StageCompleted/TaskEnd/
+        Speculative*). Remove with remove_job_listener when done — job
+        ids are never reused, so a stale registration only wastes a dict
+        slot, never receives foreign events."""
+        with self._lock:
+            self._job_listeners.setdefault(job_id, []).append(listener)
+
+    def remove_job_listener(self, job_id: int,
+                            listener: Optional[Listener] = None) -> None:
+        with self._lock:
+            if listener is None:
+                self._job_listeners.pop(job_id, None)
+                return
+            listeners = self._job_listeners.get(job_id)
+            if listeners and listener in listeners:
+                listeners.remove(listener)
+                if not listeners:
+                    self._job_listeners.pop(job_id, None)
 
     def start(self) -> None:
         with self._lock:
@@ -262,6 +306,9 @@ class LiveListenerBus:
                     return
                 with self._lock:
                     listeners = list(self._listeners)
+                    job_id = getattr(event, "job_id", -1)
+                    if job_id != -1 and job_id in self._job_listeners:
+                        listeners.extend(self._job_listeners[job_id])
                 for listener in listeners:
                     try:
                         listener.on_event(event)
@@ -286,6 +333,9 @@ class MetricsListener(Listener):
         self.promoted_bytes: Dict[str, int] = {}
         self.spill_count = 0
         self.promote_count = 0
+        # Jobs that ended via cancellation (JobFuture.cancel / scheduler
+        # stop) rather than success or task failure.
+        self.jobs_cancelled = 0
         # Fault-tolerance counters: chaos tests distinguish in-place fetch
         # retry (no resubmits) from the executor-loss resubmit path.
         self.executors_lost = 0
@@ -330,19 +380,35 @@ class MetricsListener(Listener):
         }
         self._lock = named_lock("scheduler.events.MetricsListener._lock")
 
+    def _job(self, job_id: int) -> Dict[str, Any]:
+        """Per-job aggregate record. Per-tenant scoping: every TaskEnd is
+        folded into ITS OWN job's record, so concurrent jobs' task counts
+        and wall times never bleed into each other (pre-PR-7 only the
+        process-wide totals existed)."""
+        return self.jobs.setdefault(job_id, {
+            "tasks": 0, "task_failures": 0, "task_time_s": 0.0,
+        })
+
     def on_event(self, event: Event) -> None:
         with self._lock:
             if isinstance(event, JobStart):
-                self.jobs[event.job_id] = {"start": event.time, "stages": event.num_stages}
+                info = self._job(event.job_id)
+                info["start"] = event.time
+                info["stages"] = event.num_stages
+                info["pool"] = event.pool
             elif isinstance(event, JobEnd):
-                info = self.jobs.setdefault(event.job_id, {})
+                info = self._job(event.job_id)
                 info["duration_s"] = event.duration_s
                 info["succeeded"] = event.succeeded
+                if event.cancelled:
+                    info["cancelled"] = True
+                    self.jobs_cancelled += 1
             elif isinstance(event, StageSubmitted):
                 self.stages[event.stage_id] = {
                     "tasks": event.num_tasks,
                     "shuffle": event.is_shuffle_map,
                     "start": event.time,
+                    "job_id": event.job_id,
                 }
             elif isinstance(event, StageCompleted):
                 info = self.stages.setdefault(event.stage_id, {})
@@ -356,6 +422,12 @@ class MetricsListener(Listener):
                     self.task_failures += 1
                 if event.duplicate:
                     self.speculation["duplicate_completions"] += 1
+                if event.job_id != -1:
+                    info = self._job(event.job_id)
+                    info["tasks"] += 1
+                    info["task_time_s"] += event.duration_s
+                    if not event.success:
+                        info["task_failures"] += 1
                 d = event.dispatch
                 if d:
                     dd = self.dispatch
@@ -405,10 +477,17 @@ class MetricsListener(Listener):
                 self.promoted_bytes[event.store] = (
                     self.promoted_bytes.get(event.store, 0) + event.nbytes)
 
+    def job_summary(self, job_id: int) -> Dict[str, Any]:
+        """One job's aggregate (tasks, failures, task seconds, pool,
+        duration once ended) — the per-tenant view of summary()."""
+        with self._lock:
+            return dict(self.jobs.get(job_id, {}))
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "jobs": len(self.jobs),
+                "jobs_cancelled": self.jobs_cancelled,
                 "stages": len(self.stages),
                 "tasks": self.task_count,
                 "task_failures": self.task_failures,

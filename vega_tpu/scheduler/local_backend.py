@@ -17,11 +17,14 @@ paid 64x the serialization for one correctness check.
 from __future__ import annotations
 
 import logging
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 from vega_tpu import serialization
 from vega_tpu.env import Env
+from vega_tpu.errors import TaskCancelledError
+from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.scheduler.dag import TaskBackend
 from vega_tpu.scheduler.task import (
     Task,
@@ -50,6 +53,14 @@ class LocalBackend(TaskBackend):
         self._pool = ThreadPoolExecutor(
             max_workers=self._num_workers, thread_name_prefix="vega-task"
         )
+        # Cancelled-before-start registry: a pool thread cannot be
+        # interrupted mid-run, but a QUEUED task of a cancelled job can be
+        # dropped at pickup (the local analogue of the distributed
+        # worker's pre-run cancel gate). Bounded: ids only matter between
+        # cancel_task and the task's pickup.
+        self._cancelled: "OrderedDict[int, float]" = OrderedDict()
+        self._cancel_lock = named_lock(
+            "scheduler.local_backend.LocalBackend._cancel_lock")
 
     @property
     def parallelism(self) -> int:
@@ -62,8 +73,29 @@ class LocalBackend(TaskBackend):
         # must never pay the pickle at all.
         return self._serialize
 
+    def cancel_task(self, task_id: int) -> None:
+        """Best-effort: a task still waiting for a pool thread is failed
+        with TaskCancelledError at pickup instead of running. An attempt
+        already executing cannot be interrupted (Python threads); its
+        completion lands in a dead queue and is ignored."""
+        import time
+
+        with self._cancel_lock:
+            self._cancelled[task_id] = time.time()
+            while len(self._cancelled) > 1024:
+                self._cancelled.popitem(last=False)
+
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         def run():
+            with self._cancel_lock:
+                cancelled = self._cancelled.pop(task.task_id, None)
+            if cancelled is not None:
+                callback(TaskEndEvent(
+                    task=task, success=False,
+                    error=TaskCancelledError(
+                        f"attempt {task.task_id} cancelled before it "
+                        "started")))
+                return
             try:
                 result, duration = self._run_one(task)
                 callback(TaskEndEvent(task=task, success=True, result=result,

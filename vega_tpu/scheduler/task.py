@@ -277,6 +277,10 @@ class Task:
     speculative = False
     exclude_executors: frozenset = frozenset()
     dispatched_to: Optional[str] = None
+    # Owning job (stamped by DAGScheduler._submit_task): the fair-
+    # scheduling arbiter keys per-job accounting and cancellation purge
+    # on it. Driver-side only — deliberately absent from TaskHeader.
+    job_id: int = -1
 
     def __init__(self, stage_id: int, partition: int, split: Split,
                  preferred_locs: Optional[List[str]] = None,
@@ -381,3 +385,6 @@ class TaskEndEvent:
     # bytes, ships, cache hits — aggregated by MetricsListener into the
     # `dispatch` summary section. None for backends that don't measure.
     dispatch: Optional[dict] = None
+    # Which executor ran the attempt (distributed backend stamps it;
+    # local threads leave None -> reported as "local" on the bus).
+    executor: Optional[str] = None
