@@ -557,7 +557,14 @@ def main():
         # / overlap seconds): in local mode these are local-tier reads
         # (zero round trips); on a multi-executor run the round-trip count
         # is the batching win (1 per (reducer, server) vs 1 per bucket).
-        detail["fetch"] = ctx.metrics_summary().get("fetch", {})
+        metrics = ctx.metrics_summary()
+        detail["fetch"] = metrics.get("fetch", {})
+        # Push-plan counters (shuffle_plan=push map-side pushes into the
+        # owning servers' pre-merge tiers): all zeros on the default pull
+        # plan, but always reported so a bench run under the knob is
+        # attributable (benchmarks/shuffle_plan_ab.py is the dedicated
+        # A/B; fetch.premerged_buckets above is the reduce-side view).
+        detail["shuffle_push"] = metrics.get("shuffle_push", {})
         # Task-dispatch-plane counters (stage binaries shipped vs cache
         # hits, header/result bytes, need_binary recoveries): zeros on a
         # local in-process run; on a distributed run the binaries_shipped

@@ -1,0 +1,268 @@
+"""A/B: pull vs push shuffle plan over real cross-process workers.
+
+The pull plan (PR 4) pipelines the REDUCE side, but the reduce stage
+still cannot start until the entire map stage has finished: every bucket
+then crosses the wire and merges AFTER the barrier. Under
+`shuffle_plan=push` (PR 8, the Exoshuffle policy) mappers push each
+bucket to its reducer's owning server as it is produced, the server
+pre-merges with the existing MergeState machinery DURING the map stage,
+and a reducer fetches ONE mostly-merged blob — so the work the pull plan
+pays after the barrier has already happened before it.
+
+Harness: N_SERVERS worker processes each run a real ShuffleServer +
+ShuffleStore and execute REAL `ShuffleDependency.do_shuffle_task` calls
+(native bucket pass, `_publish`, the push path — the exact production
+code) for their assigned map partitions, on command from this driver.
+The driver then runs the reduce side through `ShuffleFetcher.fetch_stream`
+with the same StreamingMerge the ShuffledRDD uses.
+
+Measured per leg (legs interleaved per repetition, medians of 3):
+  * map_s           — map-stage wall (push leg pays its pushes HERE)
+  * reduce_start_s  — the ISSUE's reduce-start latency: time from the
+                      last map task ending until the FIRST reducer holds
+                      complete merged state for its partition (under pull
+                      that is a full 16-bucket fetch+merge; under push,
+                      one pre-merged blob)
+  * e2e_s           — map_s + all reducers fetched+merged
+Legs are asserted bit-identical (int sums: exact on every path).
+
+Prints ONE JSON line. Usage:
+
+  python benchmarks/shuffle_plan_ab.py [rows_per_map] [key_space]
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+REPS = 3
+N_MAPS = 16
+N_REDUCERS = 16
+N_SERVERS = 4
+
+_WORKER_CHILD = """
+import sys
+sys.path.insert(0, {root!r})
+from _cpu_mesh import force_cpu_mesh
+force_cpu_mesh(8)
+
+from vega_tpu.aggregator import Aggregator
+from vega_tpu.dependency import ShuffleDependency
+from vega_tpu.env import Env
+from vega_tpu.distributed.shuffle_server import ShuffleServer
+from vega_tpu.partitioner import HashPartitioner
+from vega_tpu.split import Split
+
+ROWS, KEYS, N_RED = {rows}, {keys}, {n_red}
+
+class _StubRDD:
+    def __init__(self, map_id):
+        self.map_id = map_id
+    def iterator(self, split, task_context=None):
+        base = self.map_id * ROWS
+        return (((base + j) * 7919 % KEYS, 1) for j in range(ROWS))
+
+env = Env.get()
+env.shuffle_server = ShuffleServer(env.shuffle_store)
+
+class _StubTracker:
+    peers = {{}}
+    def list_shuffle_peers(self):
+        return dict(self.peers)
+
+tracker = _StubTracker()
+env.map_output_tracker = tracker
+agg = Aggregator(lambda v: v, lambda c, v: c + v, lambda a, b: a + b,
+                 op_name="add")
+part = HashPartitioner(N_RED)
+
+print("URI", env.shuffle_server.uri, flush=True)
+for line in sys.stdin:
+    cmd = line.split()
+    if not cmd:
+        continue
+    if cmd[0] == "PEERS":
+        tracker.peers = {{str(i): u for i, u in enumerate(cmd[1].split(","))}}
+    elif cmd[0] == "PLAN":
+        env.conf.shuffle_plan = cmd[1]
+    elif cmd[0] == "MAP":
+        sid, map_id = int(cmd[1]), int(cmd[2])
+        dep = ShuffleDependency(sid, _StubRDD(map_id), agg, part)
+        dep.do_shuffle_task(Split(map_id))
+        print("DONE", map_id, flush=True)
+    elif cmd[0] == "EXIT":
+        break
+"""
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+def run_legs(rows=60_000, keys=16_384):
+    """Run both legs and return the result dict (benchmarks/suite.py
+    config 8 calls this inside a live Context; the driver Env's tracker
+    and shuffle server are saved and restored around the run)."""
+    from vega_tpu import dependency, native
+    from vega_tpu.env import Env
+    from vega_tpu.map_output_tracker import MapOutputTracker
+    from vega_tpu.shuffle import fetcher as fetcher_mod
+    from vega_tpu.shuffle.fetcher import ShuffleFetcher
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    children = []
+    uris = []
+    for _ in range(N_SERVERS):
+        child = subprocess.Popen(
+            [sys.executable, "-c", _WORKER_CHILD.format(
+                root=root, rows=rows, keys=keys, n_red=N_REDUCERS)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        )
+        children.append(child)
+        tag, uri = child.stdout.readline().split()
+        assert tag == "URI", "worker child failed to start"
+        uris.append(uri)
+    peer_csv = ",".join(uris)
+
+    def send(child, line):
+        child.stdin.write(line + "\n")
+        child.stdin.flush()
+
+    for child in children:
+        send(child, f"PEERS {peer_csv}")
+
+    env = Env.get()
+    saved = (env.map_output_tracker, env.shuffle_server,
+             env.conf.shuffle_plan)
+    tracker = MapOutputTracker()
+    tracker.list_shuffle_peers = lambda: {
+        str(i): u for i, u in enumerate(uris)}
+    env.map_output_tracker = tracker
+    env.shuffle_server = None  # the driver plays the reduce task, remote-only
+
+    def reduce_one(sid, rid):
+        """The ShuffledRDD merge loop over the real fetch stream."""
+        merger = native.StreamingMerge("add")
+        for blob in ShuffleFetcher.fetch_stream(sid, rid):
+            assert blob[:4] == b"VN01"
+            merger.feed(memoryview(blob)[5:], blob[4] == 1)
+        return merger.finish()
+
+    def one_rep(sid, plan):
+        env.conf.shuffle_plan = plan
+        dependency._invalidate_peer_cache()
+        for child in children:
+            send(child, f"PLAN {plan}")
+        tracker.register_shuffle(sid, N_MAPS)
+        # -- map stage: each child runs its share of the 16 map tasks
+        # (real do_shuffle_task; the push leg pays its pushes inside).
+        t0 = time.monotonic()
+        for m in range(N_MAPS):
+            send(children[m % N_SERVERS], f"MAP {sid} {m}")
+        locs = [None] * N_MAPS
+        for m in range(N_MAPS):
+            child = children[m % N_SERVERS]
+            tag, done_m = child.stdout.readline().split()
+            assert tag == "DONE"
+            locs[int(done_m)] = uris[m % N_SERVERS]
+        map_s = time.monotonic() - t0
+        tracker.register_map_outputs(sid, locs)
+        # -- reduce-start latency: last map ended at t_barrier; how long
+        # until the FIRST reducer holds complete merged state?
+        t_barrier = time.monotonic()
+        merged = dict(reduce_one(sid, 0))
+        reduce_start_s = time.monotonic() - t_barrier
+        for rid in range(1, N_REDUCERS):
+            merged.update(reduce_one(sid, rid))
+        e2e_s = map_s + (time.monotonic() - t_barrier)
+        return map_s, reduce_start_s, e2e_s, merged
+
+    result = {"pull": None, "push": None}
+    walls = {"pull": {"map": [], "start": [], "e2e": []},
+             "push": {"map": [], "start": [], "e2e": []}}
+    premerged = {"pull": 0, "push": 0}
+    try:
+        # Warm both legs once (connection pools, code paths, child jit of
+        # nothing — there is no jax here, but the first socket round pays
+        # interpreter warmup) before timing.
+        sid = 0
+        for plan in ("pull", "push"):
+            one_rep(sid, plan)
+            sid += 1
+        # Interleave the legs per repetition (shared-sandbox drift hits
+        # both equally, CLAUDE.md bench methodology).
+        for _ in range(REPS):
+            for plan in ("pull", "push"):
+                fetcher_mod.reset_stats()
+                map_s, start_s, e2e_s, merged = one_rep(sid, plan)
+                sid += 1
+                walls[plan]["map"].append(map_s)
+                walls[plan]["start"].append(start_s)
+                walls[plan]["e2e"].append(e2e_s)
+                premerged[plan] = fetcher_mod.stats_snapshot()["premerged"]
+                if result[plan] is None:
+                    result[plan] = merged
+                else:
+                    assert result[plan] == merged, "non-deterministic leg"
+    finally:
+        (env.map_output_tracker, env.shuffle_server,
+         env.conf.shuffle_plan) = saved
+        dependency._invalidate_peer_cache()
+        for child in children:
+            try:
+                send(child, "EXIT")
+            except (BrokenPipeError, OSError):
+                pass
+            child.kill()
+            child.wait()
+
+    bit_identical = result["pull"] == result["push"]
+    pull_start = median(walls["pull"]["start"])
+    push_start = median(walls["push"]["start"])
+    pull_e2e = median(walls["pull"]["e2e"])
+    push_e2e = median(walls["push"]["e2e"])
+    return {
+        "metric": "shuffle plan pull vs push: reduce-start latency (last "
+                  "map end -> first reducer fully merged) and end-to-end "
+                  "wall; 16x16 native-add shuffle over 4 worker processes, "
+                  "real sockets, medians of 3",
+        "mappers": N_MAPS, "reducers": N_REDUCERS, "servers": N_SERVERS,
+        "rows_per_map": rows, "key_space": keys,
+        "map_s": {"pull": round(median(walls["pull"]["map"]), 6),
+                  "push": round(median(walls["push"]["map"]), 6)},
+        "reduce_start_s": {"pull": round(pull_start, 6),
+                           "push": round(push_start, 6)},
+        "reduce_start_speedup": round(pull_start / push_start, 2)
+        if push_start else None,
+        "e2e_s": {"pull": round(pull_e2e, 6), "push": round(push_e2e, 6)},
+        "e2e_vs_pull": round(push_e2e / pull_e2e, 3) if pull_e2e else None,
+        "premerged_buckets_last_rep": premerged["push"],
+        "premerged_fraction": round(
+            premerged["push"] / float(N_MAPS * N_REDUCERS), 3),
+        "bit_identical": bit_identical,
+        "reduce_start_3x": (pull_start / push_start >= 3.0)
+        if push_start else False,
+        "e2e_no_worse": push_e2e <= pull_e2e * 1.0,
+    }
+
+
+def main():
+    # Standalone entry only: under suite.py the live Context already
+    # pinned the mesh; run_legs itself never touches jax (the shuffle
+    # plane is host-tier socket work — the import above must not probe a
+    # possibly-wedged TPU backend, CLAUDE.md).
+    force_cpu_mesh(8)
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    keys = int(sys.argv[2]) if len(sys.argv) > 2 else 16_384
+    print(json.dumps(run_legs(rows, keys)))
+
+
+if __name__ == "__main__":
+    main()

@@ -293,6 +293,26 @@ def config7_multijob_latency(ctx, scale=1.0, bank=None):
     return n_long, out["fifo_short_p50_s"], out["fair_short_p50_s"]
 
 
+def config8_shuffle_plan(ctx, scale=1.0, bank=None):
+    """PR 8 push-based pre-merged shuffle: 16x16 native-add shuffle over
+    4 cross-process workers, shuffle_plan=pull vs push (legs interleaved,
+    medians of 3, asserted bit-identical by benchmarks/shuffle_plan_ab.py
+    itself). Reported through the standard columns: host_s = pull
+    end-to-end wall, device_s = push end-to-end wall, so device_vs_host
+    reads as the push-plan win. Host-plane socket work — no device leg,
+    excluded from the TPU-window default config set (the dedicated
+    tpu_jobs/08 job runs the standalone A/B instead)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from shuffle_plan_ab import run_legs
+
+    rows = max(10_000, int(60_000 * scale))
+    out = run_legs(rows, 16_384)
+    assert out["bit_identical"], "push and pull legs diverged"
+    if bank:
+        bank(rows * out["mappers"], out["e2e_s"]["push"])
+    return rows * out["mappers"], out["e2e_s"]["pull"], out["e2e_s"]["push"]
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -302,6 +322,8 @@ CONFIGS = {
     6: ("cache spill round-trip (recompute vs spilled read)",
         config6_spill_roundtrip),
     7: ("multi-job short-job p50, fifo vs fair", config7_multijob_latency),
+    8: ("shuffle plan pull vs push e2e (16x16 native add)",
+        config8_shuffle_plan),
 }
 
 

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Push-vs-pull shuffle plan A/B (PR 8) in the TPU-host environment: the
+# push plane is host-tier socket work, but the standing question is how
+# the pre-merge pipeline behaves on the REAL multi-core TPU host (this
+# sandbox is 1-core, so map-stage pushes and server-side merges cannot
+# actually overlap — on the chip host they can, and the e2e ratio is the
+# number to trust). One JSON line; the acceptance bounds ride the
+# reduce_start_3x / e2e_no_worse / bit_identical fields.
+cd /root/repo
+exec env JAX_PLATFORMS=cpu python benchmarks/shuffle_plan_ab.py 120000 16384

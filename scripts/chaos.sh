@@ -12,3 +12,9 @@ timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
 # speculation + replicated shuffle reads on. One JSON line; the acceptance
 # bound (straggler_on <= 2x baseline) rides the "bounded_2x" field.
 timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/straggler_ab.py
+
+# Shuffle plan A/B (PR 8): pull vs push over 4 cross-process workers. One
+# JSON line; the acceptance bounds (reduce-start >= 3x, e2e no worse than
+# pull, bit-identical legs) ride the "reduce_start_3x" / "e2e_no_worse" /
+# "bit_identical" fields.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/shuffle_plan_ab.py

@@ -723,3 +723,93 @@ def test_cancel_mid_stage_leaves_distributed_fleet_reusable():
         assert summary["jobs_cancelled"] >= 1
     finally:
         ctx.stop()
+
+
+# ---------------------------------------------------------------- PR 8:
+# push-plan chaos — mapper death and server connection drops MID-PUSH must
+# recover to bit-identical results with zero double-merged buckets (the
+# push/pull-overlap edition of the exactly-once contract).
+
+def _premerge_totals(ctx):
+    """Sum the live workers' pre-merge tier counters (server `status`)."""
+    from vega_tpu.distributed.shuffle_server import check_status
+
+    tot = {"merged_buckets": 0, "raw_buckets": 0, "duplicates": 0,
+           "frozen": 0, "overflow_freezes": 0}
+    for info in ctx._backend.service.live_workers().values():
+        status = check_status(info["shuffle_uri"])
+        if status is None:
+            continue  # a reaped slot mid-respawn
+        for key in tot:
+            tot[key] += status["premerge"][key]
+    return tot
+
+
+def test_push_plan_mapper_sigkilled_mid_push_bit_identical(
+        monkeypatch, tmp_path):
+    """Acceptance (PR 8 satellite): a mapper SIGKILLed at the worst point
+    — its pushes delivered but its completion unacknowledged — recovers to
+    results bit-identical to the pull plan. The retried attempt re-pushes
+    the same buckets; the surviving owners' tiers drop them as duplicates
+    (map_id dedup), so nothing is ever double-merged."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_KILL_AFTER_TASKS", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(shuffle_plan="push")
+    try:
+        assert ctx._backend.conf.shuffle_plan == "push"
+        assert _reduce_job(ctx) == _expected_reduce()
+        kills = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "kill_worker"]
+        assert kills, "the injected SIGKILL never fired"
+        assert ctx.metrics_summary()["executors_lost"] >= 1
+        totals = _premerge_totals(ctx)
+        # The pre-merge tier engaged (the kill cannot have silently forced
+        # the whole job onto the pull plan). Replayed pushes from the
+        # retried attempt surface as tier `duplicates` ONLY when the
+        # retry's owner rotation overlaps the first attempt's (the
+        # respawned slot binds a new port, which can reshuffle the sorted
+        # rotation), so no exact count is deterministic here — the
+        # bit-identical result above is what proves zero double-merges.
+        assert totals["merged_buckets"] + totals["raw_buckets"] > 0
+        # The fleet stays usable on the push plan after recovery.
+        assert _wait_metric(ctx, "executors_restarted", 1), \
+            "killed worker slot was never respawned"
+        assert _reduce_job(ctx) == _expected_reduce()
+    finally:
+        ctx.stop()
+
+
+def test_push_plan_server_drop_mid_push_recovers(monkeypatch, tmp_path):
+    """Acceptance (PR 8 satellite): every worker's shuffle server cuts its
+    first push_merged connections AFTER consuming the payload, BEFORE the
+    ack (faults.serve_push, the deterministic PUSH_DROP_N knob). Mappers
+    must degrade those rows to the pull plan — never fail the map task —
+    and results stay bit-identical with no stage resubmission and no
+    executor loss (a dropped push is not a failure, it is a policy
+    downgrade)."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_PUSH_DROP_N", "2")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(shuffle_plan="push")
+    try:
+        assert _reduce_job(ctx) == _expected_reduce()
+        drops = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "push_drop"]
+        assert drops, "no push connection was ever dropped"
+        summary = ctx.metrics_summary()
+        assert summary["stages_resubmitted"] == 0, \
+            "a dropped push must degrade to pull, not resubmit the stage"
+        assert summary["executors_lost"] == 0
+        totals = _premerge_totals(ctx)
+        assert totals["duplicates"] == 0  # degraded rows were never replayed
+        # A second job on the same fleet pushes cleanly (the injector is
+        # counter-based: its budget is spent).
+        assert _reduce_job(ctx) == _expected_reduce()
+        assert _premerge_totals(ctx)["merged_buckets"] > \
+            totals["merged_buckets"]
+    finally:
+        ctx.stop()

@@ -452,6 +452,426 @@ def test_fetch_stream_fails_over_to_replica_mid_stream(tmp_path):
         store.close()
 
 
+def _native_blob(pairs, is_int=True):
+    """A full stored bucket frame (magic + flag + packed rows), as the
+    map side writes them."""
+    import struct
+
+    from vega_tpu.shuffle.premerge import NATIVE_MAGIC
+
+    fmt = "<qq" if is_int else "<qd"
+    return (NATIVE_MAGIC + (b"\x01" if is_int else b"\x00")
+            + b"".join(struct.pack(fmt, k, v) for k, v in pairs))
+
+
+def test_premerge_magics_match_dependency():
+    """premerge.py duplicates the frame magics to stay import-light; the
+    duplication is only safe while the bytes stay equal."""
+    from vega_tpu import dependency
+    from vega_tpu.shuffle import premerge
+
+    assert premerge.NATIVE_MAGIC == dependency.NATIVE_MAGIC
+    assert premerge.NATIVE_GROUP_MAGIC == dependency.NATIVE_GROUP_MAGIC
+
+
+def test_premerge_duplicate_feed_merged_once():
+    """MergeState idempotency under attempt tags (push plan): the same
+    bucket pushed twice — a map retry / replayed connection — is merged
+    ONCE; the frozen blob equals a single-feed merge."""
+    from vega_tpu import native
+    from vega_tpu.shuffle.premerge import PreMergeTier
+
+    store = ShuffleStore()
+    tier = PreMergeTier(store)
+    bucket = _native_blob([(1, 2), (2, 3)])
+    assert tier.feed_row(0, 0, 0, "add", [(0, bucket)]) == \
+        {"merged": 1, "stored": 0, "duplicate": 0}
+    # Same map_id again under a NEW attempt tag: dropped, counted.
+    assert tier.feed_row(0, 0, 1, "add", [(0, bucket)]) == \
+        {"merged": 0, "stored": 0, "duplicate": 1}
+    assert tier.feed_row(0, 1, 0, "add", [(0, _native_blob([(1, 5)]))]) == \
+        {"merged": 1, "stored": 0, "duplicate": 0}
+    merged_ids, raw_ids = tier.freeze(0, 0)
+    assert merged_ids == [0, 1] and raw_ids == []
+    blob = tier.merged_blob(0, 0)
+    assert blob[:4] == b"VN01"
+    assert sorted(native.decode(blob[5:], blob[4] == 1)) == \
+        [(1, 7), (2, 3)]  # NOT (1, 9): the duplicate never double-merged
+    # Freeze is idempotent (reducer retries read a stable answer), and a
+    # post-freeze push degrades to store-and-forward, never a re-merge.
+    assert tier.freeze(0, 0) == ([0, 1], [])
+    assert tier.feed_row(0, 2, 0, "add", [(0, _native_blob([(9, 9)]))]) == \
+        {"merged": 0, "stored": 1, "duplicate": 0}
+    assert tier.freeze(0, 0) == ([0, 1], [2])
+    assert tier.status()["duplicates"] == 1
+
+
+def test_premerge_int64_overflow_voids_merged_set_redo_exact():
+    """A pre-merged accumulator that overflows int64 must VOID the merged
+    set (freeze returns no blob) so the reducer pulls the origin buckets
+    and the exact bignum redo runs — never doubles-rounded values. Same
+    contract on the native path (finish() -> None) and the pure-Python
+    fallback (bignum result that no longer encodes as int64 rows)."""
+    from vega_tpu import native
+    from vega_tpu.shuffle.premerge import PreMergeTier
+
+    big = (1 << 62) + 3
+    buckets = [_native_blob([(7, big)]), _native_blob([(7, big)])]
+
+    def run_tier():
+        tier = PreMergeTier(ShuffleStore())
+        for m, b in enumerate(buckets):
+            assert tier.feed_row(0, m, 0, "add", [(0, b)])["merged"] == 1
+        merged_ids, raw_ids = tier.freeze(0, 0)
+        return tier, merged_ids, raw_ids
+
+    tier, merged_ids, raw_ids = run_tier()
+    assert merged_ids == [] and raw_ids == []
+    assert tier.merged_blob(0, 0) is None
+    assert tier.status()["overflow_freezes"] == 1
+    # The voided buckets must not linger as phantom served-merged counts.
+    assert tier.status()["merged_buckets"] == 0
+    # The origin buckets (still in their map-side stores) redo exactly.
+    assert native.merge_encoded_py(
+        [(b[5:], 1) for b in buckets], "add") == [(7, 2 * big)]
+
+    # Forced pure-Python fallback: the exact bignum merge must equally
+    # decline to encode an overflowed int64 row.
+    saved_native, saved_attempted = native._native, native._load_attempted
+    native._native, native._load_attempted = None, True
+    try:
+        _tier, merged_ids, _raw = run_tier()
+        assert merged_ids == []
+    finally:
+        native._native, native._load_attempted = saved_native, saved_attempted
+
+
+def test_premerge_malformed_frame_rejected_never_served():
+    """A structurally invalid pushed VN01 frame (truncated row — the
+    realistic in-flight corruption) must be REJECTED outright: never fed,
+    never stored, never served to a reducer (forwarding provably-bad
+    bytes would fail the reduce task on every retry, where dropping just
+    means the reducer pulls the origin's good copy). The partition's
+    merge state is untouched."""
+    from vega_tpu import native
+    from vega_tpu.shuffle.premerge import NATIVE_MAGIC, PreMergeTier
+
+    tier = PreMergeTier(ShuffleStore())
+    good = _native_blob([(1, 2)])
+    assert tier.feed_row(0, 0, 0, "add", [(0, good)])["merged"] == 1
+    bad = NATIVE_MAGIC + b"\x01" + b"\x00" * 7  # not a 16-byte row multiple
+    out = tier.feed_row(0, 1, 0, "add", [(0, bad)])
+    assert out == {"merged": 0, "stored": 0, "duplicate": 0}
+    assert tier.status()["rejected"] == 1
+    # The good feed is unaffected; the bad map_id is NOT in the merged
+    # set or the raw set, so the reducer pulls it from its origin.
+    merged_ids, raw_ids = tier.freeze(0, 0)
+    assert merged_ids == [0] and raw_ids == []
+    blob = tier.merged_blob(0, 0)
+    assert sorted(native.decode(blob[5:], blob[4] == 1)) == [(1, 2)]
+    # Budget fully reclaimed at freeze — no leaked charge from the reject.
+    assert tier.status()["fed_bytes"] == 0
+
+
+def test_premerge_mixed_value_flags_store_and_forward():
+    """One value flag per frozen blob: a float bucket arriving after an
+    int state must store-and-forward, not merge through doubles."""
+    from vega_tpu.shuffle.premerge import PreMergeTier
+
+    tier = PreMergeTier(ShuffleStore())
+    assert tier.feed_row(0, 0, 0, "add",
+                         [(0, _native_blob([(1, 2)]))])["merged"] == 1
+    out = tier.feed_row(0, 1, 0, "add",
+                        [(0, _native_blob([(1, 0.5)], is_int=False))])
+    assert out == {"merged": 0, "stored": 1, "duplicate": 0}
+    merged_ids, raw_ids = tier.freeze(0, 0)
+    assert merged_ids == [0] and raw_ids == [1]
+
+
+class _StubRDD:
+    """Minimal parent for ShuffleDependency.do_shuffle_task: iterator only."""
+
+    def __init__(self, rows):
+        self.rows = rows
+
+    def iterator(self, split, task_context=None):
+        return iter(self.rows)
+
+
+def _push_harness(env, server, n_maps):
+    """Point the Env at an in-process push fleet of ONE server (owner ==
+    primary): tracker with a peer listing, shuffle_plan=push."""
+    from vega_tpu import dependency
+    from vega_tpu.map_output_tracker import MapOutputTracker
+
+    tracker = MapOutputTracker()
+    tracker.list_shuffle_peers = lambda: {"w0": server.uri}
+    tracker.register_shuffle(0, n_maps)
+    old = (env.map_output_tracker, env.shuffle_server,
+           env.conf.shuffle_plan, env.fetch_event_sink)
+    env.map_output_tracker = tracker
+    env.shuffle_server = server
+    env.conf.shuffle_plan = "push"
+    dependency._invalidate_peer_cache()
+    return tracker, old
+
+
+def _restore_harness(env, old):
+    from vega_tpu import dependency
+
+    (env.map_output_tracker, env.shuffle_server,
+     env.conf.shuffle_plan, env.fetch_event_sink) = old
+    dependency._invalidate_peer_cache()
+
+
+def test_push_plan_round_trip_premerged_and_counted():
+    """Full push-plan round trip in one process (real sockets): map tasks
+    push via _publish, the server pre-merges, the reduce stream delivers
+    ONE frozen blob covering every map output, a replayed map attempt is
+    deduped — and both sides of the accounting (ShufflePushCompleted /
+    ShuffleFetchCompleted.premerged_buckets) reach the event sink."""
+    from vega_tpu import dependency, native
+    from vega_tpu.aggregator import Aggregator
+    from vega_tpu.partitioner import HashPartitioner
+    from vega_tpu.scheduler.events import (ShuffleFetchCompleted,
+                                           ShufflePushCompleted)
+    from vega_tpu.split import Split
+
+    env = Env.get()
+    server = ShuffleServer(env.shuffle_store)
+    n_maps, n_red = 5, 3
+    tracker, old = _push_harness(env, server, n_maps)
+    events = []
+    env.fetch_event_sink = events.append
+    agg = Aggregator(lambda v: v, lambda c, v: c + v, lambda a, b: a + b,
+                     op_name="add")
+    dependency.reset_push_stats()
+    try:
+        locs = []
+        deps = []
+        for m in range(n_maps):
+            dep = dependency.ShuffleDependency(
+                0, _StubRDD([(k, 1) for k in range(m, m + 30)]), agg,
+                HashPartitioner(n_red))
+            deps.append(dep)
+            locs.append(dep.do_shuffle_task(Split(m)))
+        # Map retry (speculative duplicate / recompute): same bytes pushed
+        # again — the tier must drop every bucket as a duplicate.
+        deps[0].do_shuffle_task(Split(0))
+        tracker.register_map_outputs(0, locs)
+        push = dependency.push_stats_snapshot()
+        assert push["pushes"] == n_maps + 1
+        assert push["duplicates"] == n_red  # the whole retried row
+        assert push["failed"] == 0
+
+        fetcher_mod.reset_stats()
+        merged = {}
+        for r in range(n_red):
+            sm = native.StreamingMerge("add")
+            for blob in ShuffleFetcher.fetch_stream(0, r):
+                assert blob[:4] == b"VN01"
+                sm.feed(memoryview(blob)[5:], blob[4] == 1)
+            merged.update(dict(sm.finish()))
+        expected = {}
+        for m in range(n_maps):
+            for k in range(m, m + 30):
+                expected[k] = expected.get(k, 0) + 1
+        assert merged == expected  # the retry never double-merged
+        stats = fetcher_mod.stats_snapshot()
+        assert stats["premerged"] == n_maps * n_red  # everything pre-merged
+        assert stats["duplicates"] == 0
+        # Self-owned partitions read the local tier in-process (this
+        # one-server harness owns every reduce partition): no sockets.
+        assert stats["round_trips"] == 0
+
+        pushes = [e for e in events if isinstance(e, ShufflePushCompleted)]
+        assert sum(e.merged for e in pushes) == n_maps * n_red
+        assert sum(e.duplicates for e in pushes) == n_red
+        fetches = [e for e in events if isinstance(e, ShuffleFetchCompleted)]
+        assert sum(e.premerged_buckets for e in fetches) == n_maps * n_red
+        assert all(e.premerged_buckets == e.buckets for e in fetches)
+    finally:
+        _restore_harness(env, old)
+        server.stop()
+
+
+def test_push_plan_dead_owner_degrades_to_pull():
+    """A push fleet whose owner is unreachable: pushes degrade (map tasks
+    still succeed), the reduce stream's get_merged fails, and the stream
+    silently completes on the pull plan — no new failure modes."""
+    from vega_tpu import dependency, native
+    from vega_tpu.aggregator import Aggregator
+    from vega_tpu.partitioner import HashPartitioner
+    from vega_tpu.split import Split
+
+    env = Env.get()
+    server = ShuffleServer(env.shuffle_store)
+    dead = _dead_uri()
+    n_maps, n_red = 4, 2
+    tracker, old = _push_harness(env, server, n_maps)
+    # Every owner resolves to the dead peer; the primary stays live.
+    tracker.list_shuffle_peers = lambda: {"w0": dead}
+    dependency._invalidate_peer_cache()
+    dependency.reset_push_stats()
+    agg = Aggregator(lambda v: v, lambda c, v: c + v, lambda a, b: a + b,
+                     op_name="add")
+    try:
+        locs = []
+        for m in range(n_maps):
+            dep = dependency.ShuffleDependency(
+                0, _StubRDD([(k, 1) for k in range(10)]), agg,
+                HashPartitioner(n_red))
+            locs.append(dep.do_shuffle_task(Split(m)))
+        tracker.register_map_outputs(0, locs)
+        assert dependency.push_stats_snapshot()["failed"] == \
+            n_maps * n_red  # every bucket degraded
+        fetcher_mod.reset_stats()
+        merged = {}
+        for r in range(n_red):
+            sm = native.StreamingMerge("add")
+            for blob in ShuffleFetcher.fetch_stream(0, r):
+                sm.feed(memoryview(blob)[5:], blob[4] == 1)
+            merged.update(dict(sm.finish()))
+        assert merged == {k: n_maps for k in range(10)}
+        stats = fetcher_mod.stats_snapshot()
+        assert stats["premerged"] == 0  # nothing arrived pushed
+        assert stats["buckets"] == n_maps * n_red
+    finally:
+        _restore_harness(env, old)
+        server.stop()
+
+
+def test_push_plan_hung_owner_bounded_by_slow_server_deadline():
+    """A pre-merge owner that accepts connections but never answers must
+    not gate the reduce task on the 120s socket timeout: with
+    fetch_slow_server_s set, the get_merged round runs under that
+    deadline and the stream degrades to pull in seconds."""
+    import socket as _socket
+    import time as _time
+
+    from vega_tpu import dependency, native
+    from vega_tpu.aggregator import Aggregator
+    from vega_tpu.partitioner import HashPartitioner
+    from vega_tpu.split import Split
+
+    env = Env.get()
+    server = ShuffleServer(env.shuffle_store)
+    hole = _socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(8)
+    hole_uri = f"127.0.0.1:{hole.getsockname()[1]}"
+    n_maps, n_red = 4, 2
+    tracker, old = _push_harness(env, server, n_maps)
+    # Pushes degrade against the hole (they fail fast enough under the
+    # connect path or degrade on error); the reduce-side get_merged is
+    # what this test bounds.
+    tracker.list_shuffle_peers = lambda: {"w0": hole_uri}
+    dependency._invalidate_peer_cache()
+    old_slow = env.conf.fetch_slow_server_s
+    env.conf.fetch_slow_server_s = 0.5
+    agg = Aggregator(lambda v: v, lambda c, v: c + v, lambda a, b: a + b,
+                     op_name="add")
+    try:
+        locs = []
+        for m in range(n_maps):
+            dep = dependency.ShuffleDependency(
+                0, _StubRDD([(k, 1) for k in range(10)]), agg,
+                HashPartitioner(n_red))
+            locs.append(dep.do_shuffle_task(Split(m)))
+        tracker.register_map_outputs(0, locs)
+        fetcher_mod.reset_stats()
+        t0 = _time.monotonic()
+        merged = {}
+        for r in range(n_red):
+            sm = native.StreamingMerge("add")
+            for blob in ShuffleFetcher.fetch_stream(0, r):
+                sm.feed(memoryview(blob)[5:], blob[4] == 1)
+            merged.update(dict(sm.finish()))
+        wall = _time.monotonic() - t0
+        assert merged == {k: n_maps for k in range(10)}
+        assert wall < 20.0, \
+            f"hung pre-merge owner gated the reducers ({wall:.1f}s)"
+        assert fetcher_mod.stats_snapshot()["premerged"] == 0
+    finally:
+        env.conf.fetch_slow_server_s = old_slow
+        _restore_harness(env, old)
+        server.stop()
+        hole.close()
+
+
+def test_executor_lost_invalidates_push_peer_cache():
+    """Regression (PR 8 satellite): the 5s-TTL shuffle-peer cache used to
+    be invalidated only on push FAILURE — after a wasted round trip
+    against a peer the driver already knew was dead. The DAG scheduler's
+    executor-lost listener must invalidate it the moment the loss is
+    known, even for an executor that held no map outputs yet."""
+    import time as _time
+
+    from vega_tpu import dependency
+    from vega_tpu.scheduler.dag import DAGScheduler
+    from vega_tpu.scheduler.events import LiveListenerBus
+    from vega_tpu.scheduler.local_backend import LocalBackend
+
+    bus = LiveListenerBus()
+    scheduler = DAGScheduler(LocalBackend(), bus)
+    try:
+        sentinel = object()
+        dependency._peer_cache.update(
+            tracker=sentinel, peers=["stale:1"],
+            expires=_time.monotonic() + 999.0)
+        scheduler._on_executor_lost("exec-0", "127.0.0.1",
+                                    "stale:1", "heartbeat timeout")
+        assert dependency._peer_cache["expires"] == 0.0
+        # And again with NO shuffle server registered (the executor died
+        # before serving anything): the cache must still be invalidated.
+        dependency._peer_cache.update(
+            tracker=sentinel, peers=["stale:1"],
+            expires=_time.monotonic() + 999.0)
+        scheduler._on_executor_lost("exec-1", "127.0.0.1", None, "exited")
+        assert dependency._peer_cache["expires"] == 0.0
+    finally:
+        scheduler.stop()
+        bus.stop()
+
+
+def test_push_plan_full_distributed_job():
+    """shuffle_plan=push end to end over a real 2-executor fleet: the
+    knob propagates through the spawn env, results match the pull plan
+    bit for bit, the workers' pre-merge tiers actually engaged (merged
+    buckets on `status`), and group_by (no combining monoid) rides the
+    store-and-forward path."""
+    from vega_tpu.distributed.shuffle_server import check_status
+
+    exp_reduce = {}
+    for i in range(200):
+        exp_reduce[i % 7] = exp_reduce.get(i % 7, 0) + i
+
+    ctx = v.Context("distributed", num_workers=2, shuffle_plan="push")
+    try:
+        assert ctx._backend.conf.shuffle_plan == "push"
+        pairs = ctx.parallelize([(i % 7, i) for i in range(200)], 8)
+        got = dict(pairs.reduce_by_key(lambda a, b: a + b, 4).collect())
+        assert got == exp_reduce
+        grouped = dict(pairs.group_by_key(3).collect())
+        assert {k: sorted(vs) for k, vs in grouped.items()} == {
+            k: sorted(i for i in range(200) if i % 7 == k)
+            for k in range(7)}
+        merged = raw = 0
+        for info in ctx._backend.service.workers.values():
+            status = check_status(info["shuffle_uri"])
+            assert status is not None
+            merged += status["premerge"]["merged_buckets"]
+            raw += status["premerge"]["raw_buckets"]
+            assert status["premerge"]["duplicates"] == 0
+        assert merged == 8 * 4   # reduce shuffle: every bucket pre-merged
+        # Group shuffles (no combining monoid) are NOT pushed — pushing
+        # them would move every byte twice for zero pre-merge benefit —
+        # so the tier saw nothing from the group_by job.
+        assert raw == 0
+    finally:
+        ctx.stop()
+
+
 def test_fetch_slow_server_deadline_fails_over(tmp_path):
     """fetch_slow_server_s: a server that accepts but never answers is
     abandoned after the deadline — NOT the 120s socket timeout — and its

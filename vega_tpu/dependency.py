@@ -14,6 +14,7 @@ from typing import TYPE_CHECKING, List
 from vega_tpu import serialization
 from vega_tpu.aggregator import Aggregator
 from vega_tpu.env import Env
+from vega_tpu.lint.sync_witness import named_lock
 from vega_tpu.partitioner import Partitioner
 
 if TYPE_CHECKING:
@@ -57,6 +58,62 @@ def _live_shuffle_peers(tracker) -> List[str]:
 
 def _invalidate_peer_cache() -> None:
     _peer_cache["expires"] = 0.0
+
+
+def resolve_push_peers(tracker):
+    """The SORTED live-peer list the push plan's owner rotation runs
+    over, or None when the plan cannot apply (local mode, no peers,
+    tracker without peer listing, discovery failure) — callers then stay
+    on the pull plan. Shared by the mapper (one resolve per bucket row)
+    and the reducer (push_owner_uri), so both sides rotate over the same
+    fleet view; a fleet change between map and reduce time only
+    degrades — pushes the reducer no longer resolves are simply not
+    read, and it pulls those map_ids from their origins."""
+    if getattr(tracker, "list_shuffle_peers", None) is None:
+        return None
+    from vega_tpu.errors import NetworkError
+
+    try:
+        peers = sorted(_live_shuffle_peers(tracker))
+    except NetworkError as e:
+        log.warning("push-peer discovery failed (%s); staying on the "
+                    "pull plan", e)
+        return None
+    return peers or None
+
+
+def push_owner_of(peers, reduce_id: int) -> str:
+    """THE owner-rotation rule — one home, used by mapper and reducer."""
+    return peers[reduce_id % len(peers)]
+
+
+def push_owner_uri(tracker, reduce_id: int):
+    """The shuffle server OWNING a reduce partition under shuffle_plan=
+    push (reducer-side convenience over resolve_push_peers)."""
+    peers = resolve_push_peers(tracker)
+    return push_owner_of(peers, reduce_id) if peers else None
+
+
+# Process-lifetime push counters (benchmarks/shuffle_plan_ab.py and the
+# chaos suite read these; the per-map edition also rides the driver event
+# bus as ShufflePushCompleted when a sink is wired).
+_push_lock = named_lock("dependency._push_lock")
+_PUSH_TOTALS = {
+    "pushes": 0, "buckets": 0, "bytes": 0, "merged": 0, "stored": 0,
+    "duplicates": 0, "failed": 0, "wall_s": 0.0,
+}
+
+
+def push_stats_snapshot() -> dict:
+    with _push_lock:
+        return dict(_PUSH_TOTALS)
+
+
+def reset_push_stats() -> None:
+    with _push_lock:
+        for k in _PUSH_TOTALS:
+            _PUSH_TOTALS[k] = 0 if isinstance(_PUSH_TOTALS[k], int) else 0.0
+
 
 _SENTINEL = object()
 
@@ -207,7 +264,8 @@ class ShuffleDependency(Dependency):
                                 self.shuffle_id, split.index, reduce_id,
                                 blob,
                             )
-                        return self._publish(env, split.index, row)
+                        return self._publish(env, split.index, row,
+                                             task_context)
                     # mixed-type stream or int64 overflow: exact redo
                     source = self.rdd.iterator(split, task_context)
                 else:
@@ -232,9 +290,10 @@ class ShuffleDependency(Dependency):
         for reduce_id, blob in enumerate(row):
             env.shuffle_store.put(self.shuffle_id, split.index, reduce_id,
                                   blob)
-        return self._publish(env, split.index, row)
+        return self._publish(env, split.index, row, task_context)
 
-    def _publish(self, env, map_id: int, row: List[bytes]):
+    def _publish(self, env, map_id: int, row: List[bytes],
+                 task_context=None):
         """Locally-stored bucket row -> this output's location(s).
 
         With `shuffle_replication` <= 1 (or no shuffle server to replicate
@@ -246,8 +305,21 @@ class ShuffleDependency(Dependency):
         redundancy of arXiv:1802.03049 — a reducer can be satisfied by any
         surviving/responsive copy instead of the one server that happens
         to be slow or dead. A failed push degrades to fewer replicas,
-        never fails the map task (the primary is already durable)."""
+        never fails the map task (the primary is already durable).
+
+        With `shuffle_plan=push` the row is ALSO pushed bucket-by-bucket
+        to each reduce partition's OWNING server (push_owner_uri rotation,
+        ONE `push_merged` round trip per owner), where mergeable buckets
+        feed the server-side pre-merge tier so reducers start from
+        mostly-merged state (shuffle/premerge.py). The push is strictly
+        additive: the local row and the registered locations are
+        byte-identical to the pull plan, so any push failure — dead peer,
+        frozen state, injected chaos — degrades those buckets to pull."""
         primary = env.shuffle_server.uri if env.shuffle_server else "local"
+        if (env.shuffle_server is not None
+                and str(getattr(env.conf, "shuffle_plan",
+                                "pull")).lower() == "push"):
+            self._push_row(env, map_id, row, task_context)
         k = int(getattr(env.conf, "shuffle_replication", 1) or 1)
         if k <= 1 or env.shuffle_server is None:
             return primary
@@ -289,3 +361,127 @@ class ShuffleDependency(Dependency):
                 continue
             locs.append(uri)
         return locs if len(locs) > 1 else primary
+
+    def _push_row(self, env, map_id: int, row: List[bytes],
+                  task_context) -> None:
+        """shuffle_plan=push: ship each bucket to its reduce partition's
+        owning server as soon as the row is finished — the map side of the
+        Exoshuffle pipeline (the server pre-merges on arrival, so the
+        reduce stage starts from mostly-merged state instead of waiting
+        out the whole map stage). Grouped by owner: one `push_merged`
+        round trip per (map task, owner server); the owner that is THIS
+        executor feeds its local tier directly. Failures degrade those
+        buckets to the pull plan and invalidate the peer cache — a push
+        must never fail the map task (the local row is already durable)."""
+        import time
+
+        from vega_tpu.errors import NetworkError
+
+        # Only shuffles with a recognized combining monoid push: the
+        # pre-merge is the whole point, and a non-mergeable bucket (group
+        # rows, opaque closures) would cross the wire twice — push to the
+        # owner, then pull by the reducer — while eating the owner's
+        # store budget, for zero benefit over the already-batched pull.
+        # (The server-side store-and-forward path still exists for the
+        # RESIDUES of mergeable shuffles: budget overflow, flag mismatch,
+        # post-freeze arrivals, poisoned states.)
+        from vega_tpu import native
+
+        if self.aggregator.is_group or \
+                self.aggregator.op_name not in native.OP_BY_NAME:
+            return
+        # The row must actually BE native-encoded: a mergeable op whose
+        # partition fell to the pickled path (non-numeric keys, missing
+        # native runtime, mixed-type redo) has nothing the tier can
+        # pre-merge — pushing it would be the same double-shipping the
+        # monoid gate above exists to prevent. One check covers the row:
+        # do_shuffle_task picks one encoding per partition.
+        if not row or row[0][:4] != NATIVE_MAGIC:
+            return
+        tracker = env.map_output_tracker
+        # One peer resolve per row; the rotation itself lives in
+        # push_owner_of — the same rule the reducer's push_owner_uri
+        # applies — so the two sides can never drift apart.
+        peers = resolve_push_peers(tracker)
+        if not peers:
+            return  # no peers / plan inapplicable: the row stays pull-only
+        by_owner: dict = {}
+        for reduce_id, blob in enumerate(row):
+            by_owner.setdefault(push_owner_of(peers, reduce_id),
+                                []).append((reduce_id, blob))
+        # Attempt tag: observability + the wire-level dedup evidence trail
+        # (the tier dedups by map_id — deterministic compute makes every
+        # attempt's bucket byte-identical).
+        attempt = getattr(task_context, "attempt_id", 0) or 0
+        op_name = self.aggregator.op_name  # mergeable by the gate above
+        # fetch_slow_server_s bounds each push round when set: a hung
+        # owner degrades the row to pull in deadline seconds instead of
+        # gating the MAP task on the 120s socket timeout.
+        slow_s = float(getattr(env.conf, "fetch_slow_server_s", 0.0) or 0.0)
+        totals = {"merged": 0, "stored": 0, "duplicate": 0}
+        failed = 0
+        failed_owners = 0
+        t0 = time.monotonic()
+        from vega_tpu.distributed.shuffle_server import push_merged_remote
+
+        for uri, entries in by_owner.items():
+            if failed_owners >= 2:
+                # Two owners down in one row means fleet-level trouble,
+                # not one dead peer: abandon the remaining pushes (pure
+                # optimization) rather than serially paying a deadline —
+                # or worse, the 120s socket timeout — per hung owner on
+                # the MAP task's critical path.
+                failed += len(entries)
+                continue
+            try:
+                if uri == env.shuffle_server.uri:
+                    counts = env.shuffle_server.premerge.feed_row(
+                        self.shuffle_id, map_id, attempt, op_name, entries)
+                else:
+                    counts = push_merged_remote(uri, self.shuffle_id,
+                                                map_id, attempt, op_name,
+                                                entries,
+                                                deadline_s=slow_s or None)
+                for key in totals:
+                    totals[key] += int(counts.get(key, 0))
+            except Exception as e:  # noqa: BLE001 — a push must NEVER fail
+                # the map task (the local row is already durable): ANY
+                # error — transport to a remote owner, or an unexpected
+                # tier/store failure on the in-process self-owner path —
+                # degrades these buckets to the pull plan.
+                failed += len(entries)
+                failed_owners += 1
+                log.warning("push of shuffle %d map %d to %s failed (%s); "
+                            "those buckets degrade to the pull plan",
+                            self.shuffle_id, map_id, uri, e,
+                            exc_info=not isinstance(e, NetworkError))
+                # The cached peer map may have just proven stale: refresh
+                # before the next task keeps targeting a dead owner.
+                _invalidate_peer_cache()
+        wall = time.monotonic() - t0
+        nbytes = sum(len(b) for b in row)
+        with _push_lock:
+            _PUSH_TOTALS["pushes"] += 1
+            # "buckets" counts ATTEMPTED buckets on both surfaces (these
+            # totals and the ShufflePushCompleted event); "failed" is the
+            # degraded-to-pull subset.
+            _PUSH_TOTALS["buckets"] += len(row)
+            _PUSH_TOTALS["bytes"] += nbytes
+            _PUSH_TOTALS["merged"] += totals["merged"]
+            _PUSH_TOTALS["stored"] += totals["stored"]
+            _PUSH_TOTALS["duplicates"] += totals["duplicate"]
+            _PUSH_TOTALS["failed"] += failed
+            _PUSH_TOTALS["wall_s"] += wall
+        sink = getattr(env, "fetch_event_sink", None)
+        if sink is not None:
+            try:
+                from vega_tpu.scheduler.events import ShufflePushCompleted
+
+                sink(ShufflePushCompleted(
+                    shuffle_id=self.shuffle_id, map_id=map_id,
+                    buckets=len(row), nbytes=nbytes,
+                    merged=totals["merged"], stored=totals["stored"],
+                    duplicates=totals["duplicate"], failed=failed,
+                    targets=len(by_owner), wall_s=wall))
+            except Exception:  # noqa: BLE001 — observability must not break the map task
+                log.debug("push event emit failed", exc_info=True)

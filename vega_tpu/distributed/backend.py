@@ -178,6 +178,10 @@ class DistributedBackend(TaskBackend):
                     self.conf.shuffle_replication),
                 VEGA_TPU_FETCH_SLOW_SERVER_S=str(
                     self.conf.fetch_slow_server_s),
+                # Push plan: map tasks push buckets to their reducer's
+                # owning server; reducers read the pre-merged blob first.
+                VEGA_TPU_SHUFFLE_PLAN=str(
+                    getattr(self.conf, "shuffle_plan", "pull")),
                 # Respawned incarnations disarm one-shot fault injections
                 # (faults.py): a chaos-killed slot comes back healthy.
                 VEGA_TPU_FAULT_INCARNATION=str(incarnation),
@@ -208,6 +212,8 @@ class DistributedBackend(TaskBackend):
             + str(self.conf.task_binary_cache_entries),
             f"VEGA_TPU_SHUFFLE_REPLICATION={self.conf.shuffle_replication}",
             f"VEGA_TPU_FETCH_SLOW_SERVER_S={self.conf.fetch_slow_server_s}",
+            "VEGA_TPU_SHUFFLE_PLAN="
+            + str(getattr(self.conf, "shuffle_plan", "pull")),
             f"VEGA_TPU_FAULT_INCARNATION={incarnation}",
             sys.executable, "-m",
             "vega_tpu.distributed.worker",

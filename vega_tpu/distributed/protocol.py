@@ -77,6 +77,42 @@ Straggler-mitigation messages (PR 6; both single request/response rounds):
                                                      and tiers as locally
                                                      written buckets)
     <- ("ok", n_buckets)
+
+Push-plan messages (PR 8, `shuffle_plan=push` — the Exoshuffle map-side
+push composed over the same store/fetch primitives). The push is the
+`put_many` wire shape keyed per-REDUCER instead of per-row, plus the
+metadata the server-side pre-merge tier needs (attempt tag, combiner op):
+
+    -> ("push_merged", (shuffle_id, map_id, attempt, op_name | None,
+                        [reduce_id, ...]))
+       + one raw bucket frame per listed reduce_id, in list order
+                                                    (map task -> each
+                                                     reduce_id's OWNING
+                                                     server; VN01 buckets
+                                                     of a recognized
+                                                     monoid feed the
+                                                     per-(shuffle,reduce)
+                                                     MergeState, others
+                                                     store-and-forward)
+    <- ("ok", {"merged": M, "stored": S, "duplicate": D})
+                                                    (duplicate = a map_id
+                                                     this server already
+                                                     holds — map retries
+                                                     never double-merge)
+
+    -> ("get_merged", (shuffle_id, reduce_id))      (reduce task -> its
+                                                     owning server; first
+                                                     call freezes the
+                                                     merge, idempotently)
+    <- ("merged", {"map_ids": [...], "blob": bool})
+       + (one raw frame — the frozen VN01 pre-merged blob — iff blob)
+    <- per raw store-and-forwarded pushed bucket:
+         ("bucket", map_id) + one raw bytes frame
+    <- ("batch_end", n_raw)                         (stream terminator)
+
+A reducer that cannot complete this exchange (connection drop, owner
+dead, nothing was pushed) treats the merged set as EMPTY and silently
+degrades to the pull plan for every map_id — no new failure modes.
 """
 
 from __future__ import annotations

@@ -14,6 +14,13 @@ Where the reference pays one GET per (map_id, reduce_id) bucket
 needs from this server into ONE request answered by a stream of framed
 per-bucket replies (protocol.py grammar) — M round trips become 1, and
 the client merges buckets while later ones are still on the wire.
+
+Under `shuffle_plan=push` the server also RECEIVES: map tasks push each
+finished bucket to its reducer's owning server (`push_merged`), a
+pre-merge tier (shuffle/premerge.py) folds mergeable buckets into the
+per-(shuffle, reduce) MergeState as they arrive, and reducers read one
+mostly-merged blob (`get_merged`) instead of M raw buckets — the
+Exoshuffle policy composed over these same store/fetch primitives.
 """
 
 from __future__ import annotations
@@ -79,6 +86,43 @@ class _Handler(socketserver.BaseRequestHandler):
                             return
                         protocol.send_bucket(sock, map_id, data)
                     protocol.send_batch_end(sock, len(map_ids))
+                elif msg_type == "push_merged":
+                    # Push plan (shuffle_plan=push): a map task pushes the
+                    # buckets this server OWNS (rotation by reduce_id) as
+                    # they are produced; mergeable ones feed the
+                    # per-(shuffle, reduce) MergeState so reducers start
+                    # from mostly-merged state (protocol.py grammar).
+                    shuffle_id, map_id, attempt, op_name, reduce_ids = payload
+                    entries = [(rid, protocol.recv_bytes(sock))
+                               for rid in reduce_ids]
+                    if faults.get().serve_push():
+                        # Injected fault: payloads consumed, connection cut
+                        # without the ack — the mapper must degrade to
+                        # local-only (pull serves the bucket) and a replay
+                        # must never double-merge.
+                        return
+                    counts = self.server.premerge.feed_row(  # type: ignore[attr-defined]
+                        shuffle_id, map_id, attempt, op_name, entries)
+                    protocol.send_msg(sock, "ok", counts)
+                elif msg_type == "get_merged":
+                    # Reduce-side read of the pre-merge tier: freeze (the
+                    # first call finalizes, idempotently), then one frozen
+                    # blob + any store-and-forwarded raw pushed buckets.
+                    shuffle_id, reduce_id = payload
+                    tier = self.server.premerge  # type: ignore[attr-defined]
+                    # tier.read owns the no-blob-voids-merged-set rule and
+                    # the lost-raw-copy skip (shared with the in-process
+                    # self-owner fetch path).
+                    merged_ids, blob, raws = tier.read(shuffle_id,
+                                                       reduce_id)
+                    protocol.send_msg(sock, "merged",
+                                      {"map_ids": merged_ids,
+                                       "blob": blob is not None})
+                    if blob is not None:
+                        protocol.send_bytes(sock, blob)
+                    for m, data in raws:
+                        protocol.send_bucket(sock, m, data)
+                    protocol.send_batch_end(sock, len(raws))
                 elif msg_type == "put_many":
                     # Replica push (shuffle_replication > 1): a peer map
                     # task stores its full bucket row here so reducers can
@@ -94,7 +138,13 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif msg_type == "status":
                     # Tier occupancy + spill counters (store.status());
                     # "entries" keeps the original healthcheck contract.
-                    protocol.send_msg(sock, "ok", store.status())
+                    # Push plan: the pre-merge tier's counters ride along
+                    # so cross-process tests can assert merged/duplicate
+                    # accounting without driver-side events.
+                    status = store.status()
+                    status["premerge"] = \
+                        self.server.premerge.status()  # type: ignore[attr-defined]
+                    protocol.send_msg(sock, "ok", status)
                 elif msg_type == "spill":
                     # Memory-pressure relief: push every RAM bucket to the
                     # disk tier; subsequent gets serve from disk.
@@ -108,12 +158,27 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 class ShuffleServer:
-    def __init__(self, shuffle_store, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, shuffle_store, host: str = "127.0.0.1", port: int = 0,
+                 premerge_budget: Optional[int] = None):
+        from vega_tpu.shuffle.premerge import PreMergeTier
+
         self._server = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
         )
         self._server.daemon_threads = True
         self._server.shuffle_store = shuffle_store  # type: ignore[attr-defined]
+        # Push-plan pre-merge tier (shuffle_plan=push): shares this
+        # server's store so pushed/frozen bytes ride the same
+        # budget/spill/checksum machinery; its accumulator footprint is
+        # bounded by `premerge_budget`. The default is a QUARTER of the
+        # store's default memory budget, matching worker.py's sizing —
+        # accumulators cannot spill, so a full-store-sized second budget
+        # would let resident bytes reach ~2x the knob.
+        self.premerge = PreMergeTier(
+            shuffle_store,
+            budget_bytes=((1 << 28) if premerge_budget is None
+                          else int(premerge_budget)))
+        self._server.premerge = self.premerge  # type: ignore[attr-defined]
         self.host = host
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -134,6 +199,13 @@ class ShuffleServer:
 # server; reuse one socket per (thread, server) instead of reconnecting
 # (the reference reconnects per HTTP request batch, shuffle_fetcher.rs:55-100).
 _pool = threading.local()
+
+# Default per-IO deadline for the push plan's OPTIMIZATION rounds
+# (push_merged / get_merged): these never carry the only copy of
+# anything, so a hung owner must degrade them in seconds — not gate a
+# map/reduce task on the 120s IO_TIMEOUT. fetch_slow_server_s, when set,
+# overrides with the operator's tighter bound.
+PUSH_IO_DEADLINE_S = 15.0
 
 
 def _pooled_connection(uri: str,
@@ -220,6 +292,86 @@ def push_buckets_remote(uri: str, shuffle_id: int, map_id: int,
         if reply_type != "ok":
             raise NetworkError(f"replica push refused: {reply_type!r}")
         clean = True
+    finally:
+        if not clean:
+            _drop_connection(uri)
+
+
+def push_merged_remote(uri: str, shuffle_id: int, map_id: int, attempt: int,
+                       op_name, entries,
+                       deadline_s: Optional[float] = None) -> dict:
+    """Push one map task's buckets to the server OWNING their reducers
+    (shuffle_plan=push): one `push_merged` round trip carrying every
+    (reduce_id, blob) this server owns. Returns the server's accounting
+    ({"merged": M, "stored": S, "duplicate": D}). Raises NetworkError on
+    failure — the caller degrades that row to pull-only (the local copy
+    is already durable), never fails the map task.
+
+    `deadline_s` (fetch_slow_server_s; PUSH_IO_DEADLINE_S when unset)
+    bounds every socket IO: a push is pure optimization, so a hung owner
+    must degrade the row to pull in deadline seconds, not gate the MAP
+    task on CONNECT/IO_TIMEOUT."""
+    deadline_s = deadline_s or PUSH_IO_DEADLINE_S
+    clean = False
+    try:
+        sock = _pooled_connection(uri, connect_timeout=deadline_s)
+        sock.settimeout(deadline_s)
+        protocol.send_msg(sock, "push_merged",
+                          (shuffle_id, map_id, attempt, op_name,
+                           [rid for rid, _ in entries]))
+        for _rid, blob in entries:
+            protocol.send_bytes(sock, blob)
+        reply_type, counts = protocol.recv_msg(sock)
+        if reply_type != "ok":
+            raise NetworkError(f"push refused: {reply_type!r}")
+        clean = True
+        sock.settimeout(protocol.IO_TIMEOUT)
+        return counts
+    finally:
+        if not clean:
+            _drop_connection(uri)
+
+
+def fetch_merged_remote(uri: str, shuffle_id: int, reduce_id: int,
+                        deadline_s: Optional[float] = None):
+    """Read the pre-merge tier for one reducer (shuffle_plan=push): ONE
+    `get_merged` round trip returning (merged_map_ids, frozen_blob_or_None,
+    [(map_id, raw_bucket), ...]). The first call freezes the server-side
+    merge (idempotent — retries and speculative duplicates read a stable
+    answer). Raises NetworkError on any transport fault; the caller then
+    treats the merged set as empty and pulls everything — degradation,
+    never a new failure mode.
+
+    `deadline_s` (fetch_slow_server_s; PUSH_IO_DEADLINE_S when unset)
+    bounds every socket IO of the round: unlike get_many, this read can
+    ALWAYS run under the tight deadline — an unresponsive owner merely
+    degrades to pull, so a hung server must not gate the reduce task on
+    CONNECT/IO_TIMEOUT."""
+    deadline_s = deadline_s or PUSH_IO_DEADLINE_S
+    clean = False
+    raws = []
+    try:
+        sock = _pooled_connection(uri, connect_timeout=deadline_s)
+        sock.settimeout(deadline_s)
+        protocol.send_msg(sock, "get_merged", (shuffle_id, reduce_id))
+        reply_type, head = protocol.recv_msg(sock)
+        if reply_type != "merged":
+            raise NetworkError(f"unexpected get_merged reply {reply_type!r}")
+        blob = protocol.recv_bytes(sock) if head.get("blob") else None
+        merged_ids = list(head.get("map_ids") or ()) if blob is not None \
+            else []
+        while True:
+            reply_type, payload = protocol.recv_msg(sock)
+            if reply_type == "bucket":
+                raws.append((payload, protocol.recv_bytes(sock)))
+            elif reply_type == "batch_end":
+                break
+            else:
+                raise NetworkError(
+                    f"unexpected get_merged stream frame {reply_type!r}")
+        clean = True
+        sock.settimeout(protocol.IO_TIMEOUT)
+        return merged_ids, blob, raws
     finally:
         if not clean:
             _drop_connection(uri)

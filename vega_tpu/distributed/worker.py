@@ -244,7 +244,17 @@ class Worker:
         env.cache_tracker = tracker
         # env.shuffle_store is the tiered store Env built (per-executor
         # spill dir under this process's session, conf-driven budgets).
-        env.shuffle_server = ShuffleServer(env.shuffle_store, host)
+        # Pre-merge accumulators are bounded at a QUARTER of the store
+        # budget: the store already admits shuffle_memory_budget bytes
+        # under its own accounting (spillable), while live MergeState
+        # accumulators cannot spill — a same-sized second budget would
+        # let a push-plan worker's resident footprint reach ~2x the
+        # knob. Past the quarter, pushes store-and-forward (which IS
+        # store-accounted), so worst case stays ~1.25x and shrinks as
+        # states freeze.
+        env.shuffle_server = ShuffleServer(
+            env.shuffle_store, host,
+            premerge_budget=conf.shuffle_memory_budget // 4)
 
         self.tracker = tracker
         # Deserialized stage binaries, one unpickle per stage per executor

@@ -164,6 +164,20 @@ class Configuration:
     # buckets are re-requested from a replica mid-stream, with no stage
     # resubmission and no map recompute.
     shuffle_replication: int = 1
+    # Shuffle plan (PR 8, Exoshuffle map-side push as a policy over the
+    # existing store/fetch primitives — never a fork of the plane):
+    #   "pull" (default) — the PR 4 pipeline: map outputs park locally,
+    #     reducers batch-fetch them after the whole map stage registered.
+    #   "push" — map tasks additionally push each finished bucket to its
+    #     reducer's OWNING server (rotation over the live peer list);
+    #     that server pre-merges mergeable buckets into the existing
+    #     MergeState machinery as they arrive, and reducers start from
+    #     ONE mostly-merged blob, pulling only the stragglers that never
+    #     arrived — the shuffle barrier becomes a map/reduce pipeline.
+    # Push is strictly additive: the local bucket row and its registered
+    # locations are byte-identical to the pull plan, so any push failure
+    # (dead peer, fleet churn, overflow) silently degrades to pull.
+    shuffle_plan: str = "pull"
     # When > 0 and every bucket requested from a server has at least one
     # replica location, the batched get_many round runs under this socket
     # deadline with no in-place retries: a server unresponsive past it
@@ -226,7 +240,7 @@ class Configuration:
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
                      "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
                      "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR",
-                     "SCHEDULER_MODE"):
+                     "SCHEDULER_MODE", "SHUFFLE_PLAN"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",

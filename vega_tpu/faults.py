@@ -20,7 +20,10 @@ the distributed plane consults at its natural failure seams:
                       serve_stream_fetch(i) (cut a get_many batch stream
                       after serving FETCH_DROP_AFTER_BUCKETS buckets — the
                       partial-batch fault the missing-tail retry must
-                      absorb without re-merging delivered buckets)
+                      absorb without re-merging delivered buckets),
+                      serve_push() (cut a push_merged round after the
+                      payload, before the ack — the push plan's degrade-
+                      to-pull and no-double-merge contract)
   - shuffle/store  -> corrupt_spilled(disk, key) (flip payload bytes in a
                       spilled bucket file — the checksummed read must turn
                       it into a miss, never wrong data)
@@ -52,6 +55,12 @@ tests:
   VEGA_TPU_FAULT_FETCH_DROP_AFTER_BUCKETS
                                      buckets to serve before the stream
                                      cut (default 1: deliver one, drop)
+  VEGA_TPU_FAULT_PUSH_DROP_N         cut the first N push_merged rounds
+                                     (shuffle_plan=push) AFTER the server
+                                     consumed the payload but BEFORE the
+                                     ack — the mapper must degrade that
+                                     row to pull, and a retried push must
+                                     never double-merge
   VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
   VEGA_TPU_FAULT_DROP_BINARY_N       drop the cached stage binary for the
                                      first N `binary_cached` task_v2
@@ -119,6 +128,7 @@ class FaultInjector:
         self.fetch_delay_s = _float("FETCH_DELAY_S") if armed else 0.0
         self.fetch_stream_drop_n = _int("FETCH_STREAM_DROP_N") if armed else 0
         self.fetch_drop_after_buckets = _int("FETCH_DROP_AFTER_BUCKETS", 1)
+        self.push_drop_n = _int("PUSH_DROP_N") if armed else 0
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
         self.drop_binary_n = _int("DROP_BINARY_N") if armed else 0
         self.stats_dir = env.get(pref + "STATS_DIR") or None
@@ -135,6 +145,7 @@ class FaultInjector:
             or self.suppress_heartbeats or self.fetch_drop_n
             or self.fetch_delay_s or self.corrupt_spill_n
             or self.fetch_stream_drop_n or self.drop_binary_n
+            or self.push_drop_n
         )
 
     def _targets_me(self) -> bool:
@@ -240,6 +251,22 @@ class FaultInjector:
         self._record("fetch_stream_drop", bucket_index=bucket_index)
         log.warning("FAULT: cutting get_many stream after %d buckets",
                     bucket_index)
+        return True
+
+    def serve_push(self) -> bool:
+        """shuffle_server.py, on a push_merged round (shuffle_plan=push):
+        True -> cut the connection after consuming the payload frames but
+        BEFORE feeding the tier or acking — the worst-timed drop: the
+        mapper sees a dead socket and must degrade that row to the pull
+        plan, and its local buckets must make the reducer whole."""
+        if not (self.active and self.push_drop_n and self._targets_me()):
+            return False
+        with self._lock:
+            if self.push_drop_n <= 0:
+                return False
+            self.push_drop_n -= 1
+        self._record("push_drop")
+        log.warning("FAULT: dropping shuffle push connection")
         return True
 
     def maybe_drop_binary(self) -> bool:

@@ -128,19 +128,31 @@ def get():
     with _lock:
         if _native is not None or _load_attempted:
             return _native
-        _load_attempted = True
+        # _load_attempted flips only AFTER the attempt concludes: setting
+        # it up front let the lock-free fast path above observe
+        # attempted=True with _native still None WHILE the import ran on
+        # another thread — so the first tasks of a concurrent stage
+        # nondeterministically fell back to the pickled path (a silent
+        # perf loss the push plan's pre-merge accounting surfaced).
+        # Callers racing the import now block on _lock and get the module.
         try:
-            from vega_tpu import _vega_native  # type: ignore[attr-defined]
+            try:
+                from vega_tpu import _vega_native  # type: ignore[attr-defined]
 
-            _native = _vega_native
-        except ImportError:
-            if _try_build():
-                try:
-                    from vega_tpu import _vega_native  # type: ignore
-
-                    _native = _vega_native
-                except ImportError:
-                    _native = None
+                _native = _vega_native
+            except ImportError:
+                if _try_build():
+                    try:
+                        from vega_tpu import _vega_native  # type: ignore
+                        _native = _vega_native
+                    except ImportError:
+                        _native = None
+        finally:
+            # finally: a CORRUPT .so whose module init raises something
+            # other than ImportError must still conclude the attempt —
+            # later callers degrade to the pure-Python fallback instead of
+            # re-raising on every hot-path call.
+            _load_attempted = True
         if _native is not None:
             log.info("native shuffle runtime loaded")
     return _native

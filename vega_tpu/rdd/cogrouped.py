@@ -69,7 +69,13 @@ class CoGroupedRDD(RDD):
                 # (reference: co_grouped_rdd.rs:226-243). fetch() streams —
                 # buckets decode and fold into the group table as they come
                 # off the wire (bounded by the fetch queue), never as a
-                # materialized List[bytes] of the whole input.
-                for k, vs in ShuffleFetcher.fetch(sid, split.index):
+                # materialized List[bytes] of the whole input. Under
+                # shuffle_plan=push, cogroup buckets (VG01 rows / pickles)
+                # have no combining monoid to pre-merge, so map tasks do
+                # NOT push them and `mergeable=False` skips the pre-merged
+                # read — this fetch runs the ordinary batched pull plan
+                # either way; same frames, same fold.
+                for k, vs in ShuffleFetcher.fetch(sid, split.index,
+                                                  mergeable=False):
                     slot(k)[i].extend(vs)
         return iter(groups.items())

@@ -53,11 +53,23 @@ class ShuffledRDD(RDD):
         # (pre-combined), all VG01 (raw group rows), or pickled — the map
         # side picks one encoding per shuffle — but heterogeneous streams
         # (mixed pickle + native across executors) still merge correctly.
+        # Under shuffle_plan=push the stream's FIRST frame is usually the
+        # owning server's frozen pre-merged blob — a normal VN01 frame
+        # covering most map outputs at once (merged server-side while the
+        # map stage still ran) — so this loop needs no push-plan special
+        # case; the int64-overflow redo below refetches the same frames
+        # (pre-merged or raw) and merge_encoded_py stays exact either way.
         merger = None  # lazy: non-native shuffles never build one
         combiners: dict = {}
         py_combined: dict = {}
+        # Mergeability mirrors dependency._push_row's gate: only shuffles
+        # with a recognized monoid ever pushed, so only those pay the
+        # push plan's pre-merged read.
+        mergeable = (self.aggregator.op_name in native.OP_BY_NAME
+                     and not self.aggregator.is_group)
         for blob in ShuffleFetcher.fetch_stream(self.shuffle_id,
-                                                split.index):
+                                                split.index,
+                                                mergeable=mergeable):
             magic = blob[:4]
             if magic == NATIVE_MAGIC:
                 if merger is None:
@@ -99,7 +111,8 @@ class ShuffledRDD(RDD):
                 flagged = [
                     (b[5:], 1 if b[4] == 1 else 0)
                     for b in ShuffleFetcher.fetch_blobs(self.shuffle_id,
-                                                        split.index)
+                                                        split.index,
+                                                        mergeable=mergeable)
                     if b[:4] == NATIVE_MAGIC
                 ]
                 merged = native.merge_encoded_py(

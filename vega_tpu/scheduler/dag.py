@@ -851,6 +851,20 @@ class DAGScheduler:
         location resolve, every reducer parks inside get_server_uris on
         the nulled entries and no fetch ever fails: the job would stall
         until resolve timeouts exhaust max_failures."""
+        # The shuffle-peer cache (dependency._peer_cache, feeding replica
+        # AND push-plan placement) must not keep targeting a peer the
+        # driver just declared dead for up to its 5s TTL: the push-failure
+        # invalidation only fires after a wasted round trip, whereas the
+        # loss is already known HERE. Invalidated unconditionally (before
+        # the shuffle_uri / lost-stage early returns — a lost executor
+        # stales the peer map even when it held no outputs yet). Scope:
+        # this clears the DRIVER process's cache (driver-side map/reduce
+        # work and tests); WORKER processes have no loss channel, so
+        # their copies stay bounded by the TTL plus the push-failure
+        # invalidation above.
+        from vega_tpu import dependency as _dependency
+
+        _dependency._invalidate_peer_cache()
         if not shuffle_uri:
             return
         with self._stages_lock:

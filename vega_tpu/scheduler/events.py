@@ -213,6 +213,30 @@ class ShuffleFetchCompleted(Event):
     net_s: float = 0.0
     overlap_s: float = 0.0
     batched: bool = True
+    # shuffle_plan=push: how many of `buckets` were delivered via the
+    # owning server's pre-merged blob instead of pulled raw — the
+    # pre-merged fraction is premerged_buckets / buckets.
+    premerged_buckets: int = 0
+
+
+@dataclasses.dataclass
+class ShufflePushCompleted(Event):
+    """One map task finished pushing its bucket row to the owning servers
+    (shuffle_plan=push; dependency._push_row). `merged` buckets fed a
+    server-side MergeState, `stored` were store-and-forwarded unmerged,
+    `duplicates` were dropped by the tier's map_id dedup (map retries —
+    never double-merged), `failed` degraded to the pull plan."""
+
+    shuffle_id: int = -1
+    map_id: int = -1
+    buckets: int = 0
+    nbytes: int = 0
+    merged: int = 0
+    stored: int = 0
+    duplicates: int = 0
+    failed: int = 0
+    targets: int = 0  # owner servers contacted (one round trip each)
+    wall_s: float = 0.0
 
 
 class Listener:
@@ -362,6 +386,15 @@ class MetricsListener(Listener):
         self.fetch_wall_s = 0.0
         self.fetch_net_s = 0.0
         self.fetch_overlap_s = 0.0
+        self.fetch_premerged_buckets = 0
+        # Push-plan counters (ShufflePushCompleted): map-side pushes into
+        # the owning servers' pre-merge tiers. benchmarks/
+        # shuffle_plan_ab.py and bench.py surface these as `shuffle_push`.
+        self.shuffle_push: Dict[str, Any] = {
+            "pushes": 0, "buckets": 0, "bytes": 0, "merged": 0,
+            "stored": 0, "duplicates": 0, "failed": 0, "targets": 0,
+            "wall_s": 0.0,
+        }
         # Task-dispatch-plane counters (TaskEnd.dispatch): driver-side
         # serialized bytes per leg, stage binaries actually shipped vs
         # worker cache hits, need_binary recoveries. benchmarks/
@@ -468,6 +501,20 @@ class MetricsListener(Listener):
                 self.fetch_wall_s += event.wall_s
                 self.fetch_net_s += event.net_s
                 self.fetch_overlap_s += event.overlap_s
+                self.fetch_premerged_buckets += event.premerged_buckets
+            elif isinstance(event, ShufflePushCompleted):
+                sp = self.shuffle_push
+                sp["pushes"] += 1
+                sp["buckets"] += event.buckets
+                sp["bytes"] += event.nbytes
+                sp["merged"] += event.merged
+                sp["stored"] += event.stored
+                sp["duplicates"] += event.duplicates
+                sp["failed"] += event.failed
+                sp["targets"] += event.targets
+                # Cumulative map-side push wall: the number that explains
+                # a map-stage regression on the push leg of an A/B.
+                sp["wall_s"] += event.wall_s
             elif isinstance(event, BlockSpilled):
                 self.spill_count += 1
                 self.spilled_bytes[event.store] = (
@@ -510,6 +557,10 @@ class MetricsListener(Listener):
                     "overlap_s": round(self.fetch_overlap_s, 6),
                     "failovers": self.fetch_failovers,
                     "failover_buckets": self.fetch_failover_buckets,
+                    "premerged_buckets": self.fetch_premerged_buckets,
                 },
+                "shuffle_push": {**self.shuffle_push,
+                                 "wall_s": round(
+                                     self.shuffle_push["wall_s"], 6)},
                 "dispatch": dict(self.dispatch),
             }

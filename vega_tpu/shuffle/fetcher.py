@@ -19,6 +19,13 @@ Configuration.fetch_queue_buckets in-flight buckets, never the whole input.
 `fetch_blobs` / `fetch` / `fetch_into` are thin wrappers over the stream;
 `fetch_batch_enabled=0` keeps the per-bucket `get` protocol live (same
 pipeline, one round trip per bucket).
+
+Under `shuffle_plan=push` the stream FIRST reads the reduce partition's
+owning server's pre-merge tier (one `get_merged` round trip): a frozen
+blob covering the map_ids that arrived pushed — merged server-side while
+the map stage was still running — plus any raw pushed buckets, then the
+pull rounds fetch only the stragglers. Exactly-once accounting spans the
+push/pull overlap through the same per-stream `delivered` set.
 """
 
 from __future__ import annotations
@@ -57,7 +64,7 @@ _TOTALS = {
     "streams": 0, "buckets": 0, "bytes": 0, "round_trips": 0,
     "net_s": 0.0, "wait_s": 0.0, "overlap_s": 0.0, "wall_s": 0.0,
     "peak_queued": 0, "duplicates": 0, "failovers": 0,
-    "failover_buckets": 0,
+    "failover_buckets": 0, "premerged": 0,
 }
 
 
@@ -77,7 +84,7 @@ def _bank_totals(stats: dict) -> None:
         _TOTALS["streams"] += 1
         for k in ("buckets", "bytes", "round_trips", "net_s", "wait_s",
                   "overlap_s", "wall_s", "duplicates", "failovers",
-                  "failover_buckets"):
+                  "failover_buckets", "premerged"):
             _TOTALS[k] += stats[k]
         if stats["peak_queued"] > _TOTALS["peak_queued"]:
             _TOTALS["peak_queued"] = stats["peak_queued"]
@@ -85,7 +92,8 @@ def _bank_totals(stats: dict) -> None:
 
 class ShuffleFetcher:
     @staticmethod
-    def fetch_stream(shuffle_id: int, reduce_id: int) -> Iterator[bytes]:
+    def fetch_stream(shuffle_id: int, reduce_id: int,
+                     mergeable: bool = True) -> Iterator[bytes]:
         """Yield the raw serialized buckets for `reduce_id` as they arrive,
         bounded-memory: at most Configuration.fetch_queue_buckets buckets
         sit decoded-but-unconsumed at any moment, so merge cost overlaps
@@ -129,18 +137,20 @@ class ShuffleFetcher:
             ) from e
         return ShuffleFetcher._stream(env, tracker,
                                       [list(lst) for lst in uri_lists],
-                                      shuffle_id, reduce_id)
+                                      shuffle_id, reduce_id,
+                                      mergeable=mergeable)
 
     @staticmethod
     def _stream(env, tracker, uri_lists: List[List[str]], shuffle_id: int,
-                reduce_id: int) -> Iterator[bytes]:
+                reduce_id: int, mergeable: bool = True) -> Iterator[bytes]:
         conf = env.conf
         batched = bool(getattr(conf, "fetch_batch_enabled", True))
         maxq = max(1, int(getattr(conf, "fetch_queue_buckets", 32)))
         slow_s = float(getattr(conf, "fetch_slow_server_s", 0.0) or 0.0)
         stats = {"buckets": 0, "bytes": 0, "round_trips": 0, "net_s": 0.0,
                  "wait_s": 0.0, "peak_queued": 0, "duplicates": 0,
-                 "failovers": 0, "failover_buckets": 0, "batched": batched}
+                 "failovers": 0, "failover_buckets": 0, "batched": batched,
+                 "premerged": 0}
         t_start = time.monotonic()
         delivered = set()
         total = len(uri_lists)
@@ -161,6 +171,90 @@ class ShuffleFetcher:
             return cursor[map_id] + 1 < len(uri_lists[map_id])
 
         try:
+            # -- push plan (shuffle_plan=push): before any pull round, read
+            # this reducer's OWNING server's pre-merge tier — ONE
+            # get_merged round trip returning a frozen blob that covers
+            # most map_ids (merged server-side while the map stage was
+            # still running) plus any raw store-and-forwarded pushed
+            # buckets. Everything it delivers joins the exactly-once
+            # `delivered` set, so the pull rounds below fetch ONLY the
+            # stragglers that never arrived pushed. Any failure here —
+            # dead owner, fleet churn, overflow-voided merge, plan
+            # mismatch — leaves `delivered` empty and the stream silently
+            # degrades to the PR 4 pull path: no new failure modes,
+            # FetchFailed semantics unchanged.
+            # `mergeable=False` (group/cogroup/opaque shuffles): the map
+            # side never pushes those (dependency._push_row's monoid
+            # gate), so the pre-read is skipped — an empty-by-construction
+            # get_merged round would only add latency per reduce task.
+            if mergeable and str(getattr(conf, "shuffle_plan",
+                                         "pull")).lower() == "push":
+                from vega_tpu.dependency import push_owner_uri
+                from vega_tpu.distributed.shuffle_server import (
+                    fetch_merged_remote)
+
+                owner = push_owner_uri(tracker, reduce_id)
+                merged_ids, blob, raws = [], None, []
+                if owner is not None:
+                    t_net = time.monotonic()
+                    try:
+                        if (env.shuffle_server is not None
+                                and owner == env.shuffle_server.uri):
+                            # Self-owned partition: read the local tier
+                            # in-process (the reduce-side mirror of the
+                            # map side's direct feed) instead of paying a
+                            # loopback round trip through our own server.
+                            # tier.read is the same call the get_merged
+                            # handler serves — one home for the no-blob-
+                            # voids-merged-set rule.
+                            merged_ids, blob, raws = \
+                                env.shuffle_server.premerge.read(
+                                    shuffle_id, reduce_id)
+                        else:
+                            # fetch_slow_server_s bounds this round when
+                            # set: a hung owner degrades to pull in
+                            # deadline seconds, never gating the reducer
+                            # on the 120s socket timeout.
+                            merged_ids, blob, raws = fetch_merged_remote(
+                                owner, shuffle_id, reduce_id,
+                                deadline_s=slow_s or None)
+                            stats["round_trips"] += 1
+                    except Exception as e:  # noqa: BLE001 — the pre-merged
+                        # read is an optimization; ANY failure (transport,
+                        # malformed reply, tier/store errors) must degrade
+                        # to pull, never fail the reduce task.
+                        log.warning(
+                            "pre-merged read of shuffle %d reduce %d from "
+                            "%s failed (%s); degrading to the pull plan",
+                            shuffle_id, reduce_id, owner, e)
+                        merged_ids, blob, raws = [], None, []
+                    dt = time.monotonic() - t_net
+                    # The pre-read is synchronous — the consumer was
+                    # blocked for all of it — so it lands in net_s AND
+                    # wait_s: network time no consumer work hid must not
+                    # inflate overlap_s (= net_s - wait_s), the number
+                    # A/B decisions key on.
+                    stats["net_s"] += dt
+                    stats["wait_s"] += dt
+                # The blob is all-or-nothing: it only counts when every
+                # id it claims is a valid, undelivered map output (a
+                # half-usable blob cannot be split — its rows are already
+                # merged together).
+                if blob is not None and merged_ids and all(
+                        0 <= m < total and m not in delivered
+                        for m in merged_ids):
+                    delivered.update(merged_ids)
+                    stats["buckets"] += len(merged_ids)
+                    stats["premerged"] += len(merged_ids)
+                    stats["bytes"] += len(blob)
+                    yield blob
+                for m, data in raws:
+                    if 0 <= m < total and m not in delivered:
+                        delivered.add(m)
+                        stats["buckets"] += 1
+                        stats["bytes"] += len(data)
+                        yield data
+
             while True:
                 # -- split undelivered buckets into local vs per-server
                 local_ids: List[int] = []
@@ -445,24 +539,31 @@ class ShuffleFetcher:
                     round_trips=stats["round_trips"],
                     wall_s=wall, net_s=stats["net_s"],
                     overlap_s=stats["overlap_s"], batched=batched,
+                    premerged_buckets=stats["premerged"],
                 ))
             except Exception:  # noqa: BLE001 — observability must not break IO
                 log.debug("fetch event emit failed", exc_info=True)
 
     @staticmethod
-    def fetch_blobs(shuffle_id: int, reduce_id: int) -> List[bytes]:
+    def fetch_blobs(shuffle_id: int, reduce_id: int,
+                    mergeable: bool = True) -> List[bytes]:
         """Materialize every bucket for `reduce_id` (thin wrapper over
         fetch_stream — same batching and recovery contract; use the stream
         directly when the merge can run incrementally)."""
-        return list(ShuffleFetcher.fetch_stream(shuffle_id, reduce_id))
+        return list(ShuffleFetcher.fetch_stream(shuffle_id, reduce_id,
+                                                mergeable=mergeable))
 
     @staticmethod
-    def fetch(shuffle_id: int, reduce_id: int) -> Iterator[Tuple]:
+    def fetch(shuffle_id: int, reduce_id: int,
+              mergeable: bool = True) -> Iterator[Tuple]:
         """Yield all (K, C) pairs destined for `reduce_id`, decoding each
-        bucket as it arrives off the stream (decode overlaps network)."""
+        bucket as it arrives off the stream (decode overlaps network).
+        `mergeable=False` marks a shuffle the push plan never pushes
+        (group/opaque) so the stream skips the pre-merged read."""
         from vega_tpu.dependency import NATIVE_GROUP_MAGIC, NATIVE_MAGIC
 
-        for blob in ShuffleFetcher.fetch_stream(shuffle_id, reduce_id):
+        for blob in ShuffleFetcher.fetch_stream(shuffle_id, reduce_id,
+                                                mergeable=mergeable):
             magic = blob[:4]
             if magic in (NATIVE_MAGIC, NATIVE_GROUP_MAGIC):
                 from vega_tpu import native
