@@ -2,7 +2,11 @@
 # vegalint gate: zero unsuppressed invariant findings over the tier-1
 # sweep set (vega_tpu/, tests/, bench.py). Exit nonzero on any finding;
 # scripts/t1.sh chains this after the test run so the tier-1 entrypoint
-# gates on a clean lint. Rule catalog: docs/LINTING.md.
+# gates on a clean lint. Rule catalog: docs/LINTING.md. The machine-
+# readable finding report (stable JSON schema) lands in
+# /tmp/vegalint.json for CI artifact pickup; repeat runs ride the
+# mtime-keyed result cache so the gate stays well under its 10s budget.
 set -o pipefail
 cd "$(dirname "$0")/.."
-exec python -m vega_tpu.lint vega_tpu tests bench.py "$@"
+exec python -m vega_tpu.lint vega_tpu tests bench.py \
+  --json-out /tmp/vegalint.json "$@"

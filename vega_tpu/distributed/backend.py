@@ -142,8 +142,49 @@ class DistributedBackend(TaskBackend):
         lost executor, from the reaper thread."""
         self._executor_lost_listeners.append(callback)
 
+    @staticmethod
+    def _worker_knobs(conf, incarnation: int = 0) -> Dict[str, str]:
+        """Every Configuration knob that WORKER-SIDE code reads
+        (worker.py, shuffle_server.py, shuffle/), as VEGA_TPU_* env vars.
+        The single source for both the spawned-subprocess environment and
+        the ssh `env K=V` command line, so the two launch paths cannot
+        drift — and the list vegalint VG010 checks worker-side reads
+        against: a knob read on the worker side but missing here is
+        silently stuck at its default in every executor."""
+        return {
+            "VEGA_TPU_DEPLOYMENT_MODE": "distributed",
+            "VEGA_TPU_HEARTBEAT_INTERVAL_S": str(conf.heartbeat_interval_s),
+            "VEGA_TPU_FETCH_RETRIES": str(conf.fetch_retries),
+            "VEGA_TPU_FETCH_RETRY_INTERVAL_S": str(
+                conf.fetch_retry_interval_s),
+            "VEGA_TPU_FETCH_BATCH_ENABLED":
+                "1" if conf.fetch_batch_enabled else "0",
+            "VEGA_TPU_FETCH_QUEUE_BUCKETS": str(conf.fetch_queue_buckets),
+            "VEGA_TPU_TASK_BINARY_DEDUP":
+                "1" if conf.task_binary_dedup else "0",
+            "VEGA_TPU_TASK_BINARY_CACHE_ENTRIES": str(
+                conf.task_binary_cache_entries),
+            # Straggler plane: map tasks replicate buckets, reduce
+            # tasks fail slow/dead servers over to the replicas.
+            "VEGA_TPU_SHUFFLE_REPLICATION": str(conf.shuffle_replication),
+            "VEGA_TPU_FETCH_SLOW_SERVER_S": str(conf.fetch_slow_server_s),
+            # Push plan: map tasks push buckets to their reducer's
+            # owning server; reducers read the pre-merged blob first.
+            "VEGA_TPU_SHUFFLE_PLAN": str(
+                getattr(conf, "shuffle_plan", "pull")),
+            # The worker sizes its shuffle store AND its pre-merge
+            # accumulator cap (a quarter of it) from this; unpropagated,
+            # a driver-side budget override never reached the fleet.
+            "VEGA_TPU_SHUFFLE_MEMORY_BUDGET": str(
+                conf.shuffle_memory_budget),
+            # Respawned incarnations disarm one-shot fault injections
+            # (faults.py): a chaos-killed slot comes back healthy.
+            "VEGA_TPU_FAULT_INCARNATION": str(incarnation),
+        }
+
     def _launch(self, executor_id: str, host: str,
                 incarnation: int = 0) -> subprocess.Popen:
+        knobs = self._worker_knobs(self.conf, incarnation)
         if host in ("127.0.0.1", "localhost"):
             cmd = [
                 sys.executable, "-m", "vega_tpu.distributed.worker",
@@ -153,38 +194,15 @@ class DistributedBackend(TaskBackend):
             ]
             # Workers are host-tier compute: keep them off the TPU.
             # Propagate the driver's logging/workdir config plus the
-            # fault-tolerance knobs (fetch retry, heartbeat cadence) so
-            # Context(...)-level overrides reach the fleet, not just
-            # env-var-configured runs.
+            # worker-side knobs so Context(...)-level overrides reach the
+            # fleet, not just env-var-configured runs. (Logging/workdir
+            # stay local-spawn-only: a remote host has its own fs.)
             worker_env = dict(
                 os.environ, JAX_PLATFORMS="cpu",
-                VEGA_TPU_DEPLOYMENT_MODE="distributed",
                 VEGA_TPU_LOG_LEVEL=str(self.conf.log_level),
                 VEGA_TPU_LOG_CLEANUP="true" if self.conf.log_cleanup else "false",
                 VEGA_TPU_LOCAL_DIR=self.conf.local_dir,
-                VEGA_TPU_HEARTBEAT_INTERVAL_S=str(self.conf.heartbeat_interval_s),
-                VEGA_TPU_FETCH_RETRIES=str(self.conf.fetch_retries),
-                VEGA_TPU_FETCH_RETRY_INTERVAL_S=str(self.conf.fetch_retry_interval_s),
-                VEGA_TPU_FETCH_BATCH_ENABLED=(
-                    "1" if self.conf.fetch_batch_enabled else "0"),
-                VEGA_TPU_FETCH_QUEUE_BUCKETS=str(self.conf.fetch_queue_buckets),
-                VEGA_TPU_TASK_BINARY_DEDUP=(
-                    "1" if self.conf.task_binary_dedup else "0"),
-                VEGA_TPU_TASK_BINARY_CACHE_ENTRIES=str(
-                    self.conf.task_binary_cache_entries),
-                # Straggler plane: map tasks replicate buckets, reduce
-                # tasks fail slow/dead servers over to the replicas.
-                VEGA_TPU_SHUFFLE_REPLICATION=str(
-                    self.conf.shuffle_replication),
-                VEGA_TPU_FETCH_SLOW_SERVER_S=str(
-                    self.conf.fetch_slow_server_s),
-                # Push plan: map tasks push buckets to their reducer's
-                # owning server; reducers read the pre-merged blob first.
-                VEGA_TPU_SHUFFLE_PLAN=str(
-                    getattr(self.conf, "shuffle_plan", "pull")),
-                # Respawned incarnations disarm one-shot fault injections
-                # (faults.py): a chaos-killed slot comes back healthy.
-                VEGA_TPU_FAULT_INCARNATION=str(incarnation),
+                **knobs,
             )
             worker_env.pop("PALLAS_AXON_POOL_IPS", None)
             return subprocess.Popen(
@@ -193,28 +211,13 @@ class DistributedBackend(TaskBackend):
             )
         # ssh launch (reference: context.rs:237-288) — assumes the
         # package is importable on the remote host. Popen env only reaches
-        # the local ssh client, so the fault-tolerance knobs ride the
-        # remote command line (`env K=V ...`) — a remote worker heartbeating
-        # at a default slower than the driver's liveness bound would be
-        # reaped while healthy.
+        # the local ssh client, so the knobs ride the remote command line
+        # (`env K=V ...`) — a remote worker heartbeating at a default
+        # slower than the driver's liveness bound would be reaped while
+        # healthy.
         cmd = [
             "ssh", host, "env",
-            "VEGA_TPU_DEPLOYMENT_MODE=distributed",
-            f"VEGA_TPU_HEARTBEAT_INTERVAL_S={self.conf.heartbeat_interval_s}",
-            f"VEGA_TPU_FETCH_RETRIES={self.conf.fetch_retries}",
-            f"VEGA_TPU_FETCH_RETRY_INTERVAL_S={self.conf.fetch_retry_interval_s}",
-            "VEGA_TPU_FETCH_BATCH_ENABLED="
-            + ("1" if self.conf.fetch_batch_enabled else "0"),
-            f"VEGA_TPU_FETCH_QUEUE_BUCKETS={self.conf.fetch_queue_buckets}",
-            "VEGA_TPU_TASK_BINARY_DEDUP="
-            + ("1" if self.conf.task_binary_dedup else "0"),
-            "VEGA_TPU_TASK_BINARY_CACHE_ENTRIES="
-            + str(self.conf.task_binary_cache_entries),
-            f"VEGA_TPU_SHUFFLE_REPLICATION={self.conf.shuffle_replication}",
-            f"VEGA_TPU_FETCH_SLOW_SERVER_S={self.conf.fetch_slow_server_s}",
-            "VEGA_TPU_SHUFFLE_PLAN="
-            + str(getattr(self.conf, "shuffle_plan", "pull")),
-            f"VEGA_TPU_FAULT_INCARNATION={incarnation}",
+            *[f"{k}={v}" for k, v in sorted(knobs.items())],
             sys.executable, "-m",
             "vega_tpu.distributed.worker",
             "--driver", self.service.uri,
@@ -258,6 +261,19 @@ class DistributedBackend(TaskBackend):
         return box["line"]
 
     @staticmethod
+    def _confirm_task_port(executor_id: str, task_uri: str) -> None:
+        """READY only proves the worker PRINTED; ping the task port before
+        marking the slot live, so a worker whose server thread died
+        between bind and serve (or whose READY line raced a crash) fails
+        the launch loudly instead of eating its first max_failures worth
+        of dispatches. Raises NetworkError on no (or wrong) answer."""
+        host, port = protocol.parse_uri(task_uri)
+        got = protocol.request(host, port, "ping", timeout=5.0)
+        if got != executor_id:
+            raise NetworkError(
+                f"worker {executor_id} task port answered ping as {got!r}")
+
+    @staticmethod
     def _drain_stdout(executor_id: str, proc: subprocess.Popen) -> None:
         """Keep reading the worker's stdout after READY. The PIPE buffer is
         ~64 KB: a chatty worker (user print()s in tasks) would otherwise
@@ -285,6 +301,11 @@ class DistributedBackend(TaskBackend):
         for executor_id, host, proc in procs:
             line = self._wait_ready(executor_id, proc, deadline)
             _tag, wid, task_uri = line.split()
+            try:
+                self._confirm_task_port(wid, task_uri)
+            except NetworkError:
+                proc.kill()  # READY-but-unserving: don't leak the process
+                raise
             with self._lock:
                 self._executors[wid] = _Executor(wid, task_uri, host, proc)
             self._drain_stdout(wid, proc)
@@ -443,6 +464,11 @@ class DistributedBackend(TaskBackend):
             proc = self._launch(ex.executor_id, ex.host, incarnation=attempt)
             line = self._wait_ready(ex.executor_id, proc, time.time() + 30.0)
             _tag, wid, task_uri = line.split()
+            try:
+                self._confirm_task_port(wid, task_uri)
+            except NetworkError:
+                proc.kill()  # READY-but-unserving: don't leak the process
+                raise
         except (NetworkError, ValueError) as e:
             log.warning("respawn of %s failed: %s", ex.executor_id, e)
             # Count the failed attempt so backoff keeps growing and the
@@ -724,6 +750,7 @@ class DistributedBackend(TaskBackend):
                             # detected by the OS (socket reset; keepalive
                             # covers remote hosts) or by the reaper — not
                             # by an arbitrary IO timeout.
+                            # vegalint: ignore[VG012] — deliberately unbounded: tasks may run for hours; executor death unblocks via the reaper's socket shutdown / OS keepalive
                             sock.settimeout(None)
                             sock.setsockopt(socket.SOL_SOCKET,
                                             socket.SO_KEEPALIVE, 1)

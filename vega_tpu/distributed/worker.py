@@ -175,6 +175,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                             )
                         raw = protocol.recv_bytes(sock)
                     finally:
+                        # vegalint: ignore[VG012] — restores the handler socket's normal no-deadline idle state after the bounded re-ship window
                         sock.settimeout(None)
             if binary is None:
                 binary = worker.binaries.load(sha, raw, claim)

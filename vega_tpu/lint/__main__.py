@@ -1,9 +1,11 @@
-"""CLI: python -m vega_tpu.lint [paths...] [--format text|json]
-[--select VG001,VG003] [--list-rules]
+"""CLI: python -m vega_tpu.lint [paths...] [--output text|json]
+[--json-out PATH] [--select VG001,VG003] [--list-rules] [--no-cache]
 
 Exit status: 0 clean, 1 unsuppressed findings (or unparseable files),
 2 usage error. The tier-1 entrypoint (scripts/t1.sh) gates on this via
-scripts/lint.sh.
+scripts/lint.sh, which also writes the machine-readable finding JSON
+(stable schema: engine.JSON_SCHEMA) to /tmp/vegalint.json via
+--json-out for CI artifact pickup.
 """
 
 from __future__ import annotations
@@ -28,11 +30,17 @@ def main(argv=None) -> int:
                         default=["vega_tpu", "tests", "bench.py"],
                         help="files or directories (default: the tier-1 "
                              "sweep set)")
-    parser.add_argument("--format", choices=("text", "json"),
-                        default="text")
+    parser.add_argument("--format", "--output", dest="format",
+                        choices=("text", "json"), default="text",
+                        help="stdout format (--output is an alias)")
+    parser.add_argument("--json-out", default=None, metavar="PATH",
+                        help="additionally write the JSON report (stable "
+                             "finding schema) to PATH — CI artifact")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run (default: "
                              "all)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the mtime-keyed result cache")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -47,10 +55,21 @@ def main(argv=None) -> int:
     select = [s.strip() for s in args.select.split(",")] \
         if args.select else None
     try:
-        result = run_lint(args.paths, select=select)
+        result = run_lint(args.paths, select=select,
+                          cache=not args.no_cache)
     except ValueError as exc:  # unknown --select rule id
         print(f"vegalint: {exc}", file=sys.stderr)
         return 2
+    if args.json_out:
+        try:
+            with open(args.json_out, "w", encoding="utf-8") as f:
+                f.write(render_json(result) + "\n")
+        except OSError as exc:
+            # The artifact is a convenience copy; an IO failure (foreign
+            # file in a shared temp dir, read-only fs) must not make a
+            # clean tree look like a failed gate.
+            print(f"vegalint: could not write --json-out artifact: {exc}",
+                  file=sys.stderr)
     print(render_json(result) if args.format == "json"
           else render_text(result))
     return 0 if result.ok else 1

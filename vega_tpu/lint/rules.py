@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG008: the project invariants as AST checks.
+"""vegalint rules VG001–VG012: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -7,11 +7,23 @@ is unguarded again — so every heuristic here is tuned to the failure mode
 that actually bit this repo, not to theoretical completeness. The dynamic
 complement (vega_tpu/lint/sync_witness.py) covers what lexical analysis
 cannot see at runtime.
+
+VG001–VG008 are the per-file (and lock-graph) invariants from PRs 3 and
+7. VG009–VG012 are the cross-process CONTRACT rules: a shared per-file
+index pass (``_contract_extract``, cached by the engine) reduces each
+file to its protocol/config/event surfaces, and global combines join
+the index — every sent msg_type has a dispatch arm and vice versa
+(VG009), every worker-side Configuration read is propagated to spawned/
+ssh workers and every VEGA_TPU_* literal resolves (VG010), every
+listener field read exists on the event schema and every emitted event
+is aggregated (VG011), and no cross-process socket op waits unbounded
+(VG012).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from vega_tpu.lint.engine import FileCtx, Finding, rule
@@ -81,6 +93,8 @@ def _banned_prefix(qual: Optional[str]) -> Optional[str]:
 def vg001(ctx: FileCtx) -> Iterator[Finding]:
     if ctx.endswith("tpu/compat.py"):
         return
+    if "jax" not in ctx.source:
+        return  # no alias can reach jax.* without the literal appearing
     # Import sites: `from jax.experimental.shard_map import ...`,
     # `from jax import export`, `from jax.lax import platform_dependent`.
     for node in ast.walk(ctx.tree):
@@ -145,6 +159,8 @@ def _is_main_guard(test: ast.AST) -> bool:
 
 @rule("VG002", "device probe reachable at module import time")
 def vg002(ctx: FileCtx) -> Iterator[Finding]:
+    if "jax" not in ctx.source:
+        return  # probes are jax.* calls; cheap gate saves the deep walk
     # Local functions that probe: a module-level call to one of them is
     # just as import-hanging as the probe itself (one hop, same module).
     probe_funcs: Set[str] = set()
@@ -234,40 +250,55 @@ def _lock_ctor(call: ast.AST, ctx: FileCtx) -> Optional[bool]:
     return None
 
 
-class _Vg003State:
-    def __init__(self) -> None:
-        self.locks: Dict[str, bool] = {}  # key -> reentrant
-        # (a, b) -> (display, line) of first `acquire b while holding a`
-        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
-        # (module, cls, fname) -> direct lock keys it acquires
-        self.fn_locks: Dict[Tuple[str, Optional[str], str], Set[str]] = {}
-        # deferred call hops: (held keys, callee, display, line)
-        self.calls: List[Tuple[List[str], Tuple, str, int]] = []
-        self.findings: List[Finding] = []
+# The analysis runs in two cacheable passes (engine.py result cache):
+# `_vg003_extract` reduces one file to plain data — lock definitions plus
+# acquisition/call/blocking sites whose lock operands are DESCRIPTORS
+# (unresolved references) — and the project-wide combine resolves
+# descriptors against the global lock set, builds the acquisition graph,
+# and reports cycles. Descriptors defer exactly the lookups that need
+# other files' lock definitions (imported locks, foreign attributes), so
+# per-file extraction stays byte-stable while the rest of the tree
+# changes.
 
 
-def _vg003_lock_key(expr: ast.AST, ctx: FileCtx, cls: Optional[str],
-                    state: _Vg003State) -> Optional[str]:
+def _vg003_desc(expr: ast.AST, ctx: FileCtx,
+                cls: Optional[str]) -> Optional[tuple]:
+    """Unresolved lock reference for a with-item / acquire operand."""
     if isinstance(expr, ast.Name):
-        key = f"{ctx.module}.{expr.id}"
-        if key in state.locks:
-            return key
-        alias = ctx.aliases.get(expr.id)
-        if alias and alias in state.locks:
-            return alias
-        return key if "lock" in expr.id.lower() else None
+        return ("name", ctx.module, expr.id, ctx.aliases.get(expr.id))
     if isinstance(expr, ast.Attribute):
         base = _base_name(expr)
         if base == "self" and isinstance(expr.value, ast.Name):
-            key = f"{ctx.module}.{cls}.{expr.attr}" if cls else None
-            if key:
-                return key if (key in state.locks
-                               or "lock" in expr.attr.lower()) else None
-        qual = ctx.qualified(expr)
-        if qual and qual in state.locks:
-            return qual
-        if "lock" in expr.attr.lower():
-            return f"{ctx.module}.?.{expr.attr}"  # opaque foreign lock
+            return ("self", ctx.module, cls, expr.attr)
+        return ("attr", ctx.qualified(expr), expr.attr, ctx.module)
+    return None
+
+
+def _vg003_resolve(desc: Optional[tuple],
+                   locks: Dict[str, bool]) -> Optional[str]:
+    """Descriptor -> lock key, given every file's lock definitions."""
+    if desc is None:
+        return None
+    kind = desc[0]
+    if kind == "name":
+        _, module, name, alias = desc
+        key = f"{module}.{name}"
+        if key in locks:
+            return key
+        if alias and alias in locks:
+            return alias
+        return key if "lock" in name.lower() else None
+    if kind == "self":
+        _, module, cls, attr = desc
+        if cls is None:
+            return None
+        key = f"{module}.{cls}.{attr}"
+        return key if (key in locks or "lock" in attr.lower()) else None
+    _, qual, attr, module = desc
+    if qual and qual in locks:
+        return qual
+    if "lock" in attr.lower():
+        return f"{module}.?.{attr}"  # opaque foreign lock
     return None
 
 
@@ -299,43 +330,35 @@ def _blocking_desc(call: ast.Call) -> Optional[str]:
 
 
 def _vg003_scan_fn(body: List[ast.stmt], ctx: FileCtx, cls: Optional[str],
-                   fname: str, state: _Vg003State) -> None:
-    direct: Set[str] = set()
+                   fname: str, data: dict) -> None:
+    direct: List[tuple] = []
     nested: List[Tuple[List[ast.stmt], Optional[str], str]] = []
 
-    def walk(node: ast.AST, held: List[str]) -> None:
+    def walk(node: ast.AST, held: List[tuple]) -> None:
         if isinstance(node, _FUNC_DEFS):
             nested.append((node.body, cls, node.name))
             return  # a nested def runs later, not under the held locks
         if isinstance(node, ast.Lambda):
             return
         if isinstance(node, ast.With):
-            here: List[str] = []
+            here: List[tuple] = []
             for item in node.items:
                 walk(item.context_expr, held + here)
-                key = _vg003_lock_key(item.context_expr, ctx, cls, state)
-                if key is None:
+                desc = _vg003_desc(item.context_expr, ctx, cls)
+                if desc is None:
                     continue
-                for h in held + here:
-                    if h == key and state.locks.get(key):
-                        continue  # reentrant re-acquire is fine
-                    state.edges.setdefault(
-                        (h, key), (ctx.display, item.context_expr.lineno))
-                here.append(key)
-                direct.add(key)
+                data["acquires"].append(
+                    (held + here, desc, item.context_expr.lineno))
+                here = here + [desc]
+                direct.append(desc)
             for b in node.body:
                 walk(b, held + here)
             return
         if isinstance(node, ast.Call):
             desc = _blocking_desc(node)
-            cacheish = [h for h in held if _is_cacheish(h)]
-            if desc and cacheish:
-                state.findings.append(Finding(
-                    "VG003", ctx.display, node.lineno,
-                    node.col_offset + 1,
-                    f"blocking {desc} while holding cache/store lock "
-                    f"'{cacheish[-1]}' — can deadlock or starve the "
-                    "1-core sandbox (the seed-suite XLA:CPU wedge)"))
+            if desc and held:
+                data["blocking"].append(
+                    (desc, list(held), node.lineno, node.col_offset + 1))
             if held:
                 callee: Optional[Tuple] = None
                 f = node.func
@@ -346,78 +369,127 @@ def _vg003_scan_fn(body: List[ast.stmt], ctx: FileCtx, cls: Optional[str],
                 elif isinstance(f, ast.Name):
                     callee = (ctx.module, None, f.id)
                 if callee is not None:
-                    state.calls.append(
-                        (list(held), callee, ctx.display, node.lineno))
+                    data["calls"].append(
+                        (list(held), callee, node.lineno))
         for child in ast.iter_child_nodes(node):
             walk(child, held)
 
     for stmt in body:
         walk(stmt, [])
-    fn_key = (ctx.module, cls, fname)
-    state.fn_locks.setdefault(fn_key, set()).update(direct)
+    data["fn_locks"].setdefault((ctx.module, cls, fname),
+                                []).extend(direct)
     for nbody, ncls, nname in nested:
-        _vg003_scan_fn(nbody, ctx, ncls, nname, state)
+        _vg003_scan_fn(nbody, ctx, ncls, nname, data)
+
+
+def _vg003_extract(ctx: FileCtx) -> Optional[dict]:
+    """Per-file half of VG003: lock definitions + unresolved acquisition/
+    call/blocking sites (cached by the engine; combine resolves them)."""
+    if not ctx.in_dir("vega_tpu"):
+        return None
+    data: dict = {"locks": {}, "acquires": [], "fn_locks": {},
+                  "calls": [], "blocking": []}
+    # Lock definitions (module-level names and self.X attributes).
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.targets[0], ast.Name):
+            r = _lock_ctor(node.value, ctx)
+            if r is not None:
+                data["locks"][f"{ctx.module}.{node.targets[0].id}"] = r
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            r = _lock_ctor(sub.value, ctx)
+            if r is None:
+                continue
+            t = sub.targets[0]
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                data["locks"][f"{ctx.module}.{node.name}.{t.attr}"] = r
+            elif isinstance(t, ast.Name):  # class-body lock (Env._lock)
+                data["locks"][f"{ctx.module}.{node.name}.{t.id}"] = r
+    # Acquisitions — module body, functions, methods.
+    _vg003_scan_fn(
+        [s for s in ctx.tree.body
+         if not isinstance(s, _FUNC_DEFS + (ast.ClassDef,))],
+        ctx, None, "<module>", data)
+    for node in ctx.tree.body:
+        if isinstance(node, _FUNC_DEFS):
+            _vg003_scan_fn(node.body, ctx, None, node.name, data)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, _FUNC_DEFS):
+                    _vg003_scan_fn(sub.body, ctx, node.name,
+                                   sub.name, data)
+    if not (data["locks"] or data["acquires"] or data["calls"]
+            or data["blocking"]):
+        return None
+    return data
 
 
 @rule("VG003", "lock-order cycles and blocking calls under cache/store "
-      "locks", project=True)
-def vg003(ctxs: List[FileCtx]) -> Iterator[Finding]:
-    ctxs = [c for c in ctxs if c.in_dir("vega_tpu")]
-    state = _Vg003State()
-    # Pass 1: lock definitions (module-level names and self.X attributes).
-    for ctx in ctxs:
-        for node in ctx.tree.body:
-            if isinstance(node, ast.Assign) \
-                    and isinstance(node.targets[0], ast.Name):
-                r = _lock_ctor(node.value, ctx)
-                if r is not None:
-                    state.locks[f"{ctx.module}.{node.targets[0].id}"] = r
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ClassDef):
+      "locks", project=True, extract=_vg003_extract)
+def vg003(records: List[Tuple[str, dict]]) -> Iterator[Finding]:
+    # Pass 1: the global lock set (descriptor resolution needs it).
+    locks: Dict[str, bool] = {}
+    for _display, data in records:
+        locks.update(data["locks"])
+    findings: List[Finding] = []
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    fn_locks: Dict[Tuple, Set[str]] = {}
+    # Pass 2: resolve acquisition sites into graph edges + blocking
+    # findings, in file order (first site wins, as before the split).
+    for display, data in records:
+        for held_descs, desc, line in data["acquires"]:
+            key = _vg003_resolve(desc, locks)
+            if key is None:
                 continue
-            for sub in ast.walk(node):
-                if not isinstance(sub, ast.Assign):
+            for h_desc in held_descs:
+                h = _vg003_resolve(h_desc, locks)
+                if h is None:
                     continue
-                r = _lock_ctor(sub.value, ctx)
-                if r is None:
-                    continue
-                t = sub.targets[0]
-                if isinstance(t, ast.Attribute) \
-                        and isinstance(t.value, ast.Name) \
-                        and t.value.id == "self":
-                    state.locks[f"{ctx.module}.{node.name}.{t.attr}"] = r
-                elif isinstance(t, ast.Name):  # class-body lock (Env._lock)
-                    state.locks[f"{ctx.module}.{node.name}.{t.id}"] = r
-    # Pass 2: acquisitions — module body, functions, methods.
-    for ctx in ctxs:
-        _vg003_scan_fn(
-            [s for s in ctx.tree.body
-             if not isinstance(s, _FUNC_DEFS + (ast.ClassDef,))],
-            ctx, None, "<module>", state)
-        for node in ctx.tree.body:
-            if isinstance(node, _FUNC_DEFS):
-                _vg003_scan_fn(node.body, ctx, None, node.name, state)
-            elif isinstance(node, ast.ClassDef):
-                for sub in node.body:
-                    if isinstance(sub, _FUNC_DEFS):
-                        _vg003_scan_fn(sub.body, ctx, node.name,
-                                       sub.name, state)
+                if h == key and locks.get(key):
+                    continue  # reentrant re-acquire is fine
+                edges.setdefault((h, key), (display, line))
+        for fn_key, descs in data["fn_locks"].items():
+            fn_locks.setdefault(fn_key, set()).update(
+                k for k in (_vg003_resolve(d, locks) for d in descs)
+                if k is not None)
+        for desc_text, held_descs, line, col in data["blocking"]:
+            held = [k for k in (_vg003_resolve(d, locks)
+                                for d in held_descs) if k is not None]
+            cacheish = [h for h in held if _is_cacheish(h)]
+            if cacheish:
+                findings.append(Finding(
+                    "VG003", display, line, col,
+                    f"blocking {desc_text} while holding cache/store lock "
+                    f"'{cacheish[-1]}' — can deadlock or starve the "
+                    "1-core sandbox (the seed-suite XLA:CPU wedge)"))
     # Pass 3: one call hop — held locks flow into the callee's direct set.
-    for held, callee, display, line in state.calls:
-        for key in state.fn_locks.get(callee, ()):
-            for h in held:
-                if h == key and state.locks.get(key):
-                    continue
-                state.edges.setdefault((h, key), (display, line))
+    for display, data in records:
+        for held_descs, callee, line in data["calls"]:
+            held = [k for k in (_vg003_resolve(d, locks)
+                                for d in held_descs) if k is not None]
+            if not held:
+                continue
+            for key in fn_locks.get(tuple(callee), ()):
+                for h in held:
+                    if h == key and locks.get(key):
+                        continue
+                    edges.setdefault((h, key), (display, line))
     # Pass 4: cycles (including non-reentrant self-acquisition).
     adj: Dict[str, Set[str]] = {}
-    for (a, b), _site in state.edges.items():
+    for (a, b), _site in edges.items():
         adj.setdefault(a, set()).add(b)
     seen_cycles: Set[Tuple[str, ...]] = set()
-    for (a, b), (display, line) in sorted(state.edges.items(),
+    for (a, b), (display, line) in sorted(edges.items(),
                                           key=lambda kv: kv[1]):
         if a == b:
-            state.findings.append(Finding(
+            findings.append(Finding(
                 "VG003", display, line, 1,
                 f"non-reentrant lock '{a}' re-acquired while already "
                 "held — self-deadlock"))
@@ -431,11 +503,11 @@ def vg003(ctxs: List[FileCtx]) -> Iterator[Finding]:
         if canon in seen_cycles:
             continue
         seen_cycles.add(canon)
-        state.findings.append(Finding(
+        findings.append(Finding(
             "VG003", display, line, 1,
             "lock-order cycle: " + " -> ".join(cycle + [cycle[0]])
             + " — two threads taking these in opposite order deadlock"))
-    yield from state.findings
+    yield from findings
 
 
 def _find_path(adj: Dict[str, Set[str]], src: str,
@@ -770,3 +842,462 @@ def vg008(ctx: FileCtx) -> Iterator[Finding]:
                 f"direct DAGScheduler.{attr} call bypasses the job "
                 "server (no pool/quota arbitration, no cancellation) — "
                 "route through Context.submit_job/run_job")
+
+
+# ---------------------------------------------------------------------------
+# Contract index — the shared per-file extraction behind VG009-VG011
+# ---------------------------------------------------------------------------
+# PRs 4-8 grew three cross-process contract surfaces: the framed-TCP
+# message grammar (protocol.py), the Configuration -> env -> spawned/ssh
+# worker knob pipeline (env.py + backend._worker_knobs), and the job-scoped
+# event-bus schema (scheduler/events.py). Each is enforced only at runtime
+# otherwise, and a typo in any of them is a silent cross-process wedge.
+# One walk per file reduces the surfaces to plain data (cached by the
+# engine); the rules below are global joins over that index.
+
+_VG009_SEND_ARG = {"send_msg": 1, "encode_msg": 0, "_call": 0}
+_VG009_DISPATCH_VARS = {"msg_type", "reply_type", "marker"}
+_ENV_NAME_RE = re.compile(r"VEGA_TPU_[A-Z0-9_]*[A-Z0-9]")
+# Infrastructure knobs that are deliberately NOT Configuration fields:
+# the sync-witness switch, the hardware-test gate, and the lint engine's
+# own cache override (docs/LINTING.md VG010).
+_VG010_ALLOWLIST = {"VEGA_TPU_DEBUG_SYNC", "VEGA_TPU_HW_TESTS",
+                    "VEGA_TPU_LINT_CACHE"}
+_VG010_WORKER_SIDE = ("distributed/worker.py",
+                      "distributed/shuffle_server.py")
+
+
+def _docstring_ids(tree: ast.AST) -> Set[int]:
+    """ids of docstring Constant nodes (module/class/function bodies)."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef) + _FUNC_DEFS):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _conf_receiver(node: ast.AST) -> bool:
+    """True for conf / self.conf / env.conf / Env.get().conf receivers."""
+    return (isinstance(node, ast.Name) and node.id == "conf") or \
+        (isinstance(node, ast.Attribute) and node.attr == "conf")
+
+
+def _event_reads_of(fn: ast.AST) -> List[tuple]:
+    """Attribute loads on `event` inside an on_event listener, with
+    isinstance narrowing: reads in the body (and test) of an
+    `if isinstance(event, X):` are checked against X's fields only."""
+    reads: List[tuple] = []
+
+    def isinstance_classes(test: ast.AST) -> List[str]:
+        found: List[str] = []
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call) \
+                    and _last_name(sub.func) == "isinstance" \
+                    and len(sub.args) == 2 \
+                    and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id == "event":
+                t = sub.args[1]
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                found.extend(n for n in (_last_name(e) for e in elts) if n)
+        return found
+
+    def walk(node: ast.AST, narrow: Optional[tuple]) -> None:
+        if isinstance(node, ast.If):
+            classes = isinstance_classes(node.test)
+            inner = tuple(classes) if classes else narrow
+            walk_children(node.test, inner)
+            for b in node.body:
+                walk(b, inner)
+            for b in node.orelse:
+                walk(b, narrow)
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "event" \
+                and isinstance(node.ctx, ast.Load):
+            reads.append((node.attr, node.lineno, node.col_offset + 1,
+                          narrow))
+        walk_children(node, narrow)
+
+    def walk_children(node: ast.AST, narrow: Optional[tuple]) -> None:
+        for child in ast.iter_child_nodes(node):
+            walk(child, narrow)
+
+    for stmt in fn.body:
+        walk(stmt, None)
+    return reads
+
+
+def _contract_extract(ctx: FileCtx) -> Optional[dict]:
+    out: dict = {}
+    docstrings = _docstring_ids(ctx.tree)
+
+    # --- protocol sends + dispatch arms (the framed-TCP grammar) -------
+    if ctx.in_dir("vega_tpu", "distributed"):
+        sends: List[tuple] = []
+        arms: List[tuple] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = _last_name(node.func)
+                idx = _VG009_SEND_ARG.get(name)
+                if name == "request":
+                    idx = 2
+                if idx is not None and len(node.args) > idx \
+                        and isinstance(node.args[idx], ast.Constant) \
+                        and isinstance(node.args[idx].value, str):
+                    sends.append((node.args[idx].value, node.lineno,
+                                  node.col_offset + 1))
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                for var, lit in ((node.left, node.comparators[0]),
+                                 (node.comparators[0], node.left)):
+                    if isinstance(var, ast.Name) \
+                            and var.id in _VG009_DISPATCH_VARS \
+                            and isinstance(lit, ast.Constant) \
+                            and isinstance(lit.value, str):
+                        arms.append((lit.value, node.lineno,
+                                     node.col_offset + 1))
+        if sends:
+            out["sends"] = sends
+        if arms:
+            out["arms"] = arms
+
+    # --- worker-side Configuration reads + the propagation list --------
+    if ctx.in_dir("vega_tpu", "shuffle") \
+            or any(ctx.endswith(s) for s in _VG010_WORKER_SIDE):
+        reads: List[tuple] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _conf_receiver(node.value):
+                reads.append((node.attr, node.lineno, node.col_offset + 1))
+            elif isinstance(node, ast.Call) \
+                    and _last_name(node.func) == "getattr" \
+                    and len(node.args) >= 2 \
+                    and _conf_receiver(node.args[0]) \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                reads.append((node.args[1].value, node.lineno,
+                              node.col_offset + 1))
+        if reads:
+            out["knob_reads"] = reads
+    if ctx.endswith("distributed/backend.py"):
+        propagated: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg and kw.arg.startswith("VEGA_TPU_"):
+                        propagated.add(kw.arg)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and id(node) not in docstrings:
+                m = re.match(r"(VEGA_TPU_[A-Z0-9_]*[A-Z0-9])(=|$)",
+                             node.value)
+                if m:
+                    propagated.add(m.group(1))
+        if propagated:
+            out["propagation"] = sorted(propagated)
+
+    # --- Configuration fields + fault knobs (resolution targets) -------
+    if ctx.endswith("vega_tpu/env.py"):
+        fields = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == "Configuration":
+                fields = [s.target.id for s in node.body
+                          if isinstance(s, ast.AnnAssign)
+                          and isinstance(s.target, ast.Name)]
+        if fields:
+            out["config_fields"] = fields
+    if ctx.endswith("vega_tpu/faults.py"):
+        knobs = sorted({
+            node.value for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in docstrings
+            and re.fullmatch(r"[A-Z][A-Z0-9_]*[A-Z0-9]", node.value)})
+        if knobs:
+            out["fault_knobs"] = knobs
+
+    # --- every VEGA_TPU_* env literal (typo class) ----------------------
+    if "VEGA_TPU_" in ctx.source:
+        env_lits: List[tuple] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and id(node) not in docstrings \
+                    and "VEGA_TPU_" in node.value:
+                for m in _ENV_NAME_RE.finditer(node.value):
+                    end = m.end()
+                    if end < len(node.value) and node.value[end] == "_":
+                        continue  # a prefix constant ("VEGA_TPU_FAULT_")
+                    env_lits.append((m.group(0), node.lineno,
+                                     node.col_offset + 1))
+        if env_lits:
+            out["env_literals"] = env_lits
+
+    # --- event schema: classes, listener reads, emissions ---------------
+    if ctx.endswith("scheduler/events.py"):
+        classes: Dict[str, List[str]] = {}
+        aggregated: List[str] = []
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {_last_name(b) for b in node.bases}
+            if node.name == "Event" or "Event" in bases:
+                classes[node.name] = [
+                    s.target.id for s in node.body
+                    if isinstance(s, ast.AnnAssign)
+                    and isinstance(s.target, ast.Name)]
+            if node.name == "MetricsListener":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and _last_name(sub.func) == "isinstance" \
+                            and len(sub.args) == 2:
+                        t = sub.args[1]
+                        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                        aggregated.extend(
+                            n for n in (_last_name(e) for e in elts) if n)
+        if classes:
+            out["event_classes"] = classes
+            out["event_aggregated"] = sorted(set(aggregated))
+    if "on_event" in ctx.source:
+        event_reads: List[tuple] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FUNC_DEFS) and node.name == "on_event":
+                event_reads.extend(_event_reads_of(node))
+        if event_reads:
+            out["event_reads"] = event_reads
+    # Emission sites resolve through the alias map, so a file with no
+    # import landing on scheduler.events cannot emit — skip the walk.
+    if any("scheduler.events" in v for v in ctx.aliases.values()):
+        emits: List[tuple] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                qual = ctx.qualified(node.func)
+                if qual and "scheduler.events." in qual:
+                    emits.append((qual.rsplit(".", 1)[1], node.lineno,
+                                  node.col_offset + 1))
+        if emits:
+            out["event_emits"] = emits
+
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# VG009 — protocol conformance: every sent msg_type has a dispatch arm,
+# every dispatch arm has a sender
+# ---------------------------------------------------------------------------
+# The message grammar lives in protocol.py prose; the send sites and the
+# role handlers (worker._TaskHandler / shuffle_server._Handler /
+# DriverService.dispatch, plus the client-side reply loops) are the code.
+# PR 5's unknown-task_v2-marker desync was exactly a grammar/handler
+# drift. A string sent via send_msg/encode_msg/request/_call with no
+# `msg_type ==` (or reply_type/marker) arm anywhere in distributed/ is an
+# unhandleable message; an arm no send site can reach is a dead handler.
+
+@rule("VG009", "protocol message without dispatch arm / dead dispatch "
+      "arm", project=True, extract=_contract_extract,
+      extract_key="contracts")
+def vg009(records: List[Tuple[str, dict]]) -> Iterator[Finding]:
+    sends: Dict[str, tuple] = {}
+    arms: Dict[str, tuple] = {}
+    for display, data in records:
+        for lit, line, col in data.get("sends", ()):
+            sends.setdefault(lit, (display, line, col))
+        for lit, line, col in data.get("arms", ()):
+            arms.setdefault(lit, (display, line, col))
+    if not sends or not arms:
+        return  # no protocol surface in this tree
+    for lit in sorted(set(sends) - set(arms)):
+        display, line, col = sends[lit]
+        yield Finding(
+            "VG009", display, line, col,
+            f"protocol message '{lit}' is sent but no dispatch arm "
+            "compares msg_type/reply_type/marker against it — the "
+            "receiver answers 'unknown' (or desyncs) at runtime; add the "
+            "arm or fix the typo (grammar: distributed/protocol.py)")
+    for lit in sorted(set(arms) - set(sends)):
+        display, line, col = arms[lit]
+        yield Finding(
+            "VG009", display, line, col,
+            f"dispatch arm for '{lit}' has no send site in the tree — "
+            "dead handler: either wire up a sender or delete the arm "
+            "(grammar: distributed/protocol.py)")
+
+
+# ---------------------------------------------------------------------------
+# VG010 — knob propagation: worker-side Configuration reads must reach
+# spawned/ssh workers; every VEGA_TPU_* literal must resolve
+# ---------------------------------------------------------------------------
+# Context(conf=...) overrides only exist in the DRIVER process; a
+# Configuration field read on the worker side (worker.py,
+# shuffle_server.py, shuffle/) is silently stuck at its default in every
+# spawned or ssh executor unless backend.py propagates the VEGA_TPU_*
+# env var. And a typo'd env literal anywhere (tests included) configures
+# nothing while looking like it does.
+
+@rule("VG010", "worker-side Configuration read not propagated to "
+      "workers / unresolvable VEGA_TPU_* env literal", project=True,
+      extract=_contract_extract, extract_key="contracts")
+def vg010(records: List[Tuple[str, dict]]) -> Iterator[Finding]:
+    fields: Set[str] = set()
+    fault_knobs: Set[str] = set()
+    propagated: Set[str] = set()
+    for _display, data in records:
+        fields.update(data.get("config_fields", ()))
+        fault_knobs.update(data.get("fault_knobs", ()))
+        propagated.update(data.get("propagation", ()))
+    if not fields:
+        return  # no Configuration in this tree: nothing to resolve against
+    if propagated:
+        seen: Set[str] = set()
+        for display, data in records:
+            for field, line, col in data.get("knob_reads", ()):
+                if field not in fields or field in seen:
+                    continue
+                seen.add(field)
+                env_name = "VEGA_TPU_" + field.upper()
+                if env_name not in propagated:
+                    yield Finding(
+                        "VG010", display, line, col,
+                        f"worker-side read of Configuration.{field} but "
+                        f"{env_name} is not in backend.py's worker "
+                        "propagation list — driver-side overrides "
+                        "silently never reach spawned/ssh executors "
+                        "(add it to _worker_knobs)")
+    for display, data in records:
+        for name, line, col in data.get("env_literals", ()):
+            if name in _VG010_ALLOWLIST:
+                continue
+            if name.startswith("VEGA_TPU_FAULT_"):
+                if name[len("VEGA_TPU_FAULT_"):] in fault_knobs:
+                    continue
+            elif name[len("VEGA_TPU_"):].lower() in fields:
+                continue
+            yield Finding(
+                "VG010", display, line, col,
+                f"env literal '{name}' resolves to no Configuration "
+                "field, faults.py knob, or known infrastructure knob — "
+                "a typo here configures nothing while looking like it "
+                "does")
+
+
+# ---------------------------------------------------------------------------
+# VG011 — event-schema conformance: listener reads exist on the event
+# classes; every emitted event type is aggregated
+# ---------------------------------------------------------------------------
+# The bus delivers plain dataclasses; a misspelled attribute in a
+# listener is an AttributeError swallowed by the bus's listener guard
+# (log + continue), i.e. silently missing metrics. Reads inside an
+# `isinstance(event, X)` branch are checked against X's own fields;
+# un-narrowed reads pass if ANY event class has the field. An event type
+# that is emitted but never aggregated by MetricsListener is invisible
+# in every summary — aggregate it or pragma the emit site.
+
+@rule("VG011", "listener reads a nonexistent event field / emitted "
+      "event type not aggregated", project=True,
+      extract=_contract_extract, extract_key="contracts")
+def vg011(records: List[Tuple[str, dict]]) -> Iterator[Finding]:
+    classes: Dict[str, Set[str]] = {}
+    aggregated: Set[str] = set()
+    for _display, data in records:
+        for cls, fields in data.get("event_classes", {}).items():
+            classes[cls] = set(fields)
+        aggregated.update(data.get("event_aggregated", ()))
+    if not classes:
+        return  # no scheduler/events.py in this tree
+    base = classes.get("Event", set())
+    union: Set[str] = set(base)
+    for fields in classes.values():
+        union.update(fields)
+    for display, data in records:
+        for attr, line, col, narrow in data.get("event_reads", ()):
+            if narrow:
+                known = [c for c in narrow if c in classes]
+                if not known:
+                    continue  # narrowed to a non-bus class: out of scope
+                ok = any(attr in classes[c] | base for c in known)
+                scope = "/".join(known)
+            else:
+                ok = attr in union
+                scope = "any event class"
+            if not ok:
+                yield Finding(
+                    "VG011", display, line, col,
+                    f"listener reads event.{attr}, which does not exist "
+                    f"on {scope} (scheduler/events.py) — the bus guard "
+                    "swallows the AttributeError, so this metric is "
+                    "silently never recorded")
+    emitted: Dict[str, tuple] = {}
+    for display, data in records:
+        for cls, line, col in data.get("event_emits", ()):
+            if cls in classes and cls != "Event":
+                emitted.setdefault(cls, (display, line, col))
+    for cls in sorted(set(emitted) - aggregated):
+        display, line, col = emitted[cls]
+        yield Finding(
+            "VG011", display, line, col,
+            f"event type {cls} is emitted but MetricsListener never "
+            "aggregates it — it is invisible in metrics_summary(); "
+            "aggregate it or justify the emit site with a pragma")
+
+
+# ---------------------------------------------------------------------------
+# VG012 — unbounded blocking socket ops in distributed/ and shuffle/
+# ---------------------------------------------------------------------------
+# The PR 8 class: a hung shuffle owner gated a reduce task on the full
+# 120s IO_TIMEOUT because one socket op ran without the push plan's
+# deadline. On cross-process paths every raw recv/recv_into, connect
+# without timeout, Future.result() without timeout, and settimeout(None)
+# is a wait no deadline bounds — flag them all; the handful of
+# deliberate unbounded waits carry justified pragmas.
+
+_VG012_DIRS = (("vega_tpu", "distributed"), ("vega_tpu", "shuffle"))
+
+
+@rule("VG012", "unbounded blocking socket op on a cross-process path")
+def vg012(ctx: FileCtx) -> Iterator[Finding]:
+    if not any(ctx.in_dir(*d) for d in _VG012_DIRS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_name(node.func)
+        if name in ("recv", "recv_into") \
+                and isinstance(node.func, ast.Attribute):
+            yield Finding(
+                "VG012", ctx.display, node.lineno, node.col_offset + 1,
+                f"raw socket {name}() — nothing here bounds the wait; a "
+                "hung peer parks this thread for the socket's full "
+                "timeout (or forever). Route through the protocol "
+                "helpers on a deadline-bearing socket, or justify with "
+                "a pragma")
+        elif name == "settimeout" and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value is None:
+            yield Finding(
+                "VG012", ctx.display, node.lineno, node.col_offset + 1,
+                "settimeout(None) removes the socket deadline — a hung "
+                "peer now gates this path forever (the PR 8 hung-owner "
+                "class); bound it or justify the unbounded wait with a "
+                "pragma")
+        elif name == "create_connection" and not _kw(node, "timeout") \
+                and len(node.args) < 2:
+            yield Finding(
+                "VG012", ctx.display, node.lineno, node.col_offset + 1,
+                "create_connection without timeout blocks the full OS "
+                "connect timeout on a SYN-blackholed peer — pass "
+                "timeout= (protocol.connect does)")
+        elif name == "result" and not node.args \
+                and not _kw(node, "timeout") \
+                and isinstance(node.func, ast.Attribute):
+            yield Finding(
+                "VG012", ctx.display, node.lineno, node.col_offset + 1,
+                "Future.result() without timeout on a cross-process "
+                "path — a dead or wedged peer strands this thread; pass "
+                "timeout= and handle the expiry")
