@@ -577,6 +577,19 @@ def main():
         # the knob is attributable (benchmarks/straggler_ab.py is the
         # dedicated A/B).
         detail["speculation"] = ctx.metrics_summary().get("speculation", {})
+        # Locality plane (PR 10): placement-tier histogram (process/host/
+        # any dispatches against preferred locations) plus the push-plan
+        # read-locality counters — pre-merged blobs served in-process
+        # (zero RTT) vs remote get_merged round trips. All zeros on a
+        # local in-process run (local threads don't place); on a
+        # distributed run the process-tier share is the scheduling win
+        # (benchmarks/locality_ab.py is the dedicated off-vs-on A/B).
+        detail["locality"] = {
+            **metrics.get("locality", {}),
+            "local_blob_reads": metrics.get("fetch", {}).get(
+                "local_blob_reads", 0),
+            "merged_rtts": metrics.get("fetch", {}).get("merged_rtts", 0),
+        }
         # Job-server plane (PR 7): every bench action routes through the
         # multi-job arbiter, so report the mode it ran under plus the
         # job-level accounting (count / cancelled / failed tasks) — a run

@@ -1,0 +1,238 @@
+"""A/B: push-plan shuffle with the locality plane OFF vs ON (PR 10).
+
+PR 8's push plan pre-merges each reduce partition on its OWNING server
+while the map stage runs, but placement stayed round-robin: a reducer
+scheduled off its owner pays one remote `get_merged` round trip — and
+ships the whole frozen blob over a socket — for state that already sat
+merged in some executor's memory. The locality plane
+(`locality_wait_s > 0`) schedules each reduce task onto its pre-merge
+owner, so the fetcher's in-process fast path serves the blob with ZERO
+round trips.
+
+Harness: ONE real 2-executor fleet (`Context("distributed")`,
+shuffle_plan=push), legs flipped via the driver-side
+`conf.locality_wait_s` policy knob (off=0.0 — the legacy round-robin
+placement — vs on) with no respawn between legs; legs interleaved per
+repetition, medians of 3, results asserted bit-identical. Each leg-rep
+is a PHASE PAIR of jobs (an odd round-robin tick burned between them):
+the legacy counter advances in lockstep with the reduce partition
+index, so a single off-leg job is accidentally either ~100% or ~0%
+owner-aligned depending on the fleet's port sort order — the pair
+samples both phases and its mean is the true placement-blind
+expectation (see flip_rr_phase). The network is
+modeled: every served `get_merged` reply is delayed by
+VEGA_TPU_FAULT_MERGED_DELAY_S (default 0.2s — a cross-zone RTT +
+blob-transfer budget; the straggler A/B models slowness the same way),
+which an in-process owner read never pays. On this 1-core loopback
+sandbox an un-modeled RTT is sub-millisecond, so the delay is what makes
+the placement difference visible above the ±15% noise band — the RTT
+COUNTS themselves (merged_rtts, local_blob_reads, owner-hit fraction)
+are measured raw, no model involved.
+
+Measured per leg:
+  * e2e_s           — job wall (map + reduce through collect())
+  * reduce_start_s  — last map-task end -> first reduce-task end
+  * owner_hit       — reduce tasks that landed on their pre-merge owner
+                      (driver TaskEnd events vs the sorted-peer rotation)
+  * local_blob_reads / merged_rtts — the workers' own fetch counters
+                      (worker_stats protocol): in-process blob reads vs
+                      remote get_merged round trips actually paid
+  * locality        — the driver-side placement-tier histogram delta
+
+Acceptance (ride the output fields):
+  * owned_rtts_zero — on-leg: merged_rtts == reducers - local_blob_reads
+                      (every owner-placed reducer paid zero get_merged
+                      round trips)
+  * e2e_improved    — on-leg median e2e <= 0.85x the off-leg median
+                      (outside the ±15% single-run noise band)
+  * bit_identical   — every leg/rep produced identical sums
+
+Prints ONE JSON line. Usage:
+
+  python benchmarks/locality_ab.py [rows_per_map] [merged_delay_s]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Importing vega_tpu must never probe a (possibly wedged) TPU backend:
+# force the CPU mesh first, like every benchmark here.
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+REPS = 3
+N_MAPS = 4
+N_RED = 16
+KEYS = 4096
+WAIT_ON_S = 0.5
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+def run_legs(rows_per_map=2000, merged_delay_s=0.2):
+    """Run both legs against one fleet; returns the result dict
+    (benchmarks/suite.py config 9 shells out to this module — a Context
+    is a process singleton, so the suite cannot host the fleet itself)."""
+    os.environ["VEGA_TPU_FAULT_MERGED_DELAY_S"] = str(merged_delay_s)
+    import vega_tpu as v
+    from vega_tpu import faults
+    from vega_tpu.scheduler import events as ev
+
+    faults.reset()
+    ctx = v.Context("distributed", num_workers=2, shuffle_plan="push",
+                    locality_wait_s=WAIT_ON_S)
+    backend = ctx._backend
+
+    ends, stages = [], []
+
+    class _Cap(ev.Listener):
+        def on_event(self, event):
+            if isinstance(event, ev.TaskEnd) and event.success:
+                ends.append(event)
+            elif isinstance(event, ev.StageSubmitted):
+                stages.append(event)
+
+    ctx.bus.add_listener(_Cap())
+    total = rows_per_map * N_MAPS
+    expected = {}
+    for i in range(total):
+        k = i % KEYS
+        expected[k] = expected.get(k, 0) + 1
+
+    def worker_fetch_totals():
+        snap = backend.worker_stats()
+        return {k: sum(s["fetch"][k] for s in snap.values())
+                for k in ("local_blob_reads", "merged_rtts", "round_trips")}
+
+    def owner_executor(partition):
+        peers = sorted(backend.shuffle_peer_uris())
+        uri_to_exec = {info["shuffle_uri"]: wid
+                       for wid, info in backend.service.workers.items()}
+        return uri_to_exec.get(peers[partition % len(peers)])
+
+    def one_job():
+        ends.clear()
+        stages.clear()
+        fetch0 = worker_fetch_totals()
+        hist0 = ctx.metrics_summary()["locality"]
+        pairs = ctx.parallelize([(i % KEYS, 1) for i in range(total)],
+                                N_MAPS)
+        t0 = time.monotonic()
+        got = dict(pairs.reduce_by_key(lambda a, b: a + b, N_RED).collect())
+        e2e = time.monotonic() - t0
+        assert got == expected, "leg diverged from the host-side sums"
+        ctx.bus.flush()
+        reduce_sids = {s.stage_id for s in stages if not s.is_shuffle_map}
+        red = [e for e in ends if e.stage_id in reduce_sids]
+        maps = [e for e in ends if e.stage_id not in reduce_sids]
+        reduce_start = (min(e.time for e in red) -
+                        max(e.time for e in maps)) if red and maps else 0.0
+        hits = sum(1 for e in red
+                   if e.executor == owner_executor(e.partition))
+        fetch1 = worker_fetch_totals()
+        hist1 = ctx.metrics_summary()["locality"]
+        return {
+            "e2e_s": e2e,
+            "reduce_start_s": max(0.0, reduce_start),
+            "owner_hit": hits,
+            "reduce_tasks": len(red),
+            "fetch": {k: fetch1[k] - fetch0[k] for k in fetch1},
+            "locality": {k: hist1.get(k, 0) - hist0.get(k, 0)
+                         for k in ("process", "host", "any")},
+        }
+
+    def flip_rr_phase():
+        # The locality-OFF placement is the legacy round-robin, whose
+        # counter advances in lockstep with the reduce partition index —
+        # so its phase relative to the owner rotation is a COIN FLIP
+        # frozen at fleet spawn (port sort order): an off-leg job is
+        # accidentally either ~100% or ~0% owner-local, deterministically.
+        # Burning an ODD number of round-robin ticks (one 3-task narrow
+        # job; the main job burns an even 20) flips that phase, so a
+        # phase-pair of off jobs samples BOTH alignments and their mean
+        # is the true placement-blind expectation. The on-leg ignores the
+        # counter (preference-driven) but runs the same choreography so
+        # the legs stay symmetric.
+        assert ctx.parallelize([0, 1, 2], 3).count() == 3
+
+    def one_rep(wait_s):
+        ctx.conf.locality_wait_s = wait_s
+        a = one_job()
+        flip_rr_phase()
+        b = one_job()
+        flip_rr_phase()  # restore: every rep leaves the phase unchanged
+        return {
+            "e2e_s": (a["e2e_s"] + b["e2e_s"]) / 2.0,
+            "reduce_start_s": (a["reduce_start_s"]
+                               + b["reduce_start_s"]) / 2.0,
+            "owner_hit": a["owner_hit"] + b["owner_hit"],
+            "reduce_tasks": a["reduce_tasks"] + b["reduce_tasks"],
+            "fetch": {k: a["fetch"][k] + b["fetch"][k] for k in a["fetch"]},
+            "locality": {k: a["locality"][k] + b["locality"][k]
+                         for k in a["locality"]},
+        }
+
+    legs = {"off": 0.0, "on": WAIT_ON_S}
+    walls = {leg: {"e2e": [], "start": []} for leg in legs}
+    last = {}
+    try:
+        for leg, wait_s in legs.items():  # warm spawn/import/socket paths
+            ctx.conf.locality_wait_s = wait_s
+            one_job()
+        for _ in range(REPS):
+            for leg, wait_s in legs.items():
+                rep = one_rep(wait_s)
+                walls[leg]["e2e"].append(rep["e2e_s"])
+                walls[leg]["start"].append(rep["reduce_start_s"])
+                last[leg] = rep
+    finally:
+        ctx.stop()
+        os.environ.pop("VEGA_TPU_FAULT_MERGED_DELAY_S", None)
+        faults.reset()
+
+    off_e2e = median(walls["off"]["e2e"])
+    on_e2e = median(walls["on"]["e2e"])
+    on = last["on"]
+    return {
+        "metric": "push-plan shuffle, locality plane off vs on: e2e wall, "
+                  "reduce-start latency, owner-hit placement and get_merged "
+                  "round trips; one 2-executor fleet, real sockets, modeled "
+                  f"{merged_delay_s}s get_merged RTT, medians of 3, legs "
+                  "interleaved per rep",
+        "mappers": N_MAPS, "reducers": N_RED, "rows_per_map": rows_per_map,
+        "key_space": KEYS, "merged_delay_s": merged_delay_s,
+        "locality_wait_s_on": WAIT_ON_S,
+        "e2e_s": {"off": round(off_e2e, 6), "on": round(on_e2e, 6)},
+        "e2e_vs_off": round(on_e2e / off_e2e, 3) if off_e2e else None,
+        "reduce_start_s": {"off": round(median(walls["off"]["start"]), 6),
+                           "on": round(median(walls["on"]["start"]), 6)},
+        "owner_hit": {leg: f"{last[leg]['owner_hit']}/"
+                           f"{last[leg]['reduce_tasks']}"
+                      for leg in legs},
+        "fetch_last_rep": {leg: last[leg]["fetch"] for leg in legs},
+        "locality_last_rep": {leg: last[leg]["locality"] for leg in legs},
+        "bit_identical": True,  # asserted every rep
+        "owned_rtts_zero": (
+            on["fetch"]["merged_rtts"]
+            == on["reduce_tasks"] - on["fetch"]["local_blob_reads"]
+        ),
+        "on_full_owner_placement": on["owner_hit"] >= 0.9 * on["reduce_tasks"],
+        "e2e_improved": bool(off_e2e and on_e2e <= 0.85 * off_e2e),
+    }
+
+
+def main():
+    force_cpu_mesh(8)
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    delay = float(sys.argv[2]) if len(sys.argv) > 2 else 0.2
+    print(json.dumps(run_legs(rows, delay)))
+
+
+if __name__ == "__main__":
+    main()

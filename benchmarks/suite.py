@@ -313,6 +313,39 @@ def config8_shuffle_plan(ctx, scale=1.0, bank=None):
     return rows * out["mappers"], out["e2e_s"]["pull"], out["e2e_s"]["push"]
 
 
+def config9_locality(ctx, scale=1.0, bank=None):
+    """PR 10 locality plane: push-plan shuffle with locality-aware
+    placement off vs on over a real 2-executor fleet
+    (benchmarks/locality_ab.py: modeled get_merged RTT, phase-paired
+    legs so the off leg measures the true placement-blind expectation,
+    medians of 3, bit-identical asserted by the A/B itself). Runs in a
+    SUBPROCESS: the A/B needs its own distributed Context and the Env is
+    a process singleton — the suite's live Context cannot host a second
+    fleet. Reported through the standard columns: host_s = locality-off
+    e2e, device_s = locality-on e2e, so device_vs_host reads as the
+    placement win. Host-plane socket work — no device leg, excluded from
+    the TPU-window default config set (tpu_jobs/09 runs the standalone
+    A/B in the chip-host environment instead)."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows = max(500, int(2000 * scale))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks", "locality_ab.py"),
+         str(rows)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"locality_ab failed: {proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["bit_identical"], "locality legs diverged"
+    assert out["owned_rtts_zero"], \
+        "owner-placed reducers paid get_merged round trips"
+    if bank:
+        bank(rows * out["mappers"], out["e2e_s"]["on"])
+    return rows * out["mappers"], out["e2e_s"]["off"], out["e2e_s"]["on"]
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -324,6 +357,8 @@ CONFIGS = {
     7: ("multi-job short-job p50, fifo vs fair", config7_multijob_latency),
     8: ("shuffle plan pull vs push e2e (16x16 native add)",
         config8_shuffle_plan),
+    9: ("push-plan locality off vs on e2e (modeled get_merged RTT)",
+        config9_locality),
 }
 
 

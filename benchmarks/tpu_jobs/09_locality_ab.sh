@@ -1,0 +1,11 @@
+#!/bin/bash
+# Locality-plane A/B (PR 10) in the TPU-host environment: placement is
+# host-plane work, but this 1-core sandbox serializes the reduce lanes,
+# so the off-leg's remote get_merged delays partially hide behind each
+# other — on the multi-core chip host the lanes genuinely overlap and
+# the modeled-RTT ratio is the number to trust (and the raw counters —
+# owner_hit, merged_rtts, local_blob_reads — carry no model at all).
+# One JSON line; acceptance rides owned_rtts_zero / e2e_improved /
+# bit_identical.
+cd /root/repo
+exec env JAX_PLATFORMS=cpu python benchmarks/locality_ab.py 4000 0.2

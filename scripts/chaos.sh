@@ -18,3 +18,11 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/straggler_ab.py
 # pull, bit-identical legs) ride the "reduce_start_3x" / "e2e_no_worse" /
 # "bit_identical" fields.
 timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/shuffle_plan_ab.py
+
+# Locality plane A/B (PR 10): push-plan placement off vs on over a real
+# 2-executor fleet with a modeled get_merged RTT. One JSON line; the
+# acceptance bounds (owner-placed reducers pay zero get_merged round
+# trips, on-leg e2e outside the off-leg's ±15% noise band, bit-identical
+# legs) ride the "owned_rtts_zero" / "e2e_improved" / "bit_identical"
+# fields.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/locality_ab.py

@@ -813,3 +813,56 @@ def test_push_plan_server_drop_mid_push_recovers(monkeypatch, tmp_path):
             totals["merged_buckets"]
     finally:
         ctx.stop()
+
+
+def test_locality_preferred_executor_killed_midstream(monkeypatch):
+    """PR 10 satellite: kill the executor holding a cached RDD's
+    partitions, then re-run the job. The ExecutorLost scrub must drop
+    the dead executor from the CacheTracker location lists, so the
+    fresh stage's preferred locations never point at a corpse (stale
+    placement metadata) — results stay bit-identical, the collect
+    finishes with no placement stall beyond locality_wait_s (here: none
+    at all — the pick also refuses to delay-wait on process-level
+    preferences, whose data died with the process), and the re-run's
+    recomputed partitions re-register on survivors."""
+    from vega_tpu.env import Env
+
+    wait_s = 1.5
+    ctx = _chaos_context(
+        locality_wait_s=wait_s,
+        # A slow, budgeted respawn: the dead slot stays "recoverable" for
+        # the whole test window, which is exactly what makes an unscrubbed
+        # cache preference wait-worthy — the scrub is what prevents it.
+        executor_restart_backoff_s=30.0, executor_max_restarts=1,
+    )
+    try:
+        rdd = ctx.parallelize(list(range(96)), 4).map(lambda x: x * 7)
+        rdd.cache()
+        expected = sorted(rdd.collect())
+        tracker = Env.get().cache_tracker
+        owners = {exec_id for p in range(4)
+                  for exec_id in tracker.get_cache_locs(rdd.rdd_id, p)}
+        victim_id = sorted(owners)[0]
+        victim = ctx._backend._executors[victim_id]
+        victim.process.kill()
+        victim.process.wait()
+        _wait_metric(ctx, "executors_lost", 1)
+
+        # The scrub: no cached-partition location points at the corpse.
+        for p in range(4):
+            assert victim_id not in tracker.get_cache_locs(rdd.rdd_id, p)
+
+        t0 = time.time()
+        got = sorted(rdd.collect())
+        wall = time.time() - t0
+        assert got == expected  # bit-identical through the loss
+        assert wall < wait_s, (
+            f"placement stalled {wall:.2f}s >= locality_wait_s={wait_s} "
+            "after the preferred executor died")
+        # Survivor-side caches kept their locations; the dead executor's
+        # partitions re-registered wherever they recomputed.
+        for p in range(4):
+            locs = tracker.get_cache_locs(rdd.rdd_id, p)
+            assert locs and victim_id not in locs
+    finally:
+        ctx.stop()

@@ -245,6 +245,47 @@ def test_disk_resident_shuffle_bucket_served(dist_ctx):
     assert dict(shuffled.collect()) == exp
 
 
+def test_cache_locality_lands_tasks_on_cached_executor(dist_ctx):
+    """Satellite regression (PR 10): a cached partition's follow-up task
+    must land on the executor holding the cache. The cache tracker
+    registers executor ids; the old _pick_executor soft branch compared
+    them only against e.executor_id AFTER a pinned gate that never fired
+    for unpinned cached RDDs mid-rotation — the locality-tiered pick
+    scores them PROCESS_LOCAL and the per-stage histogram proves it."""
+    from vega_tpu.env import Env
+    from vega_tpu.scheduler import events as ev
+
+    rdd = dist_ctx.parallelize(list(range(64)), 4).map(lambda x: x * 3)
+    rdd.cache()
+    expected = sorted(3 * x for x in range(64))
+    assert sorted(rdd.collect()) == expected  # materializes the cache
+
+    tracker = Env.get().cache_tracker
+    cache_locs = {p: tracker.get_cache_locs(rdd.rdd_id, p)
+                  for p in range(4)}
+    assert all(cache_locs[p] for p in range(4)), cache_locs
+
+    dist_ctx.bus.flush()
+    ends = []
+
+    class _Cap(ev.Listener):
+        def on_event(self, event):
+            if isinstance(event, ev.TaskEnd) and event.success:
+                ends.append(event)
+
+    dist_ctx.bus.add_listener(_Cap())
+    assert sorted(rdd.collect()) == expected  # served from the cache
+    dist_ctx.bus.flush()
+
+    by_partition = {e.partition: e for e in ends}
+    assert set(by_partition) == {0, 1, 2, 3}
+    for p, event in by_partition.items():
+        assert event.executor in cache_locs[p], (
+            f"partition {p} ran on {event.executor}, cache at "
+            f"{cache_locs[p]}")
+        assert event.locality == "process"
+
+
 # ---------------------------------------------------------------- PR 6:
 # replicated shuffle reads across real worker processes. These tests need
 # their own fleet (replication knobs are read at worker SPAWN time), and
@@ -324,3 +365,80 @@ def test_replicated_fetch_fails_over_after_executor_kill(monkeypatch,
     finally:
         ctx.stop()
         faults.reset()
+
+
+def test_push_plan_reduce_tasks_land_on_premerge_owner():
+    """Tentpole acceptance (PR 10): under shuffle_plan=push with the
+    locality plane on, reduce tasks are scheduled onto their pre-merge
+    OWNER — the fetcher's in-process fast path then serves the frozen
+    blob with ZERO get_merged round trips. Asserts >=90% owner placement
+    via TaskEnd events, zero remote merged reads for the owned
+    partitions via the workers' own counters (worker_stats protocol),
+    and bit-identical results vs the plain expected sums."""
+    from vega_tpu.scheduler import events as ev
+
+    _retire_active_context()
+    n_red = 8
+    ctx = v.Context("distributed", num_workers=2, shuffle_plan="push",
+                    locality_wait_s=0.3)
+    try:
+        ends, stages = [], []
+
+        class _Cap(ev.Listener):
+            def on_event(self, event):
+                if isinstance(event, ev.TaskEnd) and event.success:
+                    ends.append(event)
+                elif isinstance(event, ev.StageSubmitted):
+                    stages.append(event)
+
+        ctx.bus.add_listener(_Cap())
+        before = ctx._backend.worker_stats()
+        pairs = ctx.parallelize([(i % 64, 1) for i in range(4000)], 4)
+        got = dict(pairs.reduce_by_key(lambda a, b: a + b, n_red).collect())
+        expected = {}
+        for i in range(4000):
+            expected[i % 64] = expected.get(i % 64, 0) + 1
+        assert got == expected  # bit-identical to the host-side sums
+        ctx.bus.flush()
+
+        # The owner each reduce partition's pushes rotated onto — the
+        # same sorted-peer rule the mapper and the scheduler share.
+        peers = sorted(ctx._backend.shuffle_peer_uris())
+        assert len(peers) == 2
+        uri_to_exec = {
+            info["shuffle_uri"]: wid
+            for wid, info in ctx._backend.service.workers.items()}
+        reduce_stage_ids = {s.stage_id for s in stages
+                            if not s.is_shuffle_map}
+        reduce_ends = [e for e in ends if e.stage_id in reduce_stage_ids]
+        assert len(reduce_ends) == n_red
+        matched = [e for e in reduce_ends
+                   if e.executor == uri_to_exec[peers[e.partition
+                                                     % len(peers)]]]
+        assert len(matched) >= 0.9 * n_red, (
+            f"only {len(matched)}/{n_red} reduce tasks landed on their "
+            "pre-merge owner")
+        assert all(e.locality == "process" for e in matched)
+
+        # The workers' own fetch counters: every owner-placed reducer
+        # read its frozen blob in-process (zero round trips); only the
+        # (at most) non-matched remainder paid a remote get_merged.
+        after = ctx._backend.worker_stats()
+
+        def total(snapshots, key):
+            return sum(s["fetch"][key] for s in snapshots.values())
+
+        local = total(after, "local_blob_reads") - \
+            total(before, "local_blob_reads")
+        remote = total(after, "merged_rtts") - total(before, "merged_rtts")
+        assert local >= len(matched)
+        assert remote == n_red - local, (
+            f"owned-partition get_merged RTTs leaked: local={local} "
+            f"remote={remote}")
+
+        # Driver-side observability: the per-stage locality histogram
+        # counted the process-tier reduce dispatches.
+        hist = ctx.metrics_summary()["locality"]
+        assert hist["process"] >= len(matched)
+    finally:
+        ctx.stop()

@@ -654,7 +654,9 @@ def test_push_plan_round_trip_premerged_and_counted():
                 0, _StubRDD([(k, 1) for k in range(m, m + 30)]), agg,
                 HashPartitioner(n_red))
             deps.append(dep)
-            locs.append(dep.do_shuffle_task(Split(m)))
+            # do_shuffle_task returns (locs, per-reduce bucket sizes); the
+            # sizes feed the locality plane — only locs register here.
+            locs.append(dep.do_shuffle_task(Split(m))[0])
         # Map retry (speculative duplicate / recompute): same bytes pushed
         # again — the tier must drop every bucket as a duplicate.
         deps[0].do_shuffle_task(Split(0))
@@ -721,7 +723,9 @@ def test_push_plan_dead_owner_degrades_to_pull():
             dep = dependency.ShuffleDependency(
                 0, _StubRDD([(k, 1) for k in range(10)]), agg,
                 HashPartitioner(n_red))
-            locs.append(dep.do_shuffle_task(Split(m)))
+            # do_shuffle_task returns (locs, per-reduce bucket sizes); the
+            # sizes feed the locality plane — only locs register here.
+            locs.append(dep.do_shuffle_task(Split(m))[0])
         tracker.register_map_outputs(0, locs)
         assert dependency.push_stats_snapshot()["failed"] == \
             n_maps * n_red  # every bucket degraded
@@ -777,7 +781,9 @@ def test_push_plan_hung_owner_bounded_by_slow_server_deadline():
             dep = dependency.ShuffleDependency(
                 0, _StubRDD([(k, 1) for k in range(10)]), agg,
                 HashPartitioner(n_red))
-            locs.append(dep.do_shuffle_task(Split(m)))
+            # do_shuffle_task returns (locs, per-reduce bucket sizes); the
+            # sizes feed the locality plane — only locs register here.
+            locs.append(dep.do_shuffle_task(Split(m))[0])
         tracker.register_map_outputs(0, locs)
         fetcher_mod.reset_stats()
         t0 = _time.monotonic()

@@ -495,6 +495,7 @@ def test_pick_executor_speculation_rules():
     import itertools
 
     backend._rr = itertools.count(0)
+    backend._running_on = {}
     e0 = _Executor("exec-0", "127.0.0.1:1", "127.0.0.1")
     e1 = _Executor("exec-1", "127.0.0.1:2", "127.0.0.1")
     backend._executors = {"exec-0": e0, "exec-1": e1}
@@ -567,3 +568,338 @@ def test_task_duration_excludes_dispatch_latency():
             f"duration_s contains dispatch latency: {captured}")
     finally:
         context.stop()
+
+
+# ------------------------------------------------------------------ PR 10:
+# locality-aware task placement plane (tier scoring, bounded delay wait,
+# reduce-side preferences, preferred-locs memoization, arbiter hint
+# pass-through).
+
+
+def _placement_backend(conf_overrides=None, workers=None):
+    """Bare DistributedBackend placement harness: just the state
+    _pick_executor_scored / _pick_with_locality_wait consult — no fleet,
+    no sockets."""
+    import itertools
+    from types import SimpleNamespace
+
+    from vega_tpu.distributed.backend import DistributedBackend
+    from vega_tpu.env import Configuration
+    from vega_tpu.lint.sync_witness import named_lock
+
+    backend = DistributedBackend.__new__(DistributedBackend)
+    backend.conf = Configuration()
+    for key, value in (conf_overrides or {}).items():
+        setattr(backend.conf, key, value)
+    backend._lock = named_lock("test.pick_executor")
+    backend._rr = itertools.count(0)
+    backend._running_on = {}
+    backend.service = SimpleNamespace(workers=workers or {})
+    backend._executors = {}
+    return backend
+
+
+def _placement_task(locs=(), pinned=False, speculative=False, exclude=()):
+    from types import SimpleNamespace
+
+    return SimpleNamespace(speculative=speculative,
+                           exclude_executors=frozenset(exclude),
+                           pinned=pinned, preferred_locs=list(locs))
+
+
+def test_pick_executor_tier_scoring():
+    """PROCESS_LOCAL (executor-id or shuffle-uri match) beats HOST_LOCAL
+    (host match) beats ANY, and ties break by fewest in-flight dispatches
+    instead of first-match."""
+    from vega_tpu.distributed.backend import _Executor
+
+    backend = _placement_backend(
+        workers={"exec-2": {"shuffle_uri": "10.0.0.2:7777"}})
+    e0 = _Executor("exec-0", "10.0.0.1:1", "hostA")
+    e1 = _Executor("exec-1", "10.0.0.2:2", "hostB")
+    e2 = _Executor("exec-2", "10.0.0.2:3", "hostB")
+    backend._executors = {"exec-0": e0, "exec-1": e1, "exec-2": e2}
+
+    # executor-id match -> process tier, regardless of candidate order.
+    ex, tier, improvable = backend._pick_executor_scored(
+        _placement_task(["exec-1"]))
+    assert (ex, tier, improvable) == (e1, "process", False)
+    # shuffle-server-URI match (the reduce-side preference's currency)
+    # resolves through the worker registry -> process tier.
+    ex, tier, _ = backend._pick_executor_scored(
+        _placement_task(["10.0.0.2:7777"]))
+    assert (ex, tier) == (e2, "process")
+    # host match -> host tier; among the two hostB executors the one with
+    # fewer in-flight dispatches wins (NOT first-match).
+    backend._running_on = {101: "exec-1", 102: "exec-1", 103: "exec-2"}
+    ex, tier, _ = backend._pick_executor_scored(_placement_task(["hostB"]))
+    assert (ex, tier) == (e2, "host")
+    # no match at all -> any tier (and no wait: nothing recoverable).
+    ex, tier, improvable = backend._pick_executor_scored(
+        _placement_task(["hostZ"]))
+    assert tier == "any" and not improvable
+
+
+def test_pick_executor_legacy_path_matches_hosts():
+    """Satellite regression: with the locality plane OFF
+    (locality_wait_s=0) placement is the legacy round-robin +
+    first-match seek — but the seek now compares e.host too. The old
+    soft branch compared only executor ids, so host-level preferences
+    (cache tracker entries, pinned-host RDDs) never matched in
+    distributed mode and the branch was dead."""
+    from vega_tpu.distributed.backend import _Executor
+
+    backend = _placement_backend({"locality_wait_s": 0.0})
+    e0 = _Executor("exec-0", "10.0.0.1:1", "hostA")
+    e1 = _Executor("exec-1", "10.0.0.2:2", "hostB")
+    backend._executors = {"exec-0": e0, "exec-1": e1}
+
+    # Host-named preference now seeks its executor (was: round-robin).
+    for _ in range(4):
+        ex, tier, _ = backend._pick_executor_scored(
+            _placement_task(["hostB"]))
+        assert ex is e1
+        assert tier == ""  # plane off: placement is unmeasured
+    # Pinned tasks keep the pinned seek, host-matched as before.
+    ex, _, _ = backend._pick_executor_scored(
+        _placement_task(["hostA"], pinned=True))
+    assert ex is e0
+    # No preference: pure round-robin, byte-for-byte legacy.
+    picks = {backend._pick_executor(_placement_task()).executor_id
+             for _ in range(4)}
+    assert picks == {"exec-0", "exec-1"}
+    # Several executors on the preferred host (the standard local fleet —
+    # every executor is 127.0.0.1): the seek round-robins AMONG the
+    # matches instead of funneling every task onto dict-order executor 0.
+    e2 = _Executor("exec-2", "10.0.0.2:3", "hostB")
+    backend._executors["exec-2"] = e2
+    spread = {backend._pick_executor(_placement_task(["hostB"])).executor_id
+              for _ in range(4)}
+    assert spread == {"exec-1", "exec-2"}
+
+
+def test_pick_executor_delay_wait_expiry_and_immediate_demote():
+    """The bounded delay wait: a HOST preference whose only executor is
+    TEMPORARILY down (dead slot, respawn budget left) is worth waiting
+    locality_wait_s for — host-resident data survives the respawn. A
+    PROCESS-level preference (executor id / shuffle URI) on the same
+    dead slot demotes immediately (cache and pushed state died with the
+    process; the respawn starts empty), as do permanently-dead (restart
+    budget exhausted) and blacklisted preferred executors."""
+    from types import SimpleNamespace
+
+    from vega_tpu.distributed.backend import _Executor
+
+    backend = _placement_backend({"locality_wait_s": 0.4})
+    e0 = _Executor("exec-0", "10.0.0.1:1", "hostA",
+                   process=SimpleNamespace(poll=lambda: None))
+    e1 = _Executor("exec-1", "10.0.0.2:2", "hostB")
+    e0.alive = False  # dead but respawnable (restarts=0 < max_restarts)
+    backend._executors = {"exec-0": e0, "exec-1": e1}
+
+    t0 = time.monotonic()
+    ex, tier = backend._pick_with_locality_wait(_placement_task(["hostA"]))
+    waited = time.monotonic() - t0
+    assert ex is e1 and tier == "any"
+    assert 0.35 <= waited < 3.0, f"delay wait did not expire ({waited:.2f}s)"
+
+    # Executor-ID preference (cache tracker currency) on the same dead
+    # slot: a respawn keeps the id but not the cache — never waited for.
+    t0 = time.monotonic()
+    ex, tier = backend._pick_with_locality_wait(_placement_task(["exec-0"]))
+    assert ex is e1 and time.monotonic() - t0 < 0.2
+
+    # Restart budget exhausted: not improvable -> settle instantly.
+    e0.restarts = backend.conf.executor_max_restarts
+    t0 = time.monotonic()
+    ex, tier = backend._pick_with_locality_wait(_placement_task(["hostA"]))
+    assert ex is e1 and time.monotonic() - t0 < 0.2
+
+    # Blacklisted-but-alive preferred executor: demote immediately too.
+    e0.restarts = 0
+    e0.alive = True
+    e0.failures = backend.conf.executor_blacklist_threshold
+    t0 = time.monotonic()
+    ex, tier = backend._pick_with_locality_wait(_placement_task(["hostA"]))
+    assert ex is e1 and time.monotonic() - t0 < 0.2
+
+
+def test_pick_executor_speculative_never_waits_and_keeps_exclusions():
+    """Interaction with speculation: a duplicate never burns the delay
+    wait (it IS the latency mitigation) and the strict exclusion rules
+    are unchanged — preferring the excluded straggler cannot override
+    exclude_executors, and with no eligible executor the launch is still
+    skipped (raises), never relaxed onto the preferred straggler."""
+    from types import SimpleNamespace
+
+    from vega_tpu.distributed.backend import _Executor
+    from vega_tpu.errors import NetworkError
+
+    backend = _placement_backend({"locality_wait_s": 5.0})
+    e0 = _Executor("exec-0", "10.0.0.1:1", "hostA",
+                   process=SimpleNamespace(poll=lambda: None))
+    e1 = _Executor("exec-1", "10.0.0.2:2", "hostB")
+    backend._executors = {"exec-0": e0, "exec-1": e1}
+
+    # The duplicate PREFERS the straggler it must avoid (its data is
+    # there): exclusion wins, instantly.
+    t0 = time.monotonic()
+    ex, tier = backend._pick_with_locality_wait(
+        _placement_task(["exec-0"], speculative=True, exclude={"exec-0"}))
+    assert ex is e1 and time.monotonic() - t0 < 0.2
+
+    # Same preference, survivor dead-but-respawnable: an ordinary task
+    # would wait — the speculative duplicate must not (skip, not stall).
+    e1.alive = False
+    e1.process = SimpleNamespace(poll=lambda: None)
+    t0 = time.monotonic()
+    with pytest.raises(NetworkError):
+        backend._pick_with_locality_wait(
+            _placement_task(["exec-0"], speculative=True,
+                            exclude={"exec-0"}))
+    assert time.monotonic() - t0 < 0.2
+
+
+def test_preferred_locs_memoized_per_submit(ctx):
+    """Satellite: _get_preferred_locs memoizes per (rdd_id, partition)
+    for one submit_missing_tasks call — a stage whose narrow lineage
+    fans into a shared parent partition walks that parent once, not once
+    per task."""
+    from vega_tpu.dependency import ManyToOneDependency
+    from vega_tpu.split import Split
+
+    class _CountingSource:
+        rdd_id = 990001
+        should_cache = False
+
+        def __init__(self):
+            self.calls = 0
+            self._splits = [Split(0)]
+
+        def cached_splits(self):
+            return self._splits
+
+        def preferred_locations(self, split):
+            self.calls += 1
+            return ["hostA"]
+
+        def get_dependencies(self):
+            return []
+
+    class _FanIn:
+        rdd_id = 990002
+        should_cache = False
+
+        def __init__(self, parent, n):
+            self._splits = [Split(i) for i in range(n)]
+            self._dep = ManyToOneDependency(parent, [[0]] * n)
+
+        def cached_splits(self):
+            return self._splits
+
+        def preferred_locations(self, split):
+            return []
+
+        def get_dependencies(self):
+            return [self._dep]
+
+    source = _CountingSource()
+    fan_in = _FanIn(source, 4)
+    memo = {}
+    locs = [ctx.scheduler._get_preferred_locs(fan_in, p, memo=memo)
+            for p in range(4)]
+    assert locs == [["hostA"]] * 4
+    assert source.calls == 1, (
+        f"shared parent walked {source.calls}x despite the memo")
+    # Without a memo (direct callers, old behavior) it re-walks per call.
+    source.calls = 0
+    for p in range(4):
+        ctx.scheduler._get_preferred_locs(fan_in, p)
+    assert source.calls == 4
+
+
+def test_reduce_side_prefs_push_owner_and_pull_bytes(ctx):
+    """The recursion no longer stops cold at shuffle boundaries: under
+    shuffle_plan=push a mergeable shuffle's reduce task prefers its
+    pre-merge OWNER (same sorted-peer rotation as the mapper's pushes);
+    under pull it prefers the server holding the most of its bytes
+    (MapOutputTracker per-bucket size accounting). locality_wait_s=0
+    computes nothing — the plane is opt-in end to end."""
+    from vega_tpu.aggregator import Aggregator
+    from vega_tpu.dependency import ShuffleDependency
+    from vega_tpu.partitioner import HashPartitioner
+
+    env = Env.get()
+    tracker = env.map_output_tracker
+    agg = Aggregator(lambda v_: v_, lambda c, v_: c + v_,
+                     lambda a, b: a + b, op_name="add")
+    dep = ShuffleDependency(555, _FakeRDD(), agg, HashPartitioner(4))
+    tracker.register_shuffle(555, 2)
+    tracker.register_map_outputs(555, ["s1:1", "s2:2"])
+    tracker.register_map_sizes(555, {0: [10, 1, 0, 5], 1: [2, 8, 0, 5]})
+
+    sched = ctx.scheduler
+    saved = (env.conf.shuffle_plan, env.conf.locality_wait_s)
+    try:
+        env.conf.locality_wait_s = 0.3
+        env.conf.shuffle_plan = "pull"
+        # reduce 0: s1 holds 10 bytes vs s2's 2 -> s1 ranks first.
+        assert sched._reduce_side_prefs(dep, 0) == ["s1:1", "s2:2"]
+        assert sched._reduce_side_prefs(dep, 1) == ["s2:2", "s1:1"]
+        assert sched._reduce_side_prefs(dep, 2) == []  # zero bytes anywhere
+
+        env.conf.shuffle_plan = "push"
+        # LocalBackend has no peer registry -> push prefs fall through to
+        # the byte ranking; with a registry stubbed in, the owner rotation
+        # (sorted peers, reduce_id % n) decides.
+        sched.backend.shuffle_peer_uris = lambda: ["uri-b", "uri-a"]
+        assert sched._reduce_side_prefs(dep, 0) == ["uri-a"]
+        assert sched._reduce_side_prefs(dep, 1) == ["uri-b"]
+        assert sched._reduce_side_prefs(dep, 2) == ["uri-a"]
+
+        # A group (non-mergeable) shuffle is never pushed: its reduce
+        # tasks keep the pull-plan byte preference.
+        group_agg = Aggregator(lambda v_: [v_], lambda c, v_: c + [v_],
+                               lambda a, b: a + b, is_group=True)
+        group_dep = ShuffleDependency(555, _FakeRDD(), group_agg,
+                                      HashPartitioner(4))
+        assert sched._reduce_side_prefs(group_dep, 0) == ["s1:1", "s2:2"]
+
+        env.conf.locality_wait_s = 0.0
+        assert sched._reduce_side_prefs(dep, 0) == []
+    finally:
+        (env.conf.shuffle_plan, env.conf.locality_wait_s) = saved
+        del sched.backend.shuffle_peer_uris
+        tracker.unregister_shuffle(555)
+
+
+def test_arbiter_passes_placement_hints():
+    """The fair/fifo arbiter queues the very Task object the scheduler
+    built: preferred_locs / pinned / exclude_executors reach the backend
+    untouched in both ordering modes (fair scheduling decides WHEN, the
+    locality plane decides WHERE)."""
+    from types import SimpleNamespace
+
+    from vega_tpu.scheduler.jobserver import TaskArbiter
+    from vega_tpu.scheduler.task import ResultTask, TaskEndEvent
+    from vega_tpu.split import Split
+
+    for mode in ("fifo", "fair"):
+        seen = []
+
+        class _Recorder:
+            parallelism = 2
+
+            def submit(self, task, callback):
+                seen.append(task)
+                callback(TaskEndEvent(task=task, success=True))
+
+        arbiter = TaskArbiter(_Recorder(), mode)
+        job = SimpleNamespace(job_id=1, pool="default")
+        task = ResultTask(0, _FakeRDD(), lambda tc, it: None, 0, Split(0),
+                          0, preferred_locs=["hostA", "exec-1"], pinned=True)
+        task.exclude_executors = frozenset({"exec-9"})
+        arbiter.submit(task, lambda ev_: None, job)
+        assert seen and seen[0] is task
+        assert seen[0].preferred_locs == ["hostA", "exec-1"]
+        assert seen[0].pinned and seen[0].exclude_executors == {"exec-9"}

@@ -43,6 +43,23 @@ class CacheTracker:
             if host in locs:
                 locs.remove(host)
 
+    def drop_executor(self, executor_id: str) -> int:
+        """Executor loss: drop the lost executor from EVERY cached
+        partition's location list in one sweep (the cache-side mirror of
+        Stage.remove_outputs_on_server / unregister_server_outputs) so
+        _get_preferred_locs never points a fresh stage at a dead
+        executor's cache. Entries are executor ids (get_or_compute
+        registers env.executor_id), so this never collateral-drops a
+        co-hosted survivor. Returns the number of entries removed."""
+        removed = 0
+        with self._lock:
+            for parts in self._locs.values():
+                for p, hosts in parts.items():
+                    if executor_id in hosts:
+                        parts[p] = [h for h in hosts if h != executor_id]
+                        removed += 1
+        return removed
+
     def get_location_snapshot(self) -> Dict[int, Dict[int, List[str]]]:
         """Reference: cache_tracker.rs:302-317."""
         with self._lock:

@@ -94,6 +94,25 @@ def push_owner_uri(tracker, reduce_id: int):
     return push_owner_of(peers, reduce_id) if peers else None
 
 
+def is_push_plan(conf) -> bool:
+    """THE shuffle-plan predicate — one home. The mapper's push gate
+    (_publish_locs), the reducer's pre-merged read (fetcher._stream) and
+    the scheduler's placement preference (dag._reduce_side_prefs) must
+    agree on what counts as "push"; hand-rolled copies of the
+    normalization would drift."""
+    return str(getattr(conf, "shuffle_plan", "pull")).lower() == "push"
+
+
+def push_owner_for_peers(peer_uris, reduce_id: int):
+    """Driver-side owner resolution over an explicitly-supplied peer set
+    (DistributedBackend.shuffle_peer_uris, the same live-worker registry
+    `list_shuffle_peers` serves the map/reduce sides): same sort + same
+    rotation rule, so the scheduler's reduce-task placement can never
+    drift from where the pushed data actually lands."""
+    peers = sorted(u for u in peer_uris if u)
+    return push_owner_of(peers, reduce_id) if peers else None
+
+
 # Process-lifetime push counters (benchmarks/shuffle_plan_ab.py and the
 # chaos suite read these; the per-map edition also rides the driver event
 # bus as ShufflePushCompleted when a sink is wired).
@@ -204,8 +223,11 @@ class ShuffleDependency(Dependency):
         self.partitioner = partitioner
         self.is_cogroup = is_cogroup
 
-    def do_shuffle_task(self, split, task_context=None) -> str:
-        """Map-side combine: bucket parent partition by key, pre-merge, store.
+    def do_shuffle_task(self, split, task_context=None) -> tuple:
+        """Map-side combine: bucket parent partition by key, pre-merge,
+        store; returns the map task's result ``(locs, bucket_sizes)`` —
+        the output's location(s) plus per-reduce bucket sizes for the
+        locality plane (see _publish).
 
         Reference hot loop 1: src/dependency.rs:164-229 — iterate parent
         partition, hash each key into its reducer bucket, merge_value into a
@@ -294,6 +316,17 @@ class ShuffleDependency(Dependency):
 
     def _publish(self, env, map_id: int, row: List[bytes],
                  task_context=None):
+        """Locally-stored bucket row -> the map task's result:
+        ``(location(s), per-reduce bucket sizes)``. The sizes ride the
+        ordinary result envelope back to the driver (Stage.add_output_loc
+        strips them into Stage.bucket_sizes) so the locality plane can
+        schedule each reduce task where most of its input bytes already
+        sit — no extra RPC on the map path."""
+        return (self._publish_locs(env, map_id, row, task_context),
+                [len(b) for b in row])
+
+    def _publish_locs(self, env, map_id: int, row: List[bytes],
+                      task_context=None):
         """Locally-stored bucket row -> this output's location(s).
 
         With `shuffle_replication` <= 1 (or no shuffle server to replicate
@@ -316,9 +349,7 @@ class ShuffleDependency(Dependency):
         byte-identical to the pull plan, so any push failure — dead peer,
         frozen state, injected chaos — degrades those buckets to pull."""
         primary = env.shuffle_server.uri if env.shuffle_server else "local"
-        if (env.shuffle_server is not None
-                and str(getattr(env.conf, "shuffle_plan",
-                                "pull")).lower() == "push"):
+        if env.shuffle_server is not None and is_push_plan(env.conf):
             self._push_row(env, map_id, row, task_context)
         k = int(getattr(env.conf, "shuffle_replication", 1) or 1)
         if k <= 1 or env.shuffle_server is None:

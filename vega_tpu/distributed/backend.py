@@ -76,6 +76,11 @@ class _Executor:
         self.host = host
         self.process = process
         self.restarts = restarts  # respawn incarnation of this slot
+        # This slot's shuffle-server URI, lazily resolved from the
+        # worker's registration (DriverService.workers) the first time
+        # the locality scorer needs it. A respawn binds a fresh port, but
+        # it also replaces this _Executor object — never stale.
+        self.shuffle_uri: Optional[str] = None
         self.alive = True
         self.reaped = False      # declared lost; never resurrects
         self.respawning = False  # a replacement launch is in flight
@@ -177,6 +182,10 @@ class DistributedBackend(TaskBackend):
             # a driver-side budget override never reached the fleet.
             "VEGA_TPU_SHUFFLE_MEMORY_BUDGET": str(
                 conf.shuffle_memory_budget),
+            # Locality plane: driver-side placement policy, but workers
+            # carry it so nested tooling (benchmarks, diagnostics) sees
+            # the same switch the driver scheduled under.
+            "VEGA_TPU_LOCALITY_WAIT_S": str(conf.locality_wait_s),
             # Respawned incarnations disarm one-shot fault injections
             # (faults.py): a chaos-killed slot comes back healthy.
             "VEGA_TPU_FAULT_INCARNATION": str(incarnation),
@@ -501,20 +510,102 @@ class DistributedBackend(TaskBackend):
             n = max(1, len([e for e in self._executors.values() if e.alive]))
         return n * self.conf.num_workers
 
-    def _pick_executor(self, task: Task) -> _Executor:
-        """Round-robin + pinned-host seek
-        (reference: distributed_scheduler.rs:447-469), skipping blacklisted
-        repeat offenders while any clean executor is alive.
+    # Locality-tier names, indexed by score (0 is best): PROCESS_LOCAL
+    # (executor-id or shuffle-server-URI match — the task's preferred data
+    # lives in that very process), HOST_LOCAL (host match), ANY.
+    _TIER_NAMES = ("process", "host", "any")
 
-        Speculative duplicates are stricter on BOTH counts: they must land
-        on a different executor than the straggling original
-        (task.exclude_executors) and must never target a blacklisted
-        executor — a duplicate stacked on a struggling node mitigates
-        nothing, so with no eligible executor the launch is skipped
-        (raises; the DAG ignores the failure since the original still
-        runs) rather than relaxed."""
+    def shuffle_peer_uris(self) -> List[str]:
+        """Live workers' shuffle-server URIs — the same registry
+        `list_shuffle_peers` serves the map/reduce planes, so the DAG
+        scheduler's push-owner computation (dag._reduce_side_prefs)
+        rotates over the same peer set the mappers push along."""
+        return [info["shuffle_uri"]
+                for info in self.service.live_workers().values()
+                if info.get("shuffle_uri")]
+
+    def _match_tier(self, executor: _Executor, locs) -> int:
+        """0 PROCESS_LOCAL, 1 HOST_LOCAL, 2 ANY for `executor` against a
+        task's preferred locations (which may name executor ids — cache
+        tracker entries — hosts, or shuffle-server URIs from the
+        reduce-side preference)."""
+        if not locs:
+            return 2
+        if executor.executor_id in locs:
+            return 0
+        uri = executor.shuffle_uri
+        if uri is None:
+            info = self.service.workers.get(executor.executor_id)
+            uri = executor.shuffle_uri = (info or {}).get("shuffle_uri")
+        if uri and uri in locs:
+            return 0
+        if executor.host in locs:
+            return 1
+        return 2
+
+    def _recoverable_better_tier_locked(self, locs, best_tier: int,
+                                        exclude) -> bool:
+        """Could waiting improve this task's locality tier? True only for
+        a TEMPORARILY-down preferred executor: a dead slot with respawn
+        budget (or a respawn already in flight) whose HOST matches `locs`
+        while the task currently only scores ANY. Host-level data —
+        pinned-host files, host-resident disk — survives a process
+        respawn, so that wait can genuinely be repaid; PROCESS-level
+        matches never qualify, because the data they name died with the
+        process (a respawn keeps the executor id but starts with an
+        empty cache, and binds a fresh shuffle server holding none of
+        the pushed state) — waiting would add latency for zero possible
+        win. Blacklisted, speculation-excluded, or restart-exhausted
+        slots never qualify either: the delay wait must demote
+        immediately rather than starve. Caller holds self._lock."""
+        if best_tier <= 1:
+            return False  # already host-local or better
+        for ex in self._executors.values():
+            if ex.alive or ex.process is None:
+                continue
+            if not (ex.respawning
+                    or ex.restarts < self.conf.executor_max_restarts):
+                continue
+            if ex.executor_id in exclude:
+                continue
+            if ex.failures >= self.conf.executor_blacklist_threshold:
+                continue
+            if ex.host in locs:
+                return True
+        return False
+
+    def _pick_executor(self, task: Task) -> _Executor:
+        return self._pick_executor_scored(task)[0]
+
+    def _pick_executor_scored(self, task: Task):
+        """One placement decision: (executor, locality_tier, improvable).
+
+        Eligibility is unchanged from the pre-locality dispatch path:
+        speculative duplicates must land on a different executor than the
+        straggling original (task.exclude_executors) and never on a
+        blacklisted one — no eligible executor skips the launch (raises;
+        the DAG ignores the failure since the original still runs) rather
+        than relaxing; ordinary tasks keep the advisory blacklist (better
+        flaky than none).
+
+        Placement among the eligible:
+          * locality_wait_s <= 0 — the legacy round-robin + first-match
+            seek (reference: distributed_scheduler.rs:447-469),
+            byte-for-byte, except that the seek now also compares
+            e.host: the locs _get_preferred_locs returns are hosts (and
+            executor ids), so the old id-only soft branch made host-level
+            locality from the cache tracker and pinned-host RDDs dead in
+            distributed mode. Reports no tier ("" — the histogram stays
+            empty, placement is unmeasured).
+          * locality_wait_s > 0 — candidates are scored
+            PROCESS_LOCAL > HOST_LOCAL > ANY, ties broken by fewest
+            in-flight tasks (then round-robin), instead of first-match.
+            `improvable` tells the caller whether waiting could yield a
+            better tier (see _pick_with_locality_wait)."""
         speculative = bool(getattr(task, "speculative", False))
         exclude = getattr(task, "exclude_executors", None) or ()
+        locs = getattr(task, "preferred_locs", None) or ()
+        wait_s = float(getattr(self.conf, "locality_wait_s", 0.0) or 0.0)
         with self._lock:
             alive = [e for e in self._executors.values() if e.alive]
             if not alive:
@@ -536,16 +627,61 @@ class DistributedBackend(TaskBackend):
                 clean = [e for e in alive if e.failures < threshold]
                 if clean:
                     alive = clean  # blacklist advisory: better flaky than none
-            if task.pinned and task.preferred_locs:
-                for e in alive:
-                    if e.host in task.preferred_locs or \
-                            e.executor_id in task.preferred_locs:
-                        return e
-            # soft locality: prefer an executor matching preferred_locs
-            for e in alive:
-                if e.executor_id in task.preferred_locs:
-                    return e
-            return alive[next(self._rr) % len(alive)]
+            if wait_s <= 0:
+                # Pinned seek and soft-locality seek (both now compare
+                # e.host as well as e.executor_id). Round-robin AMONG the
+                # matches, not first-match: on a fleet with several
+                # executors per host (the standard local spawn — every
+                # executor is 127.0.0.1), a host-named preference matches
+                # them all, and first-match would funnel every such task
+                # onto dict-order executor 0 instead of spreading.
+                if locs:
+                    matches = [e for e in alive
+                               if e.executor_id in locs or e.host in locs]
+                    if matches:
+                        return (matches[next(self._rr) % len(matches)],
+                                "", False)
+                return alive[next(self._rr) % len(alive)], "", False
+            tiers = [(self._match_tier(e, locs), e) for e in alive]
+            best = min(t for t, _ in tiers)
+            cands = [e for t, e in tiers if t == best]
+            # Tie-break: fewest in-flight dispatches first (live load,
+            # from the cancel-routing map), then round-robin so equally
+            # loaded executors still spread.
+            running: Dict[str, int] = {}
+            for eid in self._running_on.values():
+                running[eid] = running.get(eid, 0) + 1
+            least = min(running.get(e.executor_id, 0) for e in cands)
+            cands = [e for e in cands
+                     if running.get(e.executor_id, 0) == least]
+            chosen = cands[next(self._rr) % len(cands)]
+            improvable = bool(locs) and best > 0 and \
+                self._recoverable_better_tier_locked(locs, best, exclude)
+            return chosen, self._TIER_NAMES[best], improvable
+
+    def _pick_with_locality_wait(self, task: Task):
+        """(executor, tier): the bounded delay wait. A task whose best
+        achievable tier could still improve — a HOST it prefers has its
+        only executor down with a respawn in flight or budgeted
+        (_recoverable_better_tier_locked) — re-picks every 50ms for up
+        to locality_wait_s before settling for the worse tier.
+        Never starves: permanently-dead/blacklisted/excluded preferences
+        report not-improvable and settle immediately, speculative
+        duplicates never wait (they ARE the latency mitigation), and the
+        deadline is absolute from the first pick."""
+        deadline = None
+        while True:
+            executor, tier, improvable = self._pick_executor_scored(task)
+            if not improvable or bool(getattr(task, "speculative", False)):
+                return executor, tier
+            now = time.time()
+            if deadline is None:
+                deadline = now + float(self.conf.locality_wait_s)
+            elif now >= deadline:
+                log.info("locality wait expired for %s; settling for %s "
+                         "tier on %s", task, tier, executor.executor_id)
+                return executor, tier
+            time.sleep(min(0.05, max(0.001, deadline - now)))
 
     @property
     def preserialize_stage_binaries(self) -> bool:
@@ -577,6 +713,50 @@ class DistributedBackend(TaskBackend):
 
         threading.Thread(target=_send, daemon=True,
                          name=f"cancel-{task_id}").start()
+
+    def worker_stats(self) -> Dict[str, dict]:
+        """Process-local counters of every live worker (fetcher/push
+        totals — the worker-side numbers the driver event bus cannot
+        see), one `worker_stats` round trip per executor, issued in
+        PARALLEL so one wedged worker bounds the whole call at the single
+        5s probe deadline instead of 5s per dead peer. The deadline
+        covers the WHOLE round (connect AND reply — a wedged-but-
+        accepting worker must not park the probe on the 120s IO_TIMEOUT),
+        and the returned dict is a post-join snapshot so a straggling
+        probe thread can never mutate it under the caller's iteration.
+        Observability for tests and benchmarks/locality_ab.py: an
+        unreachable worker is simply omitted."""
+        with self._lock:
+            executors = [e for e in self._executors.values() if e.alive]
+        out: Dict[str, dict] = {}
+        out_lock = threading.Lock()
+
+        def probe(ex: _Executor) -> None:
+            try:
+                host, port = protocol.parse_uri(ex.task_uri)
+                with protocol.connect(host, port, timeout=5.0) as sock:
+                    sock.settimeout(5.0)  # whole-round probe deadline
+                    protocol.send_msg(sock, "worker_stats")
+                    reply_type, reply = protocol.recv_msg(sock)
+                if reply_type != "ok":
+                    raise NetworkError(
+                        f"worker_stats refused: {reply_type!r}")
+            except NetworkError:
+                log.debug("worker_stats probe of %s failed",
+                          ex.executor_id, exc_info=True)
+                return
+            with out_lock:
+                out[ex.executor_id] = reply
+
+        threads = [threading.Thread(target=probe, args=(ex,), daemon=True,
+                                    name=f"worker-stats-{ex.executor_id}")
+                   for ex in executors]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=6.0)
+        with out_lock:
+            return dict(out)
 
     def submit(self, task: Task, callback: Callable[[TaskEndEvent], None]) -> None:
         binary = task.stage_binary
@@ -694,7 +874,7 @@ class DistributedBackend(TaskBackend):
             no_executor_deadline = None
             while True:
                 try:
-                    executor = self._pick_executor(task)
+                    executor, tier = self._pick_with_locality_wait(task)
                 except NetworkError as e:
                     if task.speculative:
                         # A duplicate with nowhere eligible to run is a
@@ -771,14 +951,16 @@ class DistributedBackend(TaskBackend):
                                               result=result,
                                               duration_s=duration,
                                               dispatch=stats,
-                                              executor=executor.executor_id))
+                                              executor=executor.executor_id,
+                                              locality=tier))
                     else:
                         exc, remote_tb = rest
                         if not isinstance(exc, BaseException):
                             exc = TaskError(repr(exc), remote_traceback=remote_tb)
                         callback(TaskEndEvent(task=task, success=False,
                                               error=exc, dispatch=stats,
-                                              executor=executor.executor_id))
+                                              executor=executor.executor_id,
+                                              locality=tier))
                     return
                 except NetworkError as e:
                     # Executor lost: mark dead, re-dispatch elsewhere

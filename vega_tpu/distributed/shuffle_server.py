@@ -108,6 +108,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     # Reduce-side read of the pre-merge tier: freeze (the
                     # first call finalizes, idempotently), then one frozen
                     # blob + any store-and-forwarded raw pushed buckets.
+                    faults.get().serve_merged()  # modeled RTT (delay only)
                     shuffle_id, reduce_id = payload
                     tier = self.server.premerge  # type: ignore[attr-defined]
                     # tier.read owns the no-blob-voids-merged-set rule and

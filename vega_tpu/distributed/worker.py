@@ -71,6 +71,20 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         if msg_type == "ping":
             protocol.send_msg(sock, "ok", worker.executor_id)
             return
+        if msg_type == "worker_stats":
+            # Process-local fetch/push counters for the driver's
+            # observability probe (DistributedBackend.worker_stats):
+            # worker-side reduce tasks post no driver-bus events, so
+            # locality tests/benchmarks read these totals instead.
+            from vega_tpu import dependency as dependency_mod
+            from vega_tpu.shuffle import fetcher as fetcher_mod
+
+            protocol.send_msg(sock, "ok", {
+                "executor_id": worker.executor_id,
+                "fetch": fetcher_mod.stats_snapshot(),
+                "push": dependency_mod.push_stats_snapshot(),
+            })
+            return
         if msg_type == "cancel_task":
             # Best-effort cancel of a running attempt (the losing copy of
             # a speculated pair): flips the attempt's cancel event — the

@@ -80,6 +80,18 @@ class Configuration:
     # short interactive jobs are not starved by a long batch job.
     # Switchable at runtime via ctx.job_server.set_scheduler_mode(...).
     scheduler_mode: str = "fifo"
+    # Locality-aware task placement (distributed mode). > 0 turns the
+    # plane ON: the DAG scheduler computes reduce-side preferred
+    # locations (push-plan pre-merge owner / pull-plan biggest-bytes
+    # server) and _pick_executor scores candidates
+    # PROCESS_LOCAL > HOST_LOCAL > ANY, breaking ties by fewest in-flight
+    # tasks; a task whose only preferred executors are TEMPORARILY down
+    # (a respawn in flight or budgeted) waits up to this many seconds
+    # before settling for a worse tier — permanently dead, blacklisted or
+    # speculation-excluded preferred executors demote immediately, so the
+    # wait can never starve a task. 0 turns the whole plane off and
+    # reproduces the legacy round-robin + first-match placement.
+    locality_wait_s: float = 0.3
     # --- executor fault tolerance (distributed mode) ---
     # Worker -> driver heartbeat period. Must be well under
     # executor_liveness_timeout_s or healthy workers get reaped.
@@ -262,7 +274,8 @@ class Configuration:
                      "SPECULATION_QUORUM",
                      "HEARTBEAT_INTERVAL_S", "EXECUTOR_LIVENESS_TIMEOUT_S",
                      "EXECUTOR_REAP_INTERVAL_S", "EXECUTOR_RESTART_BACKOFF_S",
-                     "FETCH_RETRY_INTERVAL_S", "FETCH_SLOW_SERVER_S"):
+                     "FETCH_RETRY_INTERVAL_S", "FETCH_SLOW_SERVER_S",
+                     "LOCALITY_WAIT_S"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), float(env[pref + name]))
         return cfg

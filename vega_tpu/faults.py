@@ -55,6 +55,13 @@ tests:
   VEGA_TPU_FAULT_FETCH_DROP_AFTER_BUCKETS
                                      buckets to serve before the stream
                                      cut (default 1: deliver one, drop)
+  VEGA_TPU_FAULT_MERGED_DELAY_S      delay every served get_merged reply
+                                     by S seconds (a modeled cross-node
+                                     RTT: benchmarks/locality_ab.py's
+                                     non-local reducers pay it per remote
+                                     blob read, while a reducer scheduled
+                                     onto its owning executor reads
+                                     in-process and never enters the hook)
   VEGA_TPU_FAULT_PUSH_DROP_N         cut the first N push_merged rounds
                                      (shuffle_plan=push) AFTER the server
                                      consumed the payload but BEFORE the
@@ -129,6 +136,7 @@ class FaultInjector:
         self.fetch_stream_drop_n = _int("FETCH_STREAM_DROP_N") if armed else 0
         self.fetch_drop_after_buckets = _int("FETCH_DROP_AFTER_BUCKETS", 1)
         self.push_drop_n = _int("PUSH_DROP_N") if armed else 0
+        self.merged_delay_s = _float("MERGED_DELAY_S") if armed else 0.0
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
         self.drop_binary_n = _int("DROP_BINARY_N") if armed else 0
         self.stats_dir = env.get(pref + "STATS_DIR") or None
@@ -145,7 +153,7 @@ class FaultInjector:
             or self.suppress_heartbeats or self.fetch_drop_n
             or self.fetch_delay_s or self.corrupt_spill_n
             or self.fetch_stream_drop_n or self.drop_binary_n
-            or self.push_drop_n
+            or self.push_drop_n or self.merged_delay_s
         )
 
     def _targets_me(self) -> bool:
@@ -268,6 +276,17 @@ class FaultInjector:
         self._record("push_drop")
         log.warning("FAULT: dropping shuffle push connection")
         return True
+
+    def serve_merged(self) -> None:
+        """shuffle_server.py, on each get_merged round: delay the reply by
+        MERGED_DELAY_S seconds — a deterministic modeled network RTT. The
+        locality A/B's off-leg pays it once per REMOTE pre-merged blob
+        read; a reducer the locality plane scheduled onto its owning
+        executor reads the tier in-process and never enters this hook."""
+        if not (self.active and self.merged_delay_s and self._targets_me()):
+            return
+        self._record("merged_delay", sleep_s=self.merged_delay_s)
+        time.sleep(self.merged_delay_s)
 
     def maybe_drop_binary(self) -> bool:
         """worker.py, on a task_v2 dispatch whose driver believes the stage

@@ -42,6 +42,12 @@ class MapOutputTracker:
     def __init__(self):
         # shuffle_id -> per-map_id ordered location list (empty = missing).
         self._outputs: Dict[int, List[List[str]]] = {}
+        # shuffle_id -> map_id -> per-reduce_id bucket sizes in bytes
+        # (reported by the map tasks via Stage.bucket_sizes at map-stage
+        # completion). Feeds the locality plane's pull-plan preference:
+        # schedule reduce task r where most of r's input bytes already
+        # sit. Purely advisory — never consulted for correctness.
+        self._sizes: Dict[int, Dict[int, List[int]]] = {}
         self._generation = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -98,6 +104,40 @@ class MapOutputTracker:
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             self._outputs.pop(shuffle_id, None)
+            self._sizes.pop(shuffle_id, None)
+
+    # --- per-bucket size accounting (locality plane) -----------------------
+    def register_map_sizes(self, shuffle_id: int,
+                           sizes_by_map: Dict[int, List[int]]) -> None:
+        """Record per-reduce bucket sizes for (a subset of) a shuffle's map
+        outputs. Advisory locality metadata: stale entries (a recomputed
+        map task with different placement) are simply overwritten."""
+        with self._lock:
+            dst = self._sizes.setdefault(shuffle_id, {})
+            for map_id, sizes in sizes_by_map.items():
+                dst[map_id] = list(sizes)
+
+    def top_reduce_locations(self, shuffle_id: int, reduce_id: int,
+                             limit: int = 2) -> List[str]:
+        """Server URIs ranked by how many of `reduce_id`'s input bytes
+        they hold (every registered location of a map output holds a full
+        copy of its bucket), descending. Empty when no sizes were ever
+        reported. Non-blocking — the locality plane runs at task-submit
+        time, after the map stage registered, and a partial answer is a
+        hint, not an error."""
+        totals: Dict[str, int] = {}
+        with self._lock:
+            sizes = self._sizes.get(shuffle_id)
+            locs = self._outputs.get(shuffle_id)
+            if not sizes or locs is None:
+                return []
+            for map_id, row in sizes.items():
+                if not (0 <= map_id < len(locs)) or reduce_id >= len(row):
+                    continue
+                for uri in locs[map_id]:
+                    totals[uri] = totals.get(uri, 0) + row[reduce_id]
+        ranked = sorted(totals.items(), key=lambda kv: (-kv[1], kv[0]))
+        return [uri for uri, nbytes in ranked[:limit] if nbytes > 0]
 
     # --- queries (workers / reduce tasks) ----------------------------------
     def _wait_complete(self, shuffle_id: int, timeout: float) -> None:

@@ -343,11 +343,29 @@ class DAGScheduler:
         visit(stage.rdd)
         return missing
 
-    def _get_preferred_locs(self, rdd, partition: int, depth: int = 0) -> List[str]:
-        """cache locs -> rdd prefs -> narrow-parent recursion
-        (reference: base_scheduler.rs:499-528)."""
+    def _get_preferred_locs(self, rdd, partition: int, depth: int = 0,
+                            memo: Optional[Dict] = None) -> List[str]:
+        """cache locs -> rdd prefs -> narrow-parent recursion -> reduce-side
+        shuffle preference (reference: base_scheduler.rs:499-528, which
+        stops cold at shuffle boundaries and has no reduce-side tier).
+
+        `memo` caches results per (rdd_id, partition) for the duration of
+        ONE submit_missing_tasks call: tasks of a stage whose narrow
+        lineage fans into shared parent partitions (coalesce, union)
+        otherwise re-walk the same sub-lineage once per task on the DAG
+        event loop."""
         if depth > 20:
             return []
+        key = (rdd.rdd_id, partition)
+        if memo is not None and key in memo:
+            return memo[key]
+        locs = self._compute_preferred_locs(rdd, partition, depth, memo)
+        if memo is not None:
+            memo[key] = locs
+        return locs
+
+    def _compute_preferred_locs(self, rdd, partition: int, depth: int,
+                                memo: Optional[Dict]) -> List[str]:
         env = Env.get()
         if env.cache_tracker is not None and rdd.should_cache:
             cached = env.cache_tracker.get_cache_locs(rdd.rdd_id, partition)
@@ -361,10 +379,63 @@ class DAGScheduler:
         for dep in rdd.get_dependencies():
             if isinstance(dep, NarrowDependency):
                 for parent_part in dep.get_parents(partition):
-                    locs = self._get_preferred_locs(dep.rdd, parent_part, depth + 1)
+                    locs = self._get_preferred_locs(dep.rdd, parent_part,
+                                                    depth + 1, memo)
                     if locs:
                         return locs
+            elif isinstance(dep, ShuffleDependency):
+                locs = self._reduce_side_prefs(dep, partition)
+                if locs:
+                    return locs
         return []
+
+    def _reduce_side_prefs(self, dep: ShuffleDependency,
+                           reduce_id: int) -> List[str]:
+        """Preferred location(s) for a reduce task — the recursion no
+        longer stops cold at shuffle boundaries (the classic data-locality
+        lever the reference never ported; reduce tasks there get no
+        preferences at all).
+
+        * shuffle_plan=push + mergeable shuffle: the reducer's pre-merge
+          OWNER, via the same sorted live-peer rotation the mapper pushes
+          along (dependency.push_owner_for_peers over the backend's
+          shuffle-peer registry) — landing the reducer there makes the
+          fetcher's in-process fast path serve the frozen blob with ZERO
+          round trips.
+        * pull plan (or an unpushable shuffle): the server(s) holding the
+          most map-output bytes for this reduce_id (MapOutputTracker
+          per-bucket size accounting).
+
+        The returned strings are shuffle-server URIs; _pick_executor
+        scores them as PROCESS_LOCAL through each executor's registered
+        shuffle_uri. Pure hints: empty on any missing piece (plane off,
+        local mode, no peers, no sizes) and placement falls back to the
+        legacy behavior."""
+        env = Env.get()
+        conf = env.conf
+        if float(getattr(conf, "locality_wait_s", 0.0) or 0.0) <= 0:
+            return []  # locality plane off: byte-for-byte legacy placement
+        tracker = env.map_output_tracker
+        if tracker is None:
+            return []
+        from vega_tpu.dependency import is_push_plan
+
+        if is_push_plan(conf):
+            from vega_tpu import native
+            from vega_tpu.dependency import push_owner_for_peers
+
+            agg = dep.aggregator
+            if not agg.is_group and agg.op_name in native.OP_BY_NAME:
+                peers_fn = getattr(self.backend, "shuffle_peer_uris", None)
+                if peers_fn is not None:
+                    owner = push_owner_for_peers(peers_fn(), reduce_id)
+                    if owner:
+                        return [owner]
+        top = getattr(tracker, "top_reduce_locations", None)
+        if top is None:
+            return []
+        return [u for u in top(dep.shuffle_id, reduce_id)
+                if u and u != "local"]
 
     # ------------------------------------------------------- stage ownership
     def _try_claim_stage(self, stage: Stage, job: _Job) -> bool:
@@ -526,6 +597,10 @@ class DAGScheduler:
                         self._stage_users.get(stage.id, 0) + 1
             pending = job.pending_tasks.setdefault(stage.id, set())
             tasks: List[Task] = []
+            # One preferred-locs memo per submit_missing_tasks call: the
+            # narrow-parent recursion over shared sub-lineages runs once
+            # per (rdd, partition), not once per task.
+            locs_memo: Dict = {}
             if stage is final_stage:
                 splits = rdd.cached_splits()
                 for out_id, p in enumerate(partitions):
@@ -533,7 +608,7 @@ class DAGScheduler:
                         split = splits[p]
                         tasks.append(ResultTask(
                             stage.id, rdd, func, p, split, out_id,
-                            self._get_preferred_locs(rdd, p),
+                            self._get_preferred_locs(rdd, p, memo=locs_memo),
                             pinned=rdd.is_pinned,
                         ))
             else:
@@ -543,7 +618,8 @@ class DAGScheduler:
                         split = splits[p]
                         tasks.append(ShuffleMapTask(
                             stage.id, stage.rdd, stage.shuffle_dep, p, split,
-                            self._get_preferred_locs(stage.rdd, p),
+                            self._get_preferred_locs(stage.rdd, p,
+                                                     memo=locs_memo),
                             pinned=stage.rdd.is_pinned,
                         ))
             # One stage binary for every task of the stage (and every retry
@@ -802,6 +878,7 @@ class DAGScheduler:
                     duplicate=bool(event.success and committed(event.task)),
                     job_id=job.job_id,
                     executor=event.executor or "local",
+                    locality=event.locality,
                 ))
                 key = (event.task.stage_id, event.task.partition)
                 copies = job.inflight.get(key)
@@ -865,6 +942,20 @@ class DAGScheduler:
         from vega_tpu import dependency as _dependency
 
         _dependency._invalidate_peer_cache()
+        # Placement-state scrub (locality plane): cached-partition
+        # locations registered by the lost executor must not steer fresh
+        # stages at a dead target — the delay wait would otherwise burn
+        # locality_wait_s per task on a preference that can only be
+        # satisfied by a respawn that no longer holds the cache. Mirrors
+        # the Stage.output_locs scrub below; runs BEFORE the shuffle_uri
+        # early return (an executor can hold cache without map outputs).
+        cache_tracker = Env.get().cache_tracker
+        if cache_tracker is not None and \
+                hasattr(cache_tracker, "drop_executor"):
+            dropped = cache_tracker.drop_executor(executor_id)
+            if dropped:
+                log.info("dropped %d cached-partition locations of lost "
+                         "executor %s", dropped, executor_id)
         if not shuffle_uri:
             return
         with self._stages_lock:
@@ -922,6 +1013,14 @@ class DAGScheduler:
                     [list(locs) if locs else None
                      for locs in stage.output_locs],
                 )
+                # Per-bucket sizes (from the map task results) feed the
+                # locality plane's pull-plan reduce preference: schedule
+                # reduce task r where most of r's bytes already sit.
+                if stage.bucket_sizes and \
+                        hasattr(tracker, "register_map_sizes"):
+                    tracker.register_map_sizes(
+                        stage.shuffle_dep.shuffle_id,
+                        dict(stage.bucket_sizes))
             # Hand the stage back: concurrent jobs parked behind it can
             # now consume its outputs (their poll sees availability), and
             # nothing stale blocks a future re-claim after invalidation.

@@ -95,6 +95,12 @@ class TaskEnd(Event):
     # The job this completion belongs to: per-job listeners and the
     # per-job MetricsListener aggregation key on it, end to end.
     job_id: int = -1
+    # Locality tier the dispatch achieved against the task's preferred
+    # locations: "process" (executor-id / shuffle-uri match), "host"
+    # (host match), "any" (no match, or no preferences). Empty when the
+    # backend doesn't place tasks (local threads). MetricsListener folds
+    # these into global and per-stage locality histograms.
+    locality: str = ""
 
 
 @dataclasses.dataclass
@@ -217,6 +223,12 @@ class ShuffleFetchCompleted(Event):
     # owning server's pre-merged blob instead of pulled raw — the
     # pre-merged fraction is premerged_buckets / buckets.
     premerged_buckets: int = 0
+    # shuffle_plan=push: pre-merged reads served from the IN-PROCESS tier
+    # (the reducer ran on its owning executor — zero round trips) vs the
+    # remote `get_merged` round trips actually paid. The locality plane's
+    # reduce-side win is local_blob_reads up, merged_rtts down.
+    local_blob_reads: int = 0
+    merged_rtts: int = 0
 
 
 @dataclasses.dataclass
@@ -387,6 +399,15 @@ class MetricsListener(Listener):
         self.fetch_net_s = 0.0
         self.fetch_overlap_s = 0.0
         self.fetch_premerged_buckets = 0
+        self.fetch_local_blob_reads = 0
+        self.fetch_merged_rtts = 0
+        # Locality-plane histogram (TaskEnd.locality): how many dispatches
+        # achieved each tier against their preferred locations. Per-stage
+        # copies live in self.stages[stage_id]["locality"]. bench.py and
+        # benchmarks/locality_ab.py surface these as the `locality`
+        # detail. Only dispatches that MEASURE placement count (the
+        # distributed backend; local threads leave the field empty).
+        self.locality: Dict[str, int] = {"process": 0, "host": 0, "any": 0}
         # Push-plan counters (ShufflePushCompleted): map-side pushes into
         # the owning servers' pre-merge tiers. benchmarks/
         # shuffle_plan_ab.py and bench.py surface these as `shuffle_push`.
@@ -455,6 +476,12 @@ class MetricsListener(Listener):
                     self.task_failures += 1
                 if event.duplicate:
                     self.speculation["duplicate_completions"] += 1
+                if event.locality:
+                    self.locality[event.locality] = \
+                        self.locality.get(event.locality, 0) + 1
+                    stage_info = self.stages.setdefault(event.stage_id, {})
+                    hist = stage_info.setdefault("locality", {})
+                    hist[event.locality] = hist.get(event.locality, 0) + 1
                 if event.job_id != -1:
                     info = self._job(event.job_id)
                     info["tasks"] += 1
@@ -502,6 +529,8 @@ class MetricsListener(Listener):
                 self.fetch_net_s += event.net_s
                 self.fetch_overlap_s += event.overlap_s
                 self.fetch_premerged_buckets += event.premerged_buckets
+                self.fetch_local_blob_reads += event.local_blob_reads
+                self.fetch_merged_rtts += event.merged_rtts
             elif isinstance(event, ShufflePushCompleted):
                 sp = self.shuffle_push
                 sp["pushes"] += 1
@@ -558,7 +587,10 @@ class MetricsListener(Listener):
                     "failovers": self.fetch_failovers,
                     "failover_buckets": self.fetch_failover_buckets,
                     "premerged_buckets": self.fetch_premerged_buckets,
+                    "local_blob_reads": self.fetch_local_blob_reads,
+                    "merged_rtts": self.fetch_merged_rtts,
                 },
+                "locality": dict(self.locality),
                 "shuffle_push": {**self.shuffle_push,
                                  "wall_s": round(
                                      self.shuffle_push["wall_s"], 6)},

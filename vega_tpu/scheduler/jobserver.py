@@ -88,6 +88,13 @@ class TaskArbiter:
     flight and picks what runs next when a slot frees. Completion
     callbacks are wrapped to release the slot and pump the queue —
     correctness never depends on the pick policy, only ordering does.
+
+    Placement hints ride THROUGH the arbiter untouched: the queued entry
+    holds the very Task object the scheduler built, so its
+    preferred_locs / pinned / exclude_executors reach the backend's
+    locality-tiered ``_pick_executor`` whichever pool or ordering mode
+    dequeued it — fair scheduling decides WHEN a task dispatches, the
+    locality plane decides WHERE (test_scheduler proves the pass-through).
     """
 
     def __init__(self, backend, mode: str = "fifo"):

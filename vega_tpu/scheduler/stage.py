@@ -22,6 +22,11 @@ class Stage:
         self.parents = parents
         self.num_partitions = rdd.num_partitions
         self.output_locs: List[List[str]] = [[] for _ in range(self.num_partitions)]
+        # map_id -> per-reduce bucket sizes in bytes, as reported in the
+        # map tasks' results ((locs, sizes) tuples). Registered into the
+        # MapOutputTracker at stage completion so the locality plane can
+        # schedule reduce tasks where their input bytes already sit.
+        self.bucket_sizes: dict = {}
         # The stage-level task binary (scheduler/task.py StageBinary),
         # built lazily at first submit_missing_tasks and reused across
         # retries, resubmissions, and later jobs over a cached map stage:
@@ -49,9 +54,13 @@ class Stage:
         return self.num_available_outputs == self.num_partitions
 
     def add_output_loc(self, partition: int, uri) -> None:
-        """`uri` is a single server URI or — with shuffle_replication > 1 —
-        the ordered [primary, replica, ...] list a map task returned.
-        Newest placement first, duplicates collapsed."""
+        """`uri` is a map task's result: a single server URI, the ordered
+        [primary, replica, ...] list written under shuffle_replication > 1,
+        or the ((locs, sizes)) pair carrying per-reduce bucket sizes for
+        the locality plane. Newest placement first, duplicates collapsed."""
+        if isinstance(uri, tuple):
+            uri, sizes = uri
+            self.bucket_sizes[partition] = list(sizes)
         uris = [uri] if isinstance(uri, str) else list(uri)
         self.output_locs[partition] = uris + [
             u for u in self.output_locs[partition] if u not in uris

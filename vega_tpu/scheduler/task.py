@@ -356,8 +356,9 @@ class ResultTask(Task):
 
 
 class ShuffleMapTask(Task):
-    """Parent-stage task: run the map-side combine, return this executor's
-    shuffle server URI (reference: shuffle_map_task.rs:86-91)."""
+    """Parent-stage task: run the map-side combine, return this output's
+    (locations, per-reduce bucket sizes) pair
+    (reference: shuffle_map_task.rs:86-91, which returns the bare URI)."""
 
     def __init__(self, stage_id: int, rdd, dep: ShuffleDependency,
                  partition: int, split: Split,
@@ -367,7 +368,7 @@ class ShuffleMapTask(Task):
         self.rdd = rdd
         self.dep = dep
 
-    def run(self) -> str:
+    def run(self) -> tuple:
         tc = TaskContext(self.stage_id, self.split.index, self.attempt)
         return self.dep.do_shuffle_task(self.split, tc)
 
@@ -388,3 +389,7 @@ class TaskEndEvent:
     # Which executor ran the attempt (distributed backend stamps it;
     # local threads leave None -> reported as "local" on the bus).
     executor: Optional[str] = None
+    # Locality tier the dispatch achieved against task.preferred_locs
+    # ("process" | "host" | "any"; "" = backend doesn't place, e.g. local
+    # threads). Aggregated into MetricsListener's per-stage histogram.
+    locality: str = ""
