@@ -65,6 +65,10 @@ _TOTALS = {
     "net_s": 0.0, "wait_s": 0.0, "overlap_s": 0.0, "wall_s": 0.0,
     "peak_queued": 0, "duplicates": 0, "failovers": 0,
     "failover_buckets": 0, "premerged": 0,
+    # Push-plan read locality: pre-merged reads served from the
+    # IN-PROCESS tier (the reducer ran on its owning executor — zero
+    # round trips) vs remote `get_merged` round trips actually paid.
+    "local_blob_reads": 0, "merged_rtts": 0,
 }
 
 
@@ -84,7 +88,8 @@ def _bank_totals(stats: dict) -> None:
         _TOTALS["streams"] += 1
         for k in ("buckets", "bytes", "round_trips", "net_s", "wait_s",
                   "overlap_s", "wall_s", "duplicates", "failovers",
-                  "failover_buckets", "premerged"):
+                  "failover_buckets", "premerged", "local_blob_reads",
+                  "merged_rtts"):
             _TOTALS[k] += stats[k]
         if stats["peak_queued"] > _TOTALS["peak_queued"]:
             _TOTALS["peak_queued"] = stats["peak_queued"]
@@ -150,7 +155,7 @@ class ShuffleFetcher:
         stats = {"buckets": 0, "bytes": 0, "round_trips": 0, "net_s": 0.0,
                  "wait_s": 0.0, "peak_queued": 0, "duplicates": 0,
                  "failovers": 0, "failover_buckets": 0, "batched": batched,
-                 "premerged": 0}
+                 "premerged": 0, "local_blob_reads": 0, "merged_rtts": 0}
         t_start = time.monotonic()
         delivered = set()
         total = len(uri_lists)
@@ -187,8 +192,9 @@ class ShuffleFetcher:
             # side never pushes those (dependency._push_row's monoid
             # gate), so the pre-read is skipped — an empty-by-construction
             # get_merged round would only add latency per reduce task.
-            if mergeable and str(getattr(conf, "shuffle_plan",
-                                         "pull")).lower() == "push":
+            from vega_tpu.dependency import is_push_plan
+
+            if mergeable and is_push_plan(conf):
                 from vega_tpu.dependency import push_owner_uri
                 from vega_tpu.distributed.shuffle_server import (
                     fetch_merged_remote)
@@ -210,6 +216,9 @@ class ShuffleFetcher:
                             merged_ids, blob, raws = \
                                 env.shuffle_server.premerge.read(
                                     shuffle_id, reduce_id)
+                            # The locality plane's reduce-side win: the
+                            # blob never crossed a socket.
+                            stats["local_blob_reads"] += 1
                         else:
                             # fetch_slow_server_s bounds this round when
                             # set: a hung owner degrades to pull in
@@ -219,6 +228,7 @@ class ShuffleFetcher:
                                 owner, shuffle_id, reduce_id,
                                 deadline_s=slow_s or None)
                             stats["round_trips"] += 1
+                            stats["merged_rtts"] += 1
                     except Exception as e:  # noqa: BLE001 — the pre-merged
                         # read is an optimization; ANY failure (transport,
                         # malformed reply, tier/store errors) must degrade
@@ -540,6 +550,8 @@ class ShuffleFetcher:
                     wall_s=wall, net_s=stats["net_s"],
                     overlap_s=stats["overlap_s"], batched=batched,
                     premerged_buckets=stats["premerged"],
+                    local_blob_reads=stats["local_blob_reads"],
+                    merged_rtts=stats["merged_rtts"],
                 ))
             except Exception:  # noqa: BLE001 — observability must not break IO
                 log.debug("fetch event emit failed", exc_info=True)
