@@ -1,0 +1,227 @@
+"""A/B/C: DataFrame whole-stage fusion + parquet pushdown (PR 11).
+
+One analytics query — filter -> groupBy-sum -> join -> sort over a
+6-column parquet events table (only 2 columns relevant) joined against a
+dims table — run three ways:
+
+  rdd_chain  hand-written device RDD pipeline (manual pushdown: reads
+             exactly the needed parquet columns, then dense_from_columns
+             + traced filter + named reduce + dense join + sort)
+  unfused    DataFrame with hint(fuse=False, pushdown=False): every
+             column leaves the file, every verb compiles and launches its
+             own shard program with a materialized intermediate block
+  fused      DataFrame defaults: pruned+predicate-pushed scan, ONE fused
+             program per narrow stage
+
+Legs are interleaved per repetition (shared-sandbox drift hits all
+equally), medians of 3 after one warmup rep per leg (program compiles +
+capacity hints land in the warmup). All three legs must be bit-identical
+(int32 arithmetic end to end). Acceptance: fused >= 1.5x unfused on the
+CPU mesh.
+
+Prints ONE JSON line. Usage:
+
+  python benchmarks/frame_ab.py [rows] [key_space]
+"""
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+REPS = 3
+FILTER_FRAC = 0.6  # keep ~60% of events rows
+
+
+def _median(xs):
+    return statistics.median(xs)
+
+
+def _make_fixture(rows: int, key_space: int):
+    """events: 6 int32 columns (k, x + 4 pad); dims: (k, y). Deterministic
+    data, int32-safe sums."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tempfile.mkdtemp(prefix="frame_ab_")
+    rng = np.random.default_rng(7)
+    k = (rng.integers(0, key_space, rows)).astype(np.int64)
+    x = rng.integers(0, 1000, rows).astype(np.int64)
+    events = {"k": k, "x": x}
+    for i in range(4):
+        events[f"pad{i}"] = rng.integers(0, 1 << 20, rows).astype(np.int64)
+    events_dir = os.path.join(root, "events")
+    os.makedirs(events_dir)
+    pq.write_table(pa.table(events),
+                   os.path.join(events_dir, "part0.parquet"),
+                   row_group_size=max(1, rows // 16))
+    dims_dir = os.path.join(root, "dims")
+    os.makedirs(dims_dir)
+    dk = np.arange(key_space, dtype=np.int64)
+    dy = ((dk * 2654435761) % 997).astype(np.int64)
+    pq.write_table(pa.table({"k": dk, "y": dy}),
+                   os.path.join(dims_dir, "part0.parquet"))
+    return root, events_dir, dims_dir
+
+
+def _canon(cols: dict):
+    """Sort columnar output by key for the bit-identical check."""
+    import numpy as np
+
+    names = sorted(cols)
+    key = next(nm for nm in ("k",) if nm in cols)
+    order = np.argsort(np.asarray(cols[key]), kind="stable")
+    return {nm: np.asarray(cols[nm])[order] for nm in names}
+
+
+def _legs(ctx, events_dir: str, dims_dir: str, threshold: int):
+    """The three closures; each returns {name: np column}."""
+    import numpy as np
+
+    from vega_tpu.frame import F, col
+
+    def rdd_chain():
+        import glob
+
+        import pyarrow.parquet as pq
+
+        # Manual pushdown: exactly the needed columns leave the file.
+        ev = pq.read_table(glob.glob(os.path.join(events_dir, "*.parquet")),
+                           columns=["k", "x"])
+        keys = ev.column("k").to_numpy().astype(np.int32, copy=False)
+        xs = ev.column("x").to_numpy().astype(np.int32, copy=False)
+        src = ctx.dense_from_columns({"k": keys, "x": xs}, key="k")
+        xi = src.columns.index("x")  # key= moves "k" to the schema tail
+        left = (src.filter(lambda row: row[xi] < threshold)
+                .reduce_by_key(op="add")
+                .rename({"x": "v"}))
+        dm = pq.read_table(glob.glob(os.path.join(dims_dir, "*.parquet")))
+        right = ctx.dense_from_columns(
+            {"k": dm.column("k").to_numpy().astype(np.int32, copy=False),
+             "y": dm.column("y").to_numpy().astype(np.int32, copy=False)},
+            key="k").reduce_by_key(op="add").rename({"y": "v"})
+        joined = left.join(right).sort_by_key()
+        out = joined.collect_arrays()
+        return {"k": out["k"], "sx": out["lv"], "sy": out["rv"]}
+
+    def frame_query():
+        ev = ctx.read_parquet(events_dir)
+        dm = ctx.read_parquet(dims_dir)
+        return (ev.filter(col("x") < threshold)
+                .group_by("k").agg(F.sum("x", "sx"))
+                .join(dm.group_by("k").agg(F.sum("y", "sy")), on="k")
+                .sort("k"))
+
+    def unfused():
+        return frame_query().hint(fuse=False, pushdown=False) \
+            .collect_columns()
+
+    def fused():
+        return frame_query().collect_columns()
+
+    def untraceable():
+        # The same query with a Python-object expression in the chain:
+        # the tracer rejects it, the SAME logical plan recompiles on the
+        # host tier SILENTLY, results identical (the two-tier contract —
+        # any surfaced error here fails the acceptance bound).
+        offsets = {0: 0, 1: 0}  # value-keyed dict: int(tracer) cannot trace
+
+        def opaque(c):
+            vals = np.asarray(c)
+            return vals + np.asarray(
+                [offsets[int(x) % 2] for x in vals])
+
+        ev = ctx.read_parquet(events_dir)
+        dm = ctx.read_parquet(dims_dir)
+        from vega_tpu.frame import udf as _udf
+
+        q = (ev.filter(col("x") < threshold)
+             .with_column("x2", _udf(opaque, col("x")))
+             .group_by("k").agg(F.sum("x2", "sx"))
+             .join(dm.group_by("k").agg(F.sum("y", "sy")), on="k")
+             .sort("k"))
+        assert "host tier" in q.explain()
+        return q.collect_columns()
+
+    return {"rdd_chain": rdd_chain, "unfused": unfused, "fused": fused,
+            "untraceable": untraceable}
+
+
+def run_legs(ctx, rows: int = 1_000_000, key_space: int = 4096):
+    """Run the three legs inside a live Context; returns the result dict
+    (benchmarks/suite.py config 10 calls this)."""
+    import numpy as np
+
+    root, events_dir, dims_dir = _make_fixture(rows, key_space)
+    threshold = int(1000 * FILTER_FRAC)
+    try:
+        legs = _legs(ctx, events_dir, dims_dir, threshold)
+        order = ["rdd_chain", "unfused", "fused"]
+        canon = {}
+        for name in order:  # warmup: compiles + capacity hints
+            canon[name] = _canon(legs[name]())
+        # Untimed correctness leg: the untraceable-expression plan must
+        # complete via the host tier with identical results, NO error.
+        canon["untraceable"] = _canon(legs["untraceable"]())
+        for name in order[1:] + ["untraceable"]:
+            for col_name in canon[order[0]]:
+                if not np.array_equal(canon[order[0]][col_name],
+                                      canon[name][col_name]):
+                    raise AssertionError(
+                        f"leg {name!r} diverged on column {col_name!r}")
+        walls = {name: [] for name in order}
+        for _ in range(REPS):
+            for name in order:  # interleaved: drift hits all legs equally
+                t0 = time.monotonic()
+                out = legs[name]()
+                walls[name].append(time.monotonic() - t0)
+                del out
+        med = {name: _median(walls[name]) for name in order}
+        speedup = med["unfused"] / med["fused"] if med["fused"] else None
+        return {
+            "metric": "frame fusion+pushdown A/B/C: filter->groupBy-sum->"
+                      "join->sort over a 6-col parquet table (2 relevant "
+                      "cols); hand RDD chain vs DataFrame unfused/"
+                      "unpruned vs DataFrame fused+pushdown; medians of "
+                      "3, legs interleaved, bit-identical asserted",
+            "rows": rows,
+            "key_space": key_space,
+            "filter_threshold": threshold,
+            "rdd_chain_s": round(med["rdd_chain"], 6),
+            "unfused_s": round(med["unfused"], 6),
+            "fused_s": round(med["fused"], 6),
+            "fused_vs_unfused": round(speedup, 3) if speedup else None,
+            "fused_vs_rdd_chain": round(
+                med["rdd_chain"] / med["fused"], 3) if med["fused"] else None,
+            "bit_identical": True,  # asserted above, else we raised
+            "untraceable_fallback_ok": True,  # asserted above too
+            "fused_speedup_ok": bool(speedup and speedup >= 1.5),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    force_cpu_mesh(8)
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    key_space = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    import vega_tpu as v
+
+    ctx = v.Context.active() or v.Context("local")
+    try:
+        print(json.dumps(run_legs(ctx, rows, key_space)))
+    finally:
+        if v.Context.active() is ctx:
+            ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -346,6 +346,27 @@ def config9_locality(ctx, scale=1.0, bank=None):
     return rows * out["mappers"], out["e2e_s"]["off"], out["e2e_s"]["on"]
 
 
+def config10_frame(ctx, scale=1.0, bank=None):
+    """PR 11 DataFrame layer: filter->groupBy-sum->join->sort over a
+    6-column parquet table (2 relevant columns), DataFrame WITHOUT
+    fusion/pushdown vs WITH both (benchmarks/frame_ab.py; legs
+    interleaved, medians of 3, all three legs — including a hand-written
+    device RDD chain — asserted bit-identical by the A/B itself).
+    Reported through the standard columns: host_s = unfused/unpruned
+    DataFrame wall, device_s = fused+pushdown wall, so device_vs_host
+    reads as the planner's win. Both legs run on the device tier, so
+    this DOES belong in a TPU window (tpu_jobs/10)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from frame_ab import run_legs
+
+    rows = max(100_000, int(1_000_000 * scale))
+    out = run_legs(ctx, rows, 4096)
+    assert out["bit_identical"], "frame legs diverged"
+    if bank:
+        bank(rows, out["fused_s"])
+    return rows, out["unfused_s"], out["fused_s"]
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -359,6 +380,8 @@ CONFIGS = {
         config8_shuffle_plan),
     9: ("push-plan locality off vs on e2e (modeled get_merged RTT)",
         config9_locality),
+    10: ("DataFrame fused+pushdown vs unfused (parquet analytics query)",
+         config10_frame),
 }
 
 

@@ -1,0 +1,12 @@
+#!/bin/bash
+# DataFrame fusion + pushdown A/B/C (PR 11) on the real chip: both the
+# unfused and fused legs are DEVICE legs, so this is the first frame
+# number that means anything off the 1-core CPU proxy (proxy result:
+# fused ~2.9x unfused, fused ~1.2x the hand RDD chain at 1M rows — see
+# docs/BENCH_NOTES.md). On TPU the per-program launch overhead the
+# unfused leg pays N times is RTT-shaped through the tunnel, so the
+# fusion ratio should widen; the parquet-read half of the pushdown win
+# stays host-side and should hold as-is. One JSON line; acceptance
+# bounds ride fused_speedup_ok / bit_identical.
+cd /root/repo
+exec python benchmarks/frame_ab.py 4000000 8192

@@ -11,7 +11,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FAST_EXAMPLES = ["make_rdd.py", "subtract.py", "file_read.py",
                  "columnar_analytics.py", "streamed_billion_rows.py",
                  "group_by.py", "join.py", "parquet_column_read.py",
-                 "distributed_cluster.py"]  # all nine ship runnable
+                 "distributed_cluster.py",
+                 "frame_analytics.py"]  # all ten ship runnable
 
 
 @pytest.mark.parametrize("example", FAST_EXAMPLES)

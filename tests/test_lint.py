@@ -813,6 +813,58 @@ def test_vg012_silent_on_deadlined_ops_and_out_of_scope(tmp_path):
     assert not out.findings  # scheduler/ is VG007's turf, not VG012's
 
 
+# ---------------------------------------------------------------- VG013
+def test_vg013_fires_on_materializing_calls_in_frame_planning(tmp_path):
+    res = _lint(tmp_path, "vega_tpu/frame/newplanner.py", """\
+        def lower(node, rdd):
+            rows = rdd.collect()
+            blk = node.block()
+            counts = blk.counts_np
+            return rows, counts
+        """, select=["VG013"])
+    assert _rules(res) == ["VG013"] * 3  # collect, block, counts_np
+
+
+def test_vg013_silent_on_lazy_planning_and_in_api(tmp_path):
+    # Pure lineage building in planner code: no findings.
+    clean = _lint(tmp_path, "vega_tpu/frame/newplanner.py", """\
+        def lower(node, exprs):
+            staged = node.reduce_by_key(op="add")
+            return staged.sort_by_key(ascending=True)
+        """, select=["VG013"])
+    assert not clean.findings
+    # The SAME materializing calls in the action surface (api.py) are
+    # the sanctioned route.
+    api = _lint(tmp_path, "vega_tpu/frame/api.py", """\
+        def collect_columns(compiled):
+            return compiled.rdd.collect_arrays()
+        """, select=["VG013"])
+    assert not api.findings
+    # And outside vega_tpu/frame/ the rule has no opinion.
+    out = _lint(tmp_path, "vega_tpu/tpu/newthing.py", """\
+        def read(rdd):
+            return rdd.collect()
+        """, select=["VG013"])
+    assert not out.findings
+
+
+def test_vg013_fires_in_real_tree_shape(tmp_path):
+    """A materializing call added to the real planner module layout must
+    produce exactly one VG013 finding."""
+    base = run_lint([str(tmp_path)], select=["VG013"])
+    assert not base.findings
+    p = tmp_path / "vega_tpu" / "frame" / "planner.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent("""\
+        def _lower_device(ctx, plan):
+            node = make_source(ctx, plan)
+            node.block()  # materializes at plan-build time
+            return node
+        """))
+    res = run_lint([str(tmp_path)], select=["VG013"])
+    assert _rules(res) == ["VG013"]
+
+
 # ---------------------------- mutation self-tests against the real tree
 import os as _os
 import shutil as _shutil

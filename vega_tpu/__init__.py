@@ -32,7 +32,8 @@ from vega_tpu.store import StorageLevel
 __version__ = "0.1.0"
 
 
-_LAZY = ("DenseRDD",)
+_FRAME_LAZY = ("DataFrame", "GroupedFrame", "F", "col", "lit", "udf")
+_LAZY = ("DenseRDD",) + _FRAME_LAZY
 
 
 def __getattr__(name):
@@ -42,6 +43,14 @@ def __getattr__(name):
 
         globals()[name] = DenseRDD  # cache for subsequent lookups
         return DenseRDD
+    if name in _FRAME_LAZY:
+        # The frame layer imports lazily too: its device planner reaches
+        # dense_rdd (jax) only when a device plan is actually built.
+        from vega_tpu import frame as frame_mod
+
+        value = getattr(frame_mod, name)
+        globals()[name] = value
+        return value
     raise AttributeError(f"module 'vega_tpu' has no attribute {name!r}")
 
 

@@ -206,12 +206,38 @@ class Context:
 
     def parquet_file(self, path: str, columns: Optional[List[str]] = None,
                      num_partitions: Optional[int] = None) -> RDD:
-        from vega_tpu.io.readers import ParquetReaderConfig
+        from vega_tpu.io.readers import ParquetColumnReader
 
         return self.read_source(
-            ParquetReaderConfig(path, columns,
+            ParquetColumnReader(path, columns,
                                 num_partitions or self.default_parallelism)
         )
+
+    # ------------------------------------------------------------ DataFrame
+    def read_parquet(self, path: str, columns: Optional[List[str]] = None,
+                     num_partitions: Optional[int] = None):
+        """Parquet -> DataFrame (vega_tpu/frame): the expression/verb API
+        whose planner pushes column pruning and supported predicates into
+        ParquetColumnReader and fuses narrow verb chains into one SPMD
+        program per stage on the device tier. `columns=` pre-prunes at
+        the entry point; the planner prunes further from the query. For
+        the raw columnar-block RDD, use parquet_file()."""
+        from vega_tpu.frame.api import DataFrame
+
+        return DataFrame.from_parquet(self, path, columns, num_partitions)
+
+    def create_frame(self, columns: Optional[dict] = None,
+                     num_partitions: Optional[int] = None, **kwcolumns):
+        """In-memory columns -> DataFrame (dict and/or keywords), the
+        frame-layer sibling of dense_from_columns."""
+        from vega_tpu.frame.api import DataFrame
+
+        data = dict(columns or {})
+        for name, c in kwcolumns.items():
+            if name in data:
+                raise VegaError(f"duplicate column {name!r}")
+            data[name] = c
+        return DataFrame.from_columns(self, data, num_partitions)
 
     # Device-tier sources (vega_tpu/tpu): numeric RDDs whose partitions are
     # arrays and whose ops lower to XLA.

@@ -1,0 +1,15 @@
+"""vega_tpu.frame — the columnar DataFrame layer.
+
+Expression IR (expr.py), logical plan + pure rewrites (logical.py),
+logical->physical compiler with whole-stage device fusion and parquet
+pushdown (planner.py), lazy physical building blocks (physical.py), and
+the action surface (api.py — the only module here allowed to
+materialize; VG013 enforces the split).
+
+Entry points: ``ctx.read_parquet(path)`` and ``ctx.create_frame(cols)``
+(context.py)."""
+
+from vega_tpu.frame.api import DataFrame, GroupedFrame
+from vega_tpu.frame.expr import F, col, lit, udf
+
+__all__ = ["DataFrame", "GroupedFrame", "F", "col", "lit", "udf"]

@@ -1,0 +1,390 @@
+"""Frame physical building blocks.
+
+Device side: LAZY columnar sources — plan compilation constructs the node,
+the first `block()` access reads the file / coerces the arrays (planning
+itself never touches data or device: VG013). Host side: the picklable
+per-partition closures the host-tier compile wires into ordinary RDD
+lineages (columnar block stages, group-agg pivots, tuple combiners).
+
+Dtype contract at the device boundary (the same degrade dense_from_numpy
+applies): int64/uint64 columns whose values fit int32 narrow to int32;
+float64 narrows to float32; bool widens to int32; anything else — object
+dtypes, out-of-range int64 — makes the PLANNER compile the host tier
+instead (silent fallback, never an error)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from vega_tpu.frame import expr as expr_lib
+from vega_tpu.frame.expr import evaluate
+
+
+class HostFallback(Exception):
+    """Raised during device lowering: compile the same logical plan on the
+    host tier instead (the two-tier contract, silently)."""
+
+
+# ---------------------------------------------------------------------------
+# dtype coercion at the device boundary
+# ---------------------------------------------------------------------------
+
+
+def coerce_dtype(np_dtype) -> str:
+    """numpy dtype -> device dtype name, or raise HostFallback."""
+    dt = np.dtype(np_dtype)
+    if dt == np.bool_:
+        return "int32"
+    if dt.kind in ("i", "u"):
+        if dt.itemsize <= 4 and dt != np.uint32:
+            return "int32"
+        return "int64?"  # needs a value-range check (fits-int32 proof)
+    if dt.kind == "f":
+        return "float32"
+    raise HostFallback(f"dtype {dt} has no device column form")
+
+
+def coerced_dtype(name: str, col: np.ndarray) -> np.dtype:
+    """Device dtype one host column will coerce to — CHECK only (dtype
+    kind + the int64 range proof), no copy; the astype itself runs at
+    materialization. Raises HostFallback when the host tier must serve."""
+    col = np.asarray(col)
+    kind = coerce_dtype(col.dtype)
+    if kind == "int64?":
+        info = np.iinfo(np.int32)
+        if len(col) and (col.min() < info.min or col.max() > info.max):
+            raise HostFallback(
+                f"column {name!r} holds int64 values beyond int32 range")
+        kind = "int32"
+    return np.dtype(kind)
+
+
+# ---------------------------------------------------------------------------
+# lazy device sources
+# ---------------------------------------------------------------------------
+# Imported lazily inside the factories: this module is imported by the
+# planner, and dense_rdd pulls in jax — keep that off the frame import
+# path until a device plan is actually built.
+
+
+def make_columns_source(ctx, data: Dict[str, np.ndarray],
+                        names: List[Tuple[str, str]]):
+    """Lazy dense source over in-memory columns. `names` maps
+    (frame_name, block_name); dtypes are validated eagerly (pure numpy —
+    the silent-fallback decision must happen at compile time), data is
+    sharded onto the mesh only at first materialization."""
+    from vega_tpu.tpu import mesh as mesh_lib
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    import jax.numpy as jnp
+
+    # Compile time pays only the dtype/range CHECK (the tier decision
+    # needs exactly that); the astype copies run at materialization, so
+    # explain() and plan construction stay O(metadata) and the closure
+    # pins no second copy of the data.
+    dtypes = {bn: coerced_dtype(fn, data[fn]) for fn, bn in names}
+    name_pairs = list(names)
+
+    class _ColumnsDenseSource(DenseRDD):
+        def _schema(self):
+            return tuple((bn, jnp.dtype(dtypes[bn]))
+                         for _fn, bn in name_pairs)
+
+        def _fp_extra(self):
+            return tuple((bn, str(dtypes[bn]), len(data[fn]))
+                         for fn, bn in name_pairs)
+
+        def _materialize(self):
+            from vega_tpu.tpu import block as block_lib
+
+            cols = {bn: np.asarray(data[fn]).astype(dtypes[bn],
+                                                    copy=False)
+                    for fn, bn in name_pairs}
+            return block_lib.from_numpy(cols, self.mesh,
+                                        wide_values=False)
+
+        def unpersist(self):
+            return self  # source: host copy IS the data; nothing to free
+
+    return _ColumnsDenseSource(ctx, mesh_lib.default_mesh())
+
+
+def make_parquet_source(ctx, path: str, columns: List[str],
+                        predicate, names: List[Tuple[str, str]],
+                        dtypes: Dict[str, np.dtype]):
+    """Lazy dense source over a parquet path with pruning + predicate
+    pushdown applied INSIDE the reader. Compile time touches metadata
+    only (schema, min/max statistics); the file is read at first
+    materialization."""
+    from vega_tpu.io.readers import (discover_parquet_files,
+                                     iter_parquet_batches,
+                                     parquet_column_minmax)
+    from vega_tpu.tpu import mesh as mesh_lib
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    import jax.numpy as jnp
+
+    out_dtypes = {}
+    for fn, bn in names:
+        kind = coerce_dtype(dtypes[fn])
+        if kind == "int64?":
+            mm = parquet_column_minmax(path, fn)
+            info = np.iinfo(np.int32)
+            if mm is None or mm[0] < info.min or mm[1] > info.max:
+                raise HostFallback(
+                    f"parquet column {fn!r} is int64 with no proof it "
+                    "fits int32 (missing stats or out of range)")
+            kind = "int32"
+        out_dtypes[bn] = np.dtype(kind)
+    files = discover_parquet_files(path)
+    name_pairs = list(names)
+
+    class _ParquetDenseSource(DenseRDD):
+        def _schema(self):
+            return tuple((bn, jnp.dtype(out_dtypes[bn]))
+                         for _fn, bn in name_pairs)
+
+        def _fp_extra(self):
+            return (path, tuple(columns), tuple(map(tuple, predicate)),
+                    tuple(sorted((bn, str(dt))
+                                 for bn, dt in out_dtypes.items())))
+
+        def _materialize(self):
+            from vega_tpu.tpu import block as block_lib
+
+            parts: Dict[str, list] = {fn: [] for fn, _bn in name_pairs}
+            for batch in iter_parquet_batches(files, columns, predicate):
+                for fn, _bn in name_pairs:
+                    parts[fn].append(batch[fn])
+            cols = {}
+            for fn, bn in name_pairs:
+                stacked = (np.concatenate(parts[fn]) if parts[fn]
+                           else np.empty((0,), dtypes[fn]))
+                cols[bn] = stacked.astype(out_dtypes[bn], copy=False)
+            return block_lib.from_numpy(cols, self.mesh, wide_values=False)
+
+        def unpersist(self):
+            return self  # re-read is the recompute; nothing cheaper to drop
+
+    return _ParquetDenseSource(ctx, mesh_lib.default_mesh())
+
+
+# ---------------------------------------------------------------------------
+# host-tier per-partition closures (picklable; cloudpickle ships them)
+# ---------------------------------------------------------------------------
+
+
+def host_block_stage(colmap: List[Tuple[str, str]], steps,
+                     emit: List[Tuple[str, object]]):
+    """Columnar host stage over one {name: np column} block: the same
+    project/filter step list the device stage fuses, evaluated with
+    numpy. Returns a new {out_name: column} block."""
+
+    def run(block: dict) -> dict:
+        env = {fn: block[bn] for fn, bn in colmap}
+        n = len(next(iter(env.values()))) if env else 0
+        for kind, payload in steps:
+            if kind == "project":
+                new_env = {}
+                for nm, e in payload:
+                    new_env[nm] = _host_broadcast(evaluate(e, env, host=True),
+                                                  n)
+                env = new_env
+            else:  # filter
+                keep = _host_broadcast(
+                    evaluate(payload, env, host=True), n)
+                keep = np.asarray(keep, dtype=bool)
+                env = {nm: c[keep] for nm, c in env.items()}
+                n = len(next(iter(env.values()))) if env else 0
+        return {bn: _host_broadcast(evaluate(e, env, host=True), n)
+                for bn, e in emit}
+
+    return run
+
+
+def _host_broadcast(v, n: int):
+    arr = np.asarray(v)
+    if arr.ndim == 0:
+        return np.full(n, arr[()])
+    return arr
+
+
+def host_block_to_pairs(key_name: str, specs: List[Tuple[str, object]],
+                        scalar: bool = False):
+    """Pivot a columnar block into (key, value) rows for the host
+    group-agg: specs are (alias, Expr) in output order; `scalar=True`
+    (single-aggregate plans) emits the bare value instead of a 1-tuple so
+    the shuffle can ride the native monoid merge — which is what lets the
+    push plan pre-merge it server-side. Keys become Python natives so
+    hashing/equality match the device collect's tolist view."""
+
+    def run(block: dict):
+        env = dict(block)
+        n = len(next(iter(env.values()))) if env else 0
+        keys = np.asarray(env[key_name])
+        vals = [_host_broadcast(evaluate(e, env, host=True), n)
+                for _alias, e in specs]
+        if scalar:
+            v0 = np.asarray(vals[0])
+            for i in range(n):
+                yield (_item(keys[i]), _item(v0[i]))
+            return
+        arrays = [np.asarray(v) for v in vals]
+        for i in range(n):
+            yield (_item(keys[i]), tuple(_item(a[i]) for a in arrays))
+
+    return run
+
+
+def _item(x):
+    """Element -> Python native; object-column elements (str, ...) pass
+    through — the documented host fallback must serve them, not crash."""
+    return x.item() if hasattr(x, "item") else x
+
+
+_HOST_OPS = {
+    "add": lambda a, b: a + b,
+    "min": min,
+    "max": max,
+}
+
+
+def host_tuple_combiner(ops: List[str]):
+    """Elementwise tuple monoid combine for the host reduce — the exact
+    host analogue of the device's named / traced-tuple segment reduce."""
+
+    def combine(a, b):
+        return tuple(_HOST_OPS[op](x, y) for op, x, y in zip(ops, a, b))
+
+    return combine
+
+
+def host_rows_stage(cols: List[str], steps,
+                    emit: List[Tuple[str, object]]):
+    """Rowwise host stage over (c0, c1, ...) tuples (the post-exchange
+    layout): evaluates the same expression trees per row."""
+
+    def run(row: tuple):
+        env = dict(zip(cols, row))
+        for kind, payload in steps:
+            if kind == "project":
+                env = {nm: evaluate(e, env, host=True)
+                       for nm, e in payload}
+            else:
+                raise AssertionError("row-layout filters lower via filter()")
+        return tuple(_native(evaluate(e, env, host=True))
+                     for _nm, e in emit)
+
+    return run
+
+
+def host_rows_filter(cols: List[str], predicate):
+    def run(row: tuple) -> bool:
+        env = dict(zip(cols, row))
+        return bool(evaluate(predicate, env, host=True))
+
+    return run
+
+
+def _native(v):
+    arr = np.asarray(v)
+    if arr.ndim == 0:
+        return arr[()].item() if hasattr(arr[()], "item") else arr[()]
+    return v
+
+
+def host_rows_to_pairs(cols: List[str], key_name: str,
+                       specs: List[Tuple[str, object]],
+                       scalar: bool = False):
+    """Rowwise pivot to (key, value[-tuple]) for a group-agg over the
+    post-exchange row layout (scalar: see host_block_to_pairs)."""
+
+    def run(row: tuple):
+        env = dict(zip(cols, row))
+        k = _native(env[key_name])
+        if scalar:
+            return (k, _native(evaluate(specs[0][1], env, host=True)))
+        return (k, tuple(_native(evaluate(e, env, host=True))
+                         for _alias, e in specs))
+
+    return run
+
+
+def host_pair_to_row():
+    """(k, v) -> (k, v) row tuple (scalar single-aggregate finalize)."""
+
+    def run(pair):
+        return (pair[0], pair[1])
+
+    return run
+
+
+def host_finalize_slots(slots: List[tuple]):
+    """(key, value-tuple) -> row. slots: ('v', i) picks vals[i];
+    ('mean', i, j) emits vals[i] / vals[j]."""
+
+    def run(pair):
+        k, vals = pair
+        out = [k]
+        for slot in slots:
+            if slot[0] == "v":
+                out.append(vals[slot[1]])
+            else:
+                out.append(vals[slot[1]] / vals[slot[2]])
+        return tuple(out)
+
+    return run
+
+
+def host_block_rows(cols: List[str]):
+    """Columnar block -> row tuples (cols order), Python natives."""
+
+    def run(block: dict):
+        arrays = [np.asarray(block[c]) for c in cols]
+        n = len(arrays[0]) if arrays else 0
+        for i in range(n):
+            yield tuple(_item(a[i]) for a in arrays)
+
+    return run
+
+
+def host_block_len(block: dict) -> int:
+    """Row count of one columnar block — count() ships this instead of
+    the blocks themselves."""
+    return len(next(iter(block.values()))) if block else 0
+
+
+def host_row_to_pair(idx: int):
+    """Row tuple -> (key, rest-tuple) keyed on column index `idx`."""
+
+    def run(row: tuple):
+        return (row[idx], row[:idx] + row[idx + 1:])
+
+    return run
+
+
+def host_join_rows():
+    """(k, (lrest, rrest)) -> (k, *lrest, *rrest)."""
+
+    def run(pair):
+        k, (lrest, rrest) = pair
+        return (k,) + tuple(lrest) + tuple(rrest)
+
+    return run
+
+
+def host_left_join_emit(r_arity: int, fill_value):
+    """Cogroup groups -> left-outer rows with an explicit fill (matching
+    the device kernel's fill_value semantics, so results do not depend on
+    which tier ran)."""
+
+    def run(pair):
+        k, (lvs, rvs) = pair
+        if not rvs:
+            fill = (fill_value,) * r_arity
+            return [(k,) + tuple(lv) + fill for lv in lvs]
+        return [(k,) + tuple(lv) + tuple(rv) for lv in lvs for rv in rvs]
+
+    return run

@@ -1,0 +1,576 @@
+"""Frame logical -> physical compiler.
+
+Two lowerings of the SAME optimized logical plan:
+
+* **Device tier** — scans become lazy columnar DenseRDD sources (pruned
+  columns + pushed predicates reach the parquet reader, so unneeded data
+  never leaves the file); every maximal run of select/filter/with_column
+  steps fuses into ONE `dense_pipeline` node, i.e. one traced SPMD shard
+  program per stage; groupBy/agg lowers onto the named-op segment reduce
+  (uniform monoid) or a generated traced TUPLE combiner (mixed monoids) —
+  monoid selection is by aggregate NAME, never value probing; join/sort
+  lower onto the device exchange kernels, with the per-exchange plugin
+  (`exchange=all_to_all|ring`) chosen by a size heuristic or the frame's
+  `hint()`.
+* **Host tier** — the identical verbs over ordinary RDD lineages
+  (columnar blocks until the first exchange, row tuples after), produced
+  whenever the device trace rejects an expression (opaque Python UDFs,
+  non-device dtypes) or a verb shape the kernels cannot take. The switch
+  is SILENT — same results, different placement — preserving the
+  two-tier contract. Only `tier="device"` (explicit) turns a fallback
+  into an error.
+
+Compilation is pure plan algebra + metadata reads + abstract tracing
+(`jax.eval_shape`): no partition is computed, no block materialized, no
+device transfer issued until an action runs (api.py). VG013 machine-
+checks that property for every module in this package except api.py."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from vega_tpu.errors import VegaError
+from vega_tpu.frame import logical as L
+from vega_tpu.frame import physical as P
+from vega_tpu.frame.expr import _AGG_MONOID, Col, Expr, Lit
+from vega_tpu.frame.physical import HostFallback
+
+DEFAULT_OPTIONS = {
+    "fuse": True,        # whole-stage fusion (False: one program per verb)
+    "pushdown": True,    # column pruning + predicate pushdown into scans
+    "tier": "auto",      # auto | device | host
+    "exchange": None,    # device exchange plugin override (all_to_all|ring)
+    "shuffle_plan": None,  # host-tier distributed shuffle plan (pull|push)
+}
+
+# Ring exchange bounds peak HBM (tpu/ring.py); prefer it once a single
+# exchange's resident working set is a meaningful slice of the budget.
+_RING_FRACTION = 0.25
+
+
+class Compiled:
+    """Physical plan handle: a lazy RDD lineage plus the metadata the
+    action surface (api.py) needs to extract frame-shaped results."""
+
+    def __init__(self, kind: str, rdd, cols: List[str],
+                 out: List[Tuple[str, str]], layout: str,
+                 limit: Optional[int], plan, notes: List[str]):
+        self.kind = kind          # "device" | "host"
+        self.rdd = rdd
+        self.cols = cols          # frame output columns, in order
+        self.out = out            # device: (frame_name, block_name)
+        self.layout = layout      # device: "block"; host: "blocks"|"rows"
+        self.limit = limit
+        self.plan = plan
+        self.notes = notes
+
+    def explain(self) -> str:
+        head = f"== physical: {self.kind} tier =="
+        body = L.explain_tree(self.plan)
+        notes = "".join(f"\n-- {n}" for n in self.notes)
+        lim = f"\n-- limit {self.limit}" if self.limit is not None else ""
+        return f"{head}\n{body}{notes}{lim}"
+
+
+def compile_plan(ctx, plan: L.LogicalPlan, options: dict) -> Compiled:
+    options = {**DEFAULT_OPTIONS, **(options or {})}
+    limit = None
+    while isinstance(plan, L.Limit):
+        limit = plan.n if limit is None else min(limit, plan.n)
+        plan = plan.child
+    pushdown = bool(options["pushdown"])
+    opt = L.optimize(plan, pushdown=pushdown) if pushdown else plan
+    tier = options["tier"]
+    notes: List[str] = []
+    if tier != "host":
+        try:
+            return _compile_device(ctx, opt, options, limit, notes)
+        except HostFallback as e:
+            if tier == "device":
+                raise VegaError(
+                    f"tier='device' requested but the plan has no device "
+                    f"lowering: {e}") from e
+            notes.append(f"host tier: {e}")
+    else:
+        notes.append("host tier: requested via hint")
+    return _compile_host(ctx, opt, options, limit, notes)
+
+
+# ---------------------------------------------------------------------------
+# shared lowering helpers
+# ---------------------------------------------------------------------------
+
+
+def _sanitize(name: str, taken: set) -> str:
+    """Frame name -> block column name: the canonical key name and the
+    wide-int64 low-word suffix are reserved by the block layout."""
+    bn = name
+    if bn == "k" or bn.endswith(".lo") or not bn:
+        bn = "c_" + bn.replace(".", "_")
+    while bn in taken:
+        bn += "_"
+    taken.add(bn)
+    return bn
+
+
+def _agg_specs(node: L.GroupAgg):
+    """Normalize aggregates to (block_name, input Expr, monoid) triples
+    plus finalize slots: count -> sum of ones, mean -> (sum, count) pair
+    divided after the exchange. Monoids come from the aggregate NAME
+    (sound by construction — CLAUDE.md bans value probing)."""
+    taken = {"k"}
+    specs: List[tuple] = []   # (block_name, Expr, monoid)
+    slots: List[tuple] = []   # ('v', i) | ('mean', i_sum, i_count)
+    for a in node.aggs:
+        if a.op == "count":
+            specs.append((_sanitize(a.alias, taken), Lit(1), "add"))
+            slots.append(("v", len(specs) - 1))
+        elif a.op == "mean":
+            specs.append((_sanitize(a.alias, taken), a.expr, "add"))
+            i_sum = len(specs) - 1
+            specs.append((_sanitize(a.alias + "__n", taken), Lit(1), "add"))
+            slots.append(("mean", i_sum, len(specs) - 1))
+        else:
+            specs.append((_sanitize(a.alias, taken), a.expr,
+                          _AGG_MONOID[a.op]))
+            slots.append(("v", len(specs) - 1))
+    return specs, slots
+
+
+# ---------------------------------------------------------------------------
+# device lowering
+# ---------------------------------------------------------------------------
+
+
+class _DState:
+    """Device lowering cursor: the dense node built so far, the frame->
+    block column mapping, and the pending (not yet flushed) narrow steps
+    of the current stage."""
+
+    def __init__(self, node, colmap: List[Tuple[str, str]]):
+        self.node = node
+        self.colmap = list(colmap)
+        self.steps: List[tuple] = []
+        self.est_rows: Optional[int] = None  # source row estimate
+
+
+def _step_token(step) -> tuple:
+    kind, payload = step
+    if kind == "project":
+        return ("project", tuple((nm, e.token()) for nm, e in payload))
+    return ("filter", payload.token())
+
+
+def _dev_broadcast(v, cap, jnp):
+    arr = jnp.asarray(v)
+    if arr.ndim == 0:
+        return jnp.broadcast_to(arr, (cap,))
+    return arr
+
+
+def _flush(st: _DState, out_pairs: List[Tuple[str, Expr]], fused: bool):
+    """Compile the pending stage + final projection into ONE dense
+    pipeline node (or prove it identity and skip). Raises HostFallback
+    when the stage does not trace."""
+    import jax
+    import jax.numpy as jnp
+
+    from vega_tpu.tpu import dense_rdd as dr
+    from vega_tpu.tpu import kernels
+
+    node = st.node
+    in_schema = tuple(node._schema())
+    in_names = [nm for nm, _ in in_schema]
+    colmap = list(st.colmap)
+    steps = list(st.steps)
+    out_names = [bn for bn, _e in out_pairs]
+    if not steps:
+        ident = dict(colmap)
+        if out_names == in_names and all(
+                isinstance(e, Col) and ident.get(e.name) == bn
+                for bn, e in out_pairs):
+            return node  # pure passthrough: nothing to compile
+    from vega_tpu.frame.expr import evaluate
+
+    def cols_fn(cols, count):
+        cap = cols[in_names[0]].shape[0]
+        env = {fn: cols[bn] for fn, bn in colmap}
+        for kind, payload in steps:
+            if kind == "project":
+                env = {nm: _dev_broadcast(evaluate(e, env), cap, jnp)
+                       for nm, e in payload}
+            else:  # filter
+                keep = _dev_broadcast(evaluate(payload, env), cap, jnp)
+                keep = keep.astype(jnp.bool_) \
+                    & kernels.valid_mask(cap, count)
+                env, count = kernels.compact(env, keep, cap)
+        out = {bn: _dev_broadcast(evaluate(e, env), cap, jnp)
+               for bn, e in out_pairs}
+        return out, count
+
+    try:
+        structs = [jax.ShapeDtypeStruct((8,), dt) for _nm, dt in in_schema]
+        count_s = jax.ShapeDtypeStruct((), jnp.int32)
+
+        def wrap(count, *arrays):
+            out, c = cols_fn(dict(zip(in_names, arrays)), count)
+            return tuple(out[bn] for bn in out_names) + (c,)
+
+        shapes = jax.eval_shape(wrap, count_s, *structs)
+    except HostFallback:
+        raise
+    except Exception as e:  # noqa: BLE001 — any trace failure: host tier
+        raise HostFallback(f"stage does not trace: {e}") from e
+    out_schema = tuple(
+        (bn, s.dtype) for bn, s in zip(out_names, shapes))
+    token = ("frame_stage", tuple(colmap),
+             tuple(_step_token(s) for s in steps),
+             tuple((bn, e.token()) for bn, e in out_pairs))
+    return dr.dense_pipeline(node, cols_fn, out_schema, token, fused=fused)
+
+
+def _key_dtype(node, allowed) -> None:
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dict(node._schema())["k"])
+    if dt not in tuple(jnp.dtype(a) for a in allowed):
+        raise HostFallback(
+            f"device exchange key must be {allowed}, got {dt}")
+
+
+def _pick_exchange(ctx, options: dict, st: _DState, width: int,
+                   notes: List[str]) -> Optional[str]:
+    """Per-exchange plugin policy: an explicit hint wins; otherwise prefer
+    the ring exchange (bounded peak HBM) when the estimated working set is
+    a large slice of the budget — decided from source metadata, never by
+    materializing."""
+    if options["exchange"] is not None:
+        return options["exchange"]
+    if st.est_rows is None:
+        return None
+    from vega_tpu.env import Env
+
+    budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
+    est = st.est_rows * 4 * max(width, 1)
+    if est * 6 > _RING_FRACTION * budget:  # ~6x exchange footprint
+        notes.append(f"exchange=ring (est {est >> 20} MiB working set)")
+        return "ring"
+    return None
+
+
+def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
+                  notes: List[str]) -> _DState:
+    fused = bool(options["fuse"])
+    if isinstance(plan, L.ColumnsScan):
+        taken: set = set()
+        names = [(fn, _sanitize(fn, taken)) for fn in plan.data]
+        node = P.make_columns_source(ctx, plan.data, names)
+        st = _DState(node, names)
+        st.est_rows = len(next(iter(plan.data.values()))) if plan.data \
+            else 0
+        return st
+    if isinstance(plan, L.ParquetScan):
+        from vega_tpu.io.readers import parquet_schema
+
+        cols = plan.columns()
+        dtypes = parquet_schema(plan.path)
+        missing = [c for c in cols if c not in dtypes]
+        if missing:
+            raise VegaError(
+                f"unknown column(s) {missing} — parquet file "
+                f"{plan.path!r} has {sorted(dtypes)}")
+        taken = set()
+        names = [(fn, _sanitize(fn, taken)) for fn in cols]
+        node = P.make_parquet_source(ctx, plan.path, cols, plan.predicate,
+                                     names, dtypes)
+        st = _DState(node, names)
+        try:
+            from vega_tpu.io.readers import parquet_num_rows
+
+            st.est_rows = parquet_num_rows(plan.path)
+        except Exception:  # noqa: BLE001 — estimate only
+            st.est_rows = None
+        return st
+    if isinstance(plan, L.Project):
+        st = _lower_device(ctx, plan.child, options, notes)
+        st.steps.append(("project", list(plan.outputs)))
+        if not fused:
+            st = _unfused_break(st, plan.columns(), options)
+        return st
+    if isinstance(plan, L.Filter):
+        st = _lower_device(ctx, plan.child, options, notes)
+        st.steps.append(("filter", plan.predicate))
+        if not fused:
+            st = _unfused_break(st, plan.columns(), options)
+        return st
+    if isinstance(plan, L.GroupAgg):
+        st = _lower_device(ctx, plan.child, options, notes)
+        specs, slots = _agg_specs(plan)
+        out_pairs = [("k", Col(plan.key))] + [(bn, e)
+                                              for bn, e, _m in specs]
+        staged = _flush(st, out_pairs, fused)
+        _key_dtype(staged, ("int32",))
+        ops = [m for _bn, _e, m in specs]
+        exchange = _pick_exchange(ctx, options, st, len(specs) + 1, notes)
+        if len(set(ops)) == 1:
+            red = staged.reduce_by_key(op=ops[0], exchange=exchange)
+            notes.append(f"groupBy: named-op '{ops[0]}' segment reduce")
+        else:
+            red = staged.reduce_by_key(func=_traced_tuple_combiner(ops),
+                                       exchange=exchange)
+            notes.append(
+                f"groupBy: traced tuple combiner over {ops}")
+        out = _DState(red, [(plan.key, "k")] + [
+            (bn, bn) for bn, _e, _m in specs])
+        out.est_rows = st.est_rows
+        # Mean finalization (and companion drop) rides the NEXT stage.
+        proj = [(plan.key, Col(plan.key))]
+        for a, slot in zip(plan.aggs, slots):
+            if slot[0] == "mean":
+                proj.append((a.alias, Col(specs[slot[1]][0])
+                             / Col(specs[slot[2]][0])))
+            else:
+                proj.append((a.alias, Col(specs[slot[1]][0])))
+        if any(s[0] == "mean" for s in slots) or any(
+                a.alias != specs[s[1]][0]
+                for a, s in zip(plan.aggs, slots)):
+            out.steps.append(("project", proj))
+            if not fused:
+                out = _unfused_break(out, plan.columns(), options)
+        return out
+    if isinstance(plan, L.Join):
+        lst = _lower_device(ctx, plan.left, options, notes)
+        rst = _lower_device(ctx, plan.right, options, notes)
+        lvals = [c for c in plan.left.columns() if c != plan.on]
+        rvals = [c for c in plan.right.columns() if c != plan.on]
+        if len(lvals) != 1 or len(rvals) != 1:
+            raise HostFallback(
+                "device join needs exactly one value column per side "
+                f"(have {lvals} x {rvals}); host tier joins the rest")
+        lnode = _flush(lst, [("k", Col(plan.on)), ("v", Col(lvals[0]))],
+                       bool(options["fuse"]))
+        rnode = _flush(rst, [("k", Col(plan.on)), ("v", Col(rvals[0]))],
+                       bool(options["fuse"]))
+        _key_dtype(lnode, ("int32",))
+        _key_dtype(rnode, ("int32",))
+        exchange = _pick_exchange(ctx, options, lst, 2, notes)
+        if plan.how == "inner":
+            joined = lnode.join(rnode, exchange=exchange)
+        else:
+            joined = lnode.left_outer_join(
+                rnode, fill_value=plan.fill_value, exchange=exchange)
+        from vega_tpu.tpu.dense_rdd import DenseRDD
+
+        if not isinstance(joined, DenseRDD):
+            raise HostFallback("join degraded to the host path")
+        notes.append(f"join: device sort-merge ({plan.how})")
+        out = _DState(joined, [(plan.on, "k"), (lvals[0], "lv"),
+                               (rvals[0], "rv")])
+        out.est_rows = lst.est_rows
+        return out
+    if isinstance(plan, L.Sort):
+        st = _lower_device(ctx, plan.child, options, notes)
+        others = [c for c in plan.columns() if c != plan.by]
+        taken = {"k"}
+        pairs = [("k", Col(plan.by))] + [
+            (_sanitize(c, taken), Col(c)) for c in others]
+        node = _flush(st, pairs, bool(options["fuse"]))
+        _key_dtype(node, ("int32", "float32"))
+        exchange = _pick_exchange(ctx, options, st, len(pairs), notes)
+        sorted_node = node.sort_by_key(ascending=plan.ascending,
+                                       exchange=exchange)
+        notes.append("sort: device sample-sort exchange")
+        out = _DState(sorted_node, [(plan.by, "k")] + list(
+            zip(others, [bn for bn, _e in pairs[1:]])))
+        out.est_rows = st.est_rows
+        return out
+    raise HostFallback(f"no device lowering for {type(plan).__name__}")
+
+
+def _unfused_break(st: _DState, cols: List[str], options: dict) -> _DState:
+    """fuse=False: compile the pending step(s) as their own one-node
+    program (chain-broken), so every verb pays its own launch — the
+    fusion A/B's control leg."""
+    taken: set = set()
+    pairs = [(_sanitize(c, taken), Col(c)) for c in cols]
+    node = _flush(st, pairs, fused=False)
+    out = _DState(node, list(zip(cols, [bn for bn, _e in pairs])))
+    out.est_rows = st.est_rows
+    return out
+
+
+def _traced_tuple_combiner(ops: List[str]):
+    """Elementwise monoid combine over the value-column tuple, built from
+    jnp primitives so the device reduce traces it — the mixed-op agg path
+    (e.g. sum(x), min(y) in one exchange)."""
+    import jax.numpy as jnp
+
+    fns = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+    picked = [fns[op] for op in ops]
+    if len(picked) == 1:
+        f0 = picked[0]
+        return lambda a, b: f0(a, b)
+
+    def combine(a, b):
+        return tuple(f(x, y) for f, x, y in zip(picked, a, b))
+
+    return combine
+
+
+def _compile_device(ctx, plan: L.LogicalPlan, options: dict,
+                    limit: Optional[int], notes: List[str]) -> Compiled:
+    st = _lower_device(ctx, plan, options, notes)
+    cols = plan.columns()
+    if st.steps:
+        taken: set = set()
+        pairs = [(_sanitize(c, taken), Col(c)) for c in cols]
+        node = _flush(st, pairs, bool(options["fuse"]))
+        out = list(zip(cols, [bn for bn, _e in pairs]))
+    else:
+        node = st.node
+        cm = dict(st.colmap)
+        out = [(c, cm[c]) for c in cols]
+    return Compiled("device", node, cols, out, "block", limit, plan, notes)
+
+
+# ---------------------------------------------------------------------------
+# host lowering
+# ---------------------------------------------------------------------------
+
+
+class _HState:
+    def __init__(self, rdd, layout: str, cols: List[str]):
+        self.rdd = rdd
+        self.layout = layout  # "blocks" | "rows"
+        self.cols = list(cols)
+        self.steps: List[tuple] = []  # pending, blocks layout only
+
+
+def _host_flush_blocks(st: _HState) -> _HState:
+    if not st.steps:
+        return st
+    emit = [(c, Col(c)) for c in st.cols]
+    fn = P.host_block_stage([(c, c) for c in st.input_cols], st.steps, emit)
+    out = _HState(st.rdd.map(fn), "blocks", st.cols)
+    out.input_cols = st.cols
+    return out
+
+
+def _host_state(rdd, layout, cols) -> _HState:
+    st = _HState(rdd, layout, cols)
+    st.input_cols = list(cols)
+    return st
+
+
+def _host_to_rows(st: _HState) -> _HState:
+    st = _host_flush_blocks(st)
+    if st.layout == "rows":
+        return st
+    return _host_state(st.rdd.flat_map(P.host_block_rows(st.cols)),
+                       "rows", st.cols)
+
+
+def _lower_host(ctx, plan: L.LogicalPlan, options: dict) -> _HState:
+    if isinstance(plan, L.ColumnsScan):
+        data = {nm: np.asarray(c) for nm, c in plan.data.items()}
+        cols = list(data)
+        n = len(data[cols[0]]) if cols else 0
+        parts = plan.num_partitions or ctx.default_parallelism
+        per = -(-n // parts) if n else 1
+        chunks = [{nm: c[i * per:(i + 1) * per] for nm, c in data.items()}
+                  for i in range(max(1, -(-n // per) if n else 1))]
+        return _host_state(ctx.parallelize(chunks, len(chunks)),
+                           "blocks", cols)
+    if isinstance(plan, L.ParquetScan):
+        from vega_tpu.io.readers import ParquetColumnReader
+
+        cols = plan.columns()
+        reader = ParquetColumnReader(
+            plan.path,
+            columns=None if plan.columns_kept is None else cols,
+            predicate=plan.predicate,
+            num_partitions=plan.num_partitions or ctx.default_parallelism)
+        return _host_state(ctx.read_source(reader), "blocks", cols)
+    if isinstance(plan, L.Project):
+        st = _lower_host(ctx, plan.child, options)
+        if st.layout == "blocks":
+            st.steps.append(("project", list(plan.outputs)))
+            st.cols = plan.columns()
+            return st
+        fn = P.host_rows_stage(st.cols, [],
+                               [(nm, e) for nm, e in plan.outputs])
+        return _host_state(st.rdd.map(fn), "rows", plan.columns())
+    if isinstance(plan, L.Filter):
+        st = _lower_host(ctx, plan.child, options)
+        if st.layout == "blocks":
+            st.steps.append(("filter", plan.predicate))
+            return st
+        return _host_state(
+            st.rdd.filter(P.host_rows_filter(st.cols, plan.predicate)),
+            "rows", st.cols)
+    if isinstance(plan, L.GroupAgg):
+        import operator
+
+        st = _lower_host(ctx, plan.child, options)
+        specs, slots = _agg_specs(plan)
+        spec_pairs = [(bn, e) for bn, e, _m in specs]
+        ops = [m for _bn, _e, m in specs]
+        # Single-aggregate plans shuffle BARE scalars with the canonical
+        # monoid callable: _infer_named_op tags the Aggregator, the C++
+        # bucket combine kicks in, and — the planner picking shuffle
+        # policy per exchange — the push plan (shuffle_plan=push) can
+        # pre-merge it server-side, which tuple-valued combines cannot.
+        scalar = len(specs) == 1 and ops[0] in ("add", "min", "max")
+        if st.layout == "blocks":
+            st = _host_flush_blocks(st)
+            pairs = st.rdd.flat_map(
+                P.host_block_to_pairs(plan.key, spec_pairs, scalar=scalar))
+        else:
+            pairs = st.rdd.map(
+                P.host_rows_to_pairs(st.cols, plan.key, spec_pairs,
+                                     scalar=scalar))
+        if scalar:
+            monoid = {"add": operator.add, "min": min, "max": max}[ops[0]]
+            rows = pairs.reduce_by_key(monoid).map(P.host_pair_to_row())
+        else:
+            reduced = pairs.reduce_by_key(P.host_tuple_combiner(ops))
+            rows = reduced.map(P.host_finalize_slots(slots))
+        return _host_state(rows, "rows", plan.columns())
+    if isinstance(plan, L.Join):
+        lst = _host_to_rows(_lower_host(ctx, plan.left, options))
+        rst = _host_to_rows(_lower_host(ctx, plan.right, options))
+        li = lst.cols.index(plan.on)
+        ri = rst.cols.index(plan.on)
+        lp = lst.rdd.map(P.host_row_to_pair(li))
+        rp = rst.rdd.map(P.host_row_to_pair(ri))
+        if plan.how == "inner":
+            rows = lp.join(rp).map(P.host_join_rows())
+        else:
+            r_arity = len(rst.cols) - 1
+            rows = lp.cogroup(rp).flat_map(
+                P.host_left_join_emit(r_arity, plan.fill_value))
+        return _host_state(rows, "rows", plan.columns())
+    if isinstance(plan, L.Sort):
+        st = _host_to_rows(_lower_host(ctx, plan.child, options))
+        idx = st.cols.index(plan.by)
+        rows = st.rdd.sort_by(_row_key(idx), ascending=plan.ascending)
+        return _host_state(rows, "rows", st.cols)
+    raise VegaError(f"no host lowering for {type(plan).__name__}")
+
+
+def _row_key(idx: int):
+    def key(row):
+        return row[idx]
+
+    return key
+
+
+def _compile_host(ctx, plan: L.LogicalPlan, options: dict,
+                  limit: Optional[int], notes: List[str]) -> Compiled:
+    st = _lower_host(ctx, plan, options)
+    st = _host_flush_blocks(st)
+    cols = plan.columns()
+    return Compiled("host", st.rdd, cols, [(c, c) for c in cols],
+                    st.layout, limit, plan, notes)

@@ -1,4 +1,5 @@
 from vega_tpu.io.readers import (
+    ParquetColumnReader,
     ParquetReaderConfig,
     TextFileReaderConfig,
     WholeFileReaderConfig,
@@ -7,6 +8,7 @@ from vega_tpu.io.readers import (
 
 __all__ = [
     "LocalFsReaderConfig",
+    "ParquetColumnReader",
     "ParquetReaderConfig",
     "TextFileReaderConfig",
     "WholeFileReaderConfig",

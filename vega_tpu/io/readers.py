@@ -139,40 +139,230 @@ class TextFileReaderConfig(LocalFsReaderConfig):
         return _FileListRDD(ctx, groups, read_group, self.host)
 
 
-class ParquetReaderConfig:
+# Predicate-pushdown conjunct operators (ParquetColumnReader.predicate):
+# each conjunct is a (column, op, literal) triple. Row groups whose
+# min/max statistics cannot satisfy a conjunct are skipped whole; rows
+# surviving the row-group pass are mask-filtered per batch — either way
+# the pruned rows never leave the reader.
+_PRED_OPS = {
+    "==": lambda c, v: c == v,
+    "!=": lambda c, v: c != v,
+    "<": lambda c, v: c < v,
+    "<=": lambda c, v: c <= v,
+    ">": lambda c, v: c > v,
+    ">=": lambda c, v: c >= v,
+}
+
+
+def discover_parquet_files(path: str) -> List[str]:
+    """Parquet file discovery with a crisp contract: expanding a directory
+    or glob keeps only .parquet/.pq files and REFUSES loudly when none
+    match (feeding an arbitrary matched file to pyarrow produces an
+    undecipherable downstream stack trace); a single explicitly-named
+    existing file is taken as-is (explicit path == user intent, whatever
+    the extension)."""
+    from vega_tpu.errors import VegaError
+
+    files = _discover(path)
+    if not files:
+        raise VegaError(
+            f"parquet read: path {path!r} matches no files"
+        )
+    if len(files) == 1 and files[0] == path and os.path.isfile(path):
+        return files
+    matched = [f for f in files if f.endswith((".parquet", ".pq"))]
+    if not matched:
+        raise VegaError(
+            f"parquet read: no .parquet/.pq files under {path!r} — the "
+            f"{len(files)} file(s) found there (e.g. "
+            f"{os.path.basename(files[0])!r}) are not parquet; pass the "
+            "file explicitly if the extension is just unconventional"
+        )
+    return matched
+
+
+def _row_group_may_match(meta_rg, col_index: dict, predicate) -> bool:
+    """False only when the row group's column statistics PROVE no row can
+    satisfy the conjunct — missing/partial statistics keep the group."""
+    for name, op, lit in predicate:
+        idx = col_index.get(name)
+        if idx is None:
+            continue
+        col = meta_rg.column(idx)
+        stats = col.statistics
+        if stats is None or not stats.has_min_max:
+            continue
+        lo, hi = stats.min, stats.max
+        try:
+            if op == "==" and (lit < lo or lit > hi):
+                return False
+            if op == "<" and lo >= lit:
+                return False
+            if op == "<=" and lo > lit:
+                return False
+            if op == ">" and hi <= lit:
+                return False
+            if op == ">=" and hi < lit:
+                return False
+        except TypeError:
+            continue  # incomparable stats (e.g. bytes vs int): keep
+    return True
+
+
+def iter_parquet_batches(paths: List[str], columns: Optional[List[str]],
+                         predicate=None, batch_rows: int = 1 << 20):
+    """Yield {name: numpy column} dicts with column pruning AND predicate
+    pushdown applied inside the reader. Columns the query never names and
+    rows no conjunct can accept never leave the file layer."""
+    import numpy as np
+    import pyarrow.parquet as pq
+
+    predicate = list(predicate or ())
+    # Predicate columns must be read to evaluate the mask even when the
+    # query output prunes them; they are dropped again after filtering.
+    read_cols = columns
+    if columns is not None and predicate:
+        extra = [nm for nm, _op, _v in predicate if nm not in columns]
+        read_cols = list(columns) + sorted(set(extra))
+    for path in paths:
+        pf = pq.ParquetFile(path)
+        names = pf.schema_arrow.names
+        col_index = {nm: i for i, nm in enumerate(names)}
+        if predicate:
+            groups = [g for g in range(pf.metadata.num_row_groups)
+                      if _row_group_may_match(pf.metadata.row_group(g),
+                                              col_index, predicate)]
+            if not groups:
+                continue
+        else:
+            groups = None  # all
+        for batch in pf.iter_batches(batch_size=batch_rows,
+                                     columns=read_cols, row_groups=groups):
+            block = {
+                name: batch.column(i).to_numpy(zero_copy_only=False)
+                for i, name in enumerate(batch.schema.names)
+            }
+            if predicate:
+                mask = None
+                for nm, op, lit in predicate:
+                    m = _PRED_OPS[op](block[nm], lit)
+                    mask = m if mask is None else (mask & m)
+                if mask is not None and not np.all(mask):
+                    block = {nm: c[mask] for nm, c in block.items()}
+            if columns is not None:
+                block = {nm: block[nm] for nm in columns}
+            yield block
+
+
+# Parquet METADATA cache, keyed on (abspath, mtime_ns, size): one frame
+# compile consults schema, row counts and column statistics several times
+# (entry-point schema, planner schema, size estimate, int32-fit proofs —
+# and again on every action, since frames recompile per action), and each
+# consult used to re-open the file's footer. One footer read per file
+# version serves them all. Bounded: pruned crudely once it grows past
+# _META_CACHE_MAX (fixture churn in tests).
+_META_CACHE: dict = {}
+_META_CACHE_MAX = 1024
+
+
+def _file_meta(path: str) -> dict:
+    import os as _os
+
+    import pyarrow.parquet as pq
+
+    st = _os.stat(path)
+    key = (_os.path.abspath(path), st.st_mtime_ns, st.st_size)
+    meta = _META_CACHE.get(key)
+    if meta is not None:
+        return meta
+    pf = pq.ParquetFile(path)
+    m = pf.metadata
+    idx = {m.schema.column(i).name: i for i in range(m.num_columns)}
+    minmax = {}
+    for name, i in idx.items():
+        lo = hi = None
+        complete = True
+        for g in range(m.num_row_groups):
+            stats = m.row_group(g).column(i).statistics
+            if stats is None or not stats.has_min_max:
+                complete = False
+                break
+            try:
+                lo = stats.min if lo is None else min(lo, stats.min)
+                hi = stats.max if hi is None else max(hi, stats.max)
+            except TypeError:  # incomparable stats values
+                complete = False
+                break
+        minmax[name] = (lo, hi) if complete and lo is not None else None
+    meta = {
+        "schema": {f.name: f.type.to_pandas_dtype()
+                   for f in pf.schema_arrow},
+        "num_rows": m.num_rows,
+        "minmax": minmax,
+    }
+    if len(_META_CACHE) >= _META_CACHE_MAX:
+        _META_CACHE.clear()
+    _META_CACHE[key] = meta
+    return meta
+
+
+def parquet_schema(path: str) -> dict:
+    """{column: numpy dtype} from file metadata only (no data read) — the
+    frame planner's schema source."""
+    return dict(_file_meta(discover_parquet_files(path)[0])["schema"])
+
+
+def parquet_num_rows(path: str) -> int:
+    """Total rows across the path's files, from metadata only (the frame
+    planner's exchange-sizing estimate)."""
+    return sum(_file_meta(f)["num_rows"]
+               for f in discover_parquet_files(path))
+
+
+def parquet_column_minmax(path: str, column: str):
+    """(min, max) over every row group's statistics, or None when any
+    group lacks them. Metadata only — lets the frame planner prove an
+    int64 column fits int32 without touching data."""
+    lo = hi = None
+    for f in discover_parquet_files(path):
+        mm = _file_meta(f)["minmax"].get(column)
+        if mm is None:
+            return None
+        lo = mm[0] if lo is None else min(lo, mm[0])
+        hi = mm[1] if hi is None else max(hi, mm[1])
+    return None if lo is None else (lo, hi)
+
+
+class ParquetColumnReader:
     """Columnar parquet ingest (reference: examples/parquet_column_read.rs).
 
-    Yields one pyarrow RecordBatch-derived dict of numpy column arrays per row
-    group — the exact block format the device tier consumes, so
-    parquet -> TPU needs no row pivot."""
+    Yields one pyarrow RecordBatch-derived dict of numpy column arrays per
+    batch — the exact block format the device tier consumes, so
+    parquet -> TPU needs no row pivot. `columns` prunes at the file layer;
+    `predicate` ([(column, op, literal), ...] conjuncts, op in
+    ==/!=/</<=/>/>=) skips row groups via statistics and mask-filters the
+    survivors — the frame planner's pushdown hooks."""
 
     def __init__(self, path: str, columns: Optional[List[str]] = None,
                  num_partitions: int = 4, batch_rows: int = 1 << 20,
-                 host: Optional[str] = None):
+                 host: Optional[str] = None, predicate=None):
         self.path = path
         self.columns = columns
         self.num_partitions = num_partitions
         self.batch_rows = batch_rows
         self.host = host
+        self.predicate = list(predicate or ())
 
     def make_reader(self, ctx) -> RDD:
-        files = _discover(self.path)
-        files = [f for f in files if f.endswith((".parquet", ".pq"))] or files
+        files = discover_parquet_files(self.path)
         groups = assign_files_to_partitions(files, self.num_partitions)
         columns = self.columns
         batch_rows = self.batch_rows
+        predicate = self.predicate
 
         def read_group(paths: List[str]):
-            import pyarrow.parquet as pq
-
-            for path in paths:
-                pf = pq.ParquetFile(path)
-                for batch in pf.iter_batches(batch_size=batch_rows,
-                                             columns=columns):
-                    yield {
-                        name: batch.column(i).to_numpy(zero_copy_only=False)
-                        for i, name in enumerate(batch.schema.names)
-                    }
+            yield from iter_parquet_batches(paths, columns, predicate,
+                                            batch_rows)
 
         return _FileListRDD(ctx, groups, read_group, self.host)
 
@@ -189,3 +379,7 @@ class ParquetReaderConfig:
                 yield tuple(c[i] for c in cols)
 
         return block_rdd.flat_map(to_rows)
+
+
+# Historical name (pre-frame API); same class, kept for callers and docs.
+ParquetReaderConfig = ParquetColumnReader

@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG012: the project invariants as AST checks.
+"""vegalint rules VG001–VG013: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -9,7 +9,9 @@ complement (vega_tpu/lint/sync_witness.py) covers what lexical analysis
 cannot see at runtime.
 
 VG001–VG008 are the per-file (and lock-graph) invariants from PRs 3 and
-7. VG009–VG012 are the cross-process CONTRACT rules: a shared per-file
+7; VG013 (PR 11) keeps frame planning pure — no materialization at
+plan-build time. VG009–VG012 are the cross-process CONTRACT rules: a
+shared per-file
 index pass (``_contract_extract``, cached by the engine) reduces each
 file to its protocol/config/event surfaces, and global combines join
 the index — every sent msg_type has a dispatch arm and vice versa
@@ -1301,3 +1303,54 @@ def vg012(ctx: FileCtx) -> Iterator[Finding]:
                 "Future.result() without timeout on a cross-process "
                 "path — a dead or wedged peer strands this thread; pass "
                 "timeout= and handle the expiry")
+
+
+# ---------------------------------------------------------------------------
+# VG013 — frame planning must stay pure/lazy
+# ---------------------------------------------------------------------------
+# The frame subsystem's contract (same spirit as VG004's pure property
+# reads): compiling a logical plan builds LINEAGE — it must never compute
+# a partition, materialize a device block, or issue a device transfer.
+# Every materializing entry point lives in vega_tpu/frame/api.py (the
+# action surface); anywhere else in vega_tpu/frame/, a call to the
+# materializing surface is a plan-build-time side effect — explain() or a
+# mere DataFrame construction would launch device work at unpredictable
+# times, and pushdown decisions would silently become value probing.
+
+_VG013_BANNED_CALLS = {
+    "collect", "collect_arrays", "collect_columns", "collect_grouped",
+    "compute", "iterator", "block", "block_spec", "to_numpy", "host_get",
+    "device_get", "device_put", "run_job", "submit_job",
+    # The RDD actions: `if node.count() > t:` at plan-build time IS the
+    # value-probing class this rule exists for.
+    "count", "take", "reduce",
+}
+# counts_np only: it is unique to Block (a device counts fetch), while
+# e.g. "num_rows" also names innocent pyarrow metadata — conservative by
+# design (a crying-wolf rule gets pragma'd into silence).
+_VG013_BANNED_ATTRS = {"counts_np"}
+
+
+@rule("VG013", "materializing call at frame plan-build time")
+def vg013(ctx: FileCtx) -> Iterator[Finding]:
+    if not ctx.in_dir("vega_tpu", "frame") or ctx.endswith("frame/api.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = _last_name(node.func)
+            if name in _VG013_BANNED_CALLS:
+                yield Finding(
+                    "VG013", ctx.display, node.lineno, node.col_offset + 1,
+                    f"'{name}()' inside frame planning code — plan "
+                    "compilation must stay pure/lazy (no partition "
+                    "compute, no device block reads); materializing "
+                    "actions belong in vega_tpu/frame/api.py "
+                    "(docs/LINTING.md VG013)")
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in _VG013_BANNED_ATTRS \
+                and isinstance(node.ctx, ast.Load):
+            yield Finding(
+                "VG013", ctx.display, node.lineno, node.col_offset + 1,
+                f"'.{node.attr}' read inside frame planning code — that "
+                "is a device materialization/transfer; planning must stay "
+                "pure (docs/LINTING.md VG013)")

@@ -93,6 +93,15 @@ def _shard_program(mesh, fn, in_specs, out_specs):
 # portability story (SURVEY.md §2.1): here the *fingerprint* of the traced
 # function is the identity, and XLA's own jit cache handles shape changes.
 _PROGRAM_CACHE: dict = {}
+# Programs minted (built, not served from the cache) since process start.
+# The frame planner's whole-stage-fusion acceptance test reads this to
+# prove a select->filter->with_column chain compiled to ONE program.
+_PROGRAM_MINTS: int = 0
+
+
+def program_mints() -> int:
+    """Count of shard programs BUILT so far (cache hits excluded)."""
+    return _PROGRAM_MINTS
 
 
 def _fp(obj) -> str:
@@ -108,10 +117,12 @@ def _fp(obj) -> str:
 
 
 def _cached_program(key, build):
+    global _PROGRAM_MINTS
     prog = _PROGRAM_CACHE.get(key)
     if prog is None:
         prog = build()
         _PROGRAM_CACHE[key] = prog
+        _PROGRAM_MINTS += 1
     return prog
 
 
@@ -1887,31 +1898,38 @@ class _NarrowRDD(DenseRDD):
         # the two sites cannot disagree about what a chain is).
         chain, root = _narrow_chain(self)
         chain = _detached_chain(chain)  # cached program must not pin nodes
-        root_block = root.block()
-        names = list(root_block.cols)
-        out_names = [n for n, _ in self._out_schema]
-        cap = root_block.capacity
+        return _run_narrow_chain(self.mesh, chain, root.block(),
+                                 self._out_schema)
 
-        def fused(counts, *col_arrays):
-            cols = dict(zip(names, col_arrays))
-            cols, count = _apply_chain(chain, cols, counts[0])
-            return (count.reshape(1),) + tuple(cols[n] for n in out_names)
 
-        key = ("narrow", self.mesh, tuple(names), tuple(out_names),
-               _chain_fp(chain))
-        prog = _cached_program(
-            key,
-            lambda: _shard_program(
-                self.mesh, fused, 1 + len(names),
-                (_SPEC,) * (1 + len(out_names)),
-            ),
-        )
-        out = prog(root_block.counts, *[root_block.cols[n] for n in names])
-        counts, col_arrays = out[0], out[1:]
-        return Block(
-            cols=dict(zip(out_names, col_arrays)),
-            counts=counts, capacity=cap, mesh=self.mesh,
-        )
+def _run_narrow_chain(mesh, chain, root_block: Block, out_schema) -> Block:
+    """Compile+launch ONE shard program applying a (detached) narrow
+    chain over a materialized root block — the shared materializer behind
+    _NarrowRDD._materialize and the frame A/B's chain-broken unfused
+    nodes (one program-cache key scheme, one Block contract)."""
+    names = list(root_block.cols)
+    out_names = [n for n, _ in out_schema]
+    cap = root_block.capacity
+
+    def fused(counts, *col_arrays):
+        cols = dict(zip(names, col_arrays))
+        cols, count = _apply_chain(chain, cols, counts[0])
+        return (count.reshape(1),) + tuple(cols[n] for n in out_names)
+
+    key = ("narrow", mesh, tuple(names), tuple(out_names),
+           _chain_fp(chain))
+    prog = _cached_program(
+        key,
+        lambda: _shard_program(
+            mesh, fused, 1 + len(names),
+            (_SPEC,) * (1 + len(out_names)),
+        ),
+    )
+    out = prog(root_block.counts, *[root_block.cols[n] for n in names])
+    return Block(
+        cols=dict(zip(out_names, out[1:])),
+        counts=out[0], capacity=cap, mesh=mesh,
+    )
 
 
 class _MapRDD(_NarrowRDD):
@@ -2380,6 +2398,45 @@ class _ProjectRDD(_NarrowRDD):
 
     def _shard_fn(self, cols, count):
         return {VALUE: cols[self._col]}, count
+
+
+class _ColsPipelineRDD(_NarrowRDD):
+    """Multi-op traced closure entry: ONE narrow node applying an arbitrary
+    columnwise (cols, count) -> (cols, count) pipeline with a declared
+    output schema and a stable fingerprint token. The frame planner
+    (vega_tpu/frame) lowers a whole select/filter/with_column stage onto a
+    single instance, so the stage compiles to exactly one shard program —
+    and still rides the existing chain fusion when stacked on other narrow
+    nodes. `fused=False` breaks the chain: the node materializes through
+    its OWN single-step program (the frame A/B's unfused leg)."""
+
+    def __init__(self, parent: DenseRDD, cols_fn, out_schema, token,
+                 fused: bool = True):
+        super().__init__(parent, out_schema)
+        self._cols_fn = cols_fn
+        self._user_fn = token  # _node_fp pickles this, not the closure
+        if not fused:
+            self._chainable = False
+
+    def _shard_fn(self, cols, count):
+        return self._cols_fn(cols, count)
+
+    def _materialize(self) -> Block:
+        if self._chainable:
+            return _NarrowRDD._materialize(self)
+        # Unfused: a one-node chain over the materialized parent — its own
+        # program launch and its own intermediate block, deliberately (the
+        # fusion A/B's control leg must pay per-op launches).
+        return _run_narrow_chain(self.mesh, _detached_chain([self]),
+                                 self.parent.block(), self._out_schema)
+
+
+def dense_pipeline(parent: DenseRDD, cols_fn, out_schema, token,
+                   fused: bool = True) -> DenseRDD:
+    """Public factory for _ColsPipelineRDD (the frame planner's whole-stage
+    entry). `out_schema` is ((name, dtype), ...); `token` must be a stable
+    picklable description of the pipeline (it keys the program cache)."""
+    return _ColsPipelineRDD(parent, cols_fn, out_schema, token, fused=fused)
 
 
 # ---------------------------------------------------------------------------
