@@ -1,0 +1,202 @@
+"""A/B: static max-size fleet vs elastic autoscaled fleet (PR 12).
+
+The serving-plane question: a multi-tenant driver sized for PEAK load
+burns executors through every idle trough, and one sized for the trough
+queues unboundedly at every burst. The elastic controller
+(scheduler/elastic.py) should buy most of the static fleet's burst
+latency at a fraction of its executor-seconds.
+
+Harness: a BURSTY workload — per burst, short narrow jobs (sleep-bound
+tasks, so they parallelize honestly on this 1-core sandbox) are
+STREAMED onto the job server at a fixed arrival rate that oversubscribes
+the minimum fleet but not the maximum one; bursts are separated by idle
+troughs. Two legs, fresh fleets each (a Context is a process singleton),
+interleaved per repetition, medians of 3:
+
+  * static  — num_executors = MAX, elastic off: the peak-sized fleet.
+  * elastic — num_executors = MIN, elastic on (min=MIN, max=MAX): the
+    fleet must GROW into each burst (spawn latency charged to the leg)
+    and drain back through each trough (decommission charged too).
+
+Measured per leg:
+  * short_p50_s       — median submit->settle latency over every job of
+                        every burst (the tenant-visible number)
+  * executor_seconds  — fleet-size integral over the leg's whole
+                        measured window, troughs included (the cost;
+                        the controller tracks it for both legs)
+  * fleet_peak / fleet_trough — live executors seen at burst peak and
+                        trough floor (elastic leg shape proof)
+
+Acceptance (ride the output fields):
+  * exec_seconds_bounded — elastic executor_seconds <= 0.7x static
+  * p50_bounded          — elastic short_p50 <= 1.3x static
+  * results_ok           — every job returned its exact count (asserted
+                           every rep, both legs)
+
+Prints ONE JSON line. Usage:
+
+  python benchmarks/elastic_ab.py [jobs_per_burst] [task_sleep_s]
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Importing vega_tpu must never probe a (possibly wedged) TPU backend:
+# force the CPU mesh first, like every benchmark here.
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+REPS = 3
+BURSTS = 3
+MIN_EXECUTORS = 1
+MAX_EXECUTORS = 3
+NUM_WORKERS = 2          # task slots per executor
+TASKS_PER_JOB = 4
+# Burst shape: the arrival rate oversubscribes the MIN fleet (4 slow
+# tasks every 300ms > 2 slots' throughput — the scale-up trigger) but
+# leaves the MAX fleet headroom, and each burst streams long enough
+# (jobs_per_burst * gap >> ramp latency) that the MEDIAN job runs after
+# the ramp — p50 then measures steady-state serving, p90 the ramp tax.
+ARRIVAL_GAP_S = 0.3
+# Troughs must be long enough for the drain ladder (one decommission per
+# held decision interval) to actually reach the floor — a trough shorter
+# than ~2 drain cycles measures ramp-down latency, not the idle cost the
+# elastic plane exists to shed.
+TROUGH_S = 8.0
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+def _one_leg(elastic: bool, jobs_per_burst: int, task_sleep_s: float):
+    """Fresh fleet, full burst/trough choreography, per-job latencies +
+    executor-seconds over the leg window."""
+    import vega_tpu as v
+
+    kw = dict(num_workers=NUM_WORKERS)
+    if elastic:
+        kw.update(num_executors=MIN_EXECUTORS, elastic_enabled=True,
+                  elastic_min_executors=MIN_EXECUTORS,
+                  elastic_max_executors=MAX_EXECUTORS,
+                  elastic_decision_interval_s=0.2,
+                  elastic_scale_up_threshold=1.0,
+                  elastic_scale_down_threshold=0.3,
+                  decommission_timeout_s=5.0)
+    else:
+        kw.update(num_executors=MAX_EXECUTORS)
+    ctx = v.Context("distributed", **kw)
+    try:
+        # Warm the dispatch/serialization paths off the clock.
+        assert ctx.parallelize(list(range(4)), 4).count() == 4
+
+        def short_job():
+            def slow(x, _s=task_sleep_s):
+                time.sleep(_s)
+                return x
+
+            rdd = ctx.parallelize(list(range(TASKS_PER_JOB)),
+                                  TASKS_PER_JOB).map(slow)
+            return ctx.submit_job(rdd, lambda tc, it: sum(1 for _ in it),
+                                  transform=sum)
+
+        latencies = []
+        peaks = []
+        troughs = []
+        es0 = ctx.elastic.executor_seconds()
+        t_leg0 = time.monotonic()
+        for _burst in range(BURSTS):
+            inflight = []
+            for _ in range(jobs_per_burst):
+                t0 = time.monotonic()
+                inflight.append((t0, short_job()))
+                time.sleep(ARRIVAL_GAP_S)
+            for t0, future in inflight:
+                got = future.result(60.0)
+                assert got == TASKS_PER_JOB, f"job returned {got}"
+                latencies.append(time.monotonic() - t0)
+            peaks.append(ctx.elastic.status()["live_executors"])
+            # Idle trough: the elastic leg should drain toward MIN here
+            # (decommissions included in its executor-seconds).
+            time.sleep(TROUGH_S)
+            troughs.append(ctx.elastic.status()["live_executors"])
+        exec_seconds = ctx.elastic.executor_seconds() - es0
+        wall = time.monotonic() - t_leg0
+        summary = ctx.metrics_summary()
+        return {
+            "p50_s": median(latencies),
+            "p90_s": sorted(latencies)[int(0.9 * (len(latencies) - 1))],
+            "executor_seconds": exec_seconds,
+            "wall_s": wall,
+            "fleet_peak": max(peaks),
+            "fleet_trough": min(troughs),
+            "scale_ups": summary["elastic"]["executors_added"],
+            "scale_downs": summary["elastic"]["executors_decommissioned"],
+        }
+    finally:
+        ctx.stop()
+
+
+def run_legs(jobs_per_burst: int = 20, task_sleep_s: float = 0.25):
+    legs = {"static": False, "elastic": True}
+    samples = {leg: [] for leg in legs}
+    for _rep in range(REPS):
+        for leg, elastic in legs.items():
+            samples[leg].append(_one_leg(elastic, jobs_per_burst,
+                                         task_sleep_s))
+
+    def med(leg, key):
+        return median([s[key] for s in samples[leg]])
+
+    static_p50 = med("static", "p50_s")
+    elastic_p50 = med("elastic", "p50_s")
+    static_es = med("static", "executor_seconds")
+    elastic_es = med("elastic", "executor_seconds")
+    last = {leg: samples[leg][-1] for leg in legs}
+    return {
+        "metric": "bursty multi-tenant serving: static max-size fleet vs "
+                  "elastic autoscaled fleet — short-job p50 latency and "
+                  "executor-seconds consumed (troughs included); fresh "
+                  f"fleets per leg, legs interleaved, medians of {REPS}",
+        "bursts": BURSTS, "jobs_per_burst": jobs_per_burst,
+        "tasks_per_job": TASKS_PER_JOB, "task_sleep_s": task_sleep_s,
+        "arrival_gap_s": ARRIVAL_GAP_S, "trough_s": TROUGH_S,
+        "fleet": {"min": MIN_EXECUTORS, "max": MAX_EXECUTORS,
+                  "num_workers": NUM_WORKERS},
+        "short_p50_s": {"static": round(static_p50, 6),
+                        "elastic": round(elastic_p50, 6)},
+        "short_p90_s": {"static": round(med("static", "p90_s"), 6),
+                        "elastic": round(med("elastic", "p90_s"), 6)},
+        "executor_seconds": {"static": round(static_es, 3),
+                             "elastic": round(elastic_es, 3)},
+        "exec_seconds_vs_static": round(elastic_es / static_es, 3)
+        if static_es else None,
+        "p50_vs_static": round(elastic_p50 / static_p50, 3)
+        if static_p50 else None,
+        "fleet_shape_last_rep": {
+            leg: {"peak": last[leg]["fleet_peak"],
+                  "trough": last[leg]["fleet_trough"],
+                  "scale_ups": last[leg]["scale_ups"],
+                  "scale_downs": last[leg]["scale_downs"]}
+            for leg in legs},
+        "results_ok": True,  # every job's count asserted, every rep
+        "exec_seconds_bounded": bool(
+            static_es and elastic_es <= 0.7 * static_es),
+        "p50_bounded": bool(static_p50
+                            and elastic_p50 <= 1.3 * static_p50),
+    }
+
+
+def main():
+    force_cpu_mesh(8)
+    jobs = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    sleep_s = float(sys.argv[2]) if len(sys.argv) > 2 else 0.25
+    print(json.dumps(run_legs(jobs, sleep_s)))
+
+
+if __name__ == "__main__":
+    main()

@@ -367,6 +367,41 @@ def config10_frame(ctx, scale=1.0, bank=None):
     return rows, out["unfused_s"], out["fused_s"]
 
 
+def config11_elastic(ctx, scale=1.0, bank=None):
+    """PR 12 elastic serving plane: bursty short-job stream on a static
+    max-size fleet vs an elastic min->max autoscaled fleet
+    (benchmarks/elastic_ab.py: interleaved legs, medians of 3, per-job
+    counts asserted by the A/B itself). Runs in a SUBPROCESS — the A/B
+    spawns its own fresh fleets per leg and the Env is a process
+    singleton. Reported through the standard columns: host_s = static
+    short-job p50, device_s = elastic short-job p50, so device_vs_host
+    reads as the latency COST of elasticity (want ~1.0x or better); the
+    real win — executor-seconds — rides the emitted A/B line's
+    exec_seconds_vs_static (accept <= 0.7). Host-plane scheduling work —
+    no device leg, excluded from the TPU-window default config set
+    (tpu_jobs/11 runs the standalone A/B instead)."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jobs = max(8, int(20 * scale))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks", "elastic_ab.py"),
+         str(jobs)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"elastic_ab failed: {proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["results_ok"], "elastic legs returned wrong job results"
+    assert out["exec_seconds_bounded"], (
+        "elastic fleet burned more than 0.7x the static fleet's "
+        f"executor-seconds: {out['executor_seconds']}")
+    if bank:
+        bank(jobs * out["bursts"], out["short_p50_s"]["elastic"])
+    return (jobs * out["bursts"], out["short_p50_s"]["static"],
+            out["short_p50_s"]["elastic"])
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -382,6 +417,8 @@ CONFIGS = {
         config9_locality),
     10: ("DataFrame fused+pushdown vs unfused (parquet analytics query)",
          config10_frame),
+    11: ("elastic fleet vs static max fleet (bursty short-job p50 + "
+         "executor-seconds)", config11_elastic),
 }
 
 

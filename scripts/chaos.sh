@@ -26,3 +26,10 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/shuffle_plan_ab.py
 # legs) ride the "owned_rtts_zero" / "e2e_improved" / "bit_identical"
 # fields.
 timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/locality_ab.py
+
+# Elastic serving plane A/B (PR 12): bursty short-job stream on a static
+# max-size fleet vs an elastic autoscaled fleet. One JSON line; the
+# acceptance bounds (elastic executor-seconds <= 0.7x static with
+# short-job p50 <= 1.3x, every job's result asserted) ride the
+# "exec_seconds_bounded" / "p50_bounded" / "results_ok" fields.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/elastic_ab.py

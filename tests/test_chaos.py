@@ -815,6 +815,115 @@ def test_push_plan_server_drop_mid_push_recovers(monkeypatch, tmp_path):
         ctx.stop()
 
 
+# -------------------------------------------------------------- PR 12:
+# elastic decommission chaos — graceful scale-down must be LOSS-FREE.
+
+
+def test_scale_down_mid_job_loss_free_with_replication(monkeypatch):
+    """Acceptance (PR 12): a job running ACROSS a graceful scale-down is
+    bit-identical with zero FetchFailed when shuffle_replication>=2 —
+    the victim's map outputs are already replica-covered, so the
+    decommission drops the leaving location and reducers read the
+    surviving copies: no stage resubmission, no mid-stream failover, no
+    recompute."""
+    monkeypatch.setenv("VEGA_TPU_FAULT_SLOW_TASKS", "4")
+    monkeypatch.setenv("VEGA_TPU_FAULT_SLOW_TASK_S", "0.4")
+    faults.reset()
+    ctx = _chaos_context(shuffle_replication=2, decommission_timeout_s=8.0)
+    try:
+        # Async job: slow map tasks (the chaos straggler injection slows
+        # the first 4 across the fleet) give the decommission a live job
+        # to cross.
+        pairs = ctx.parallelize([(i % 5, i) for i in range(200)], 8)
+        future = pairs.reduce_by_key(lambda a, b: a + b, 4) \
+            .collect_async()
+        time.sleep(0.3)  # let map tasks land on both executors
+        result = ctx.elastic.decommission("exec-0", reason="chaos")
+        assert not result["forced"], "graceful drain should not escalate"
+        got = sorted(future.result(30.0))
+        expected = sorted(
+            {k: sum(i for i in range(200) if i % 5 == k)
+             for k in range(5)}.items())
+        assert got == expected  # bit-identical across the scale-down
+        summary = ctx.metrics_summary()
+        # Loss-free: no FetchFailed escalation ever fired — no stage was
+        # resubmitted, no map output recomputed, and the victim was never
+        # declared lost. (A reducer caught mid-stream by the final reap
+        # may ride the replica-failover ladder; that is the replication
+        # plane absorbing the handoff, not a loss.)
+        assert summary["stages_resubmitted"] == 0
+        assert summary["executors_lost"] == 0
+        assert summary["elastic"]["executors_decommissioned"] == 1
+        assert summary["elastic"]["recomputed_outputs"] == 0
+        # A fresh job on the shrunken fleet still works.
+        assert _reduce_job(ctx) == _expected_reduce()
+    finally:
+        ctx.stop()
+
+
+def test_unreplicated_scale_down_migrates_bucket_rows():
+    """Unreplicated outputs (shuffle_replication=1) survive a graceful
+    decommission by MIGRATION: the victim's sole-copy bucket rows are
+    re-pushed to the surviving peer, the tracker/stages rebind, and a
+    re-read of the same shuffle is bit-identical with zero resubmission
+    and zero recompute."""
+    ctx = _chaos_context(decommission_timeout_s=8.0)
+    try:
+        pairs = ctx.parallelize([(i % 4, i) for i in range(120)], 4)
+        shuffled = pairs.reduce_by_key(lambda a, b: a + b, 4)
+        expected = dict(shuffled.collect())
+        result = ctx.elastic.decommission("exec-0", reason="chaos")
+        assert not result["forced"]
+        # This fleet spread 4 map tasks over 2 executors: exec-0 held
+        # some sole-copy rows, and every one of them moved.
+        assert result["migrated_outputs"] >= 1
+        assert result["migrated_bytes"] > 0
+        assert result["recomputed_outputs"] == 0
+        assert dict(shuffled.collect()) == expected  # served, not recomputed
+        summary = ctx.metrics_summary()
+        assert summary["stages_resubmitted"] == 0
+        assert summary["executors_lost"] == 0
+    finally:
+        ctx.stop()
+
+
+def test_decommission_hang_escalates_to_executor_lost(monkeypatch,
+                                                      tmp_path):
+    """Chaos: VEGA_TPU_FAULT_DECOMMISSION_HANG_S wedges the victim
+    mid-drain past decommission_timeout_s — the drain must escalate to
+    the PR 2 executor-lost path (ExecutorLost, outputs unregistered)
+    instead of hanging the controller, and with shuffle_replication=2
+    the job data still survives on the peer's replicas."""
+    stats_dir = str(tmp_path / "stats")
+    monkeypatch.setenv("VEGA_TPU_FAULT_DECOMMISSION_HANG_S", "30")
+    monkeypatch.setenv("VEGA_TPU_FAULT_EXECUTOR", "exec-0")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", stats_dir)
+    faults.reset()
+    ctx = _chaos_context(shuffle_replication=2,
+                         decommission_timeout_s=1.0)
+    try:
+        pairs = ctx.parallelize([(i % 5, i) for i in range(100)], 4)
+        shuffled = pairs.reduce_by_key(lambda a, b: a + b, 4)
+        expected = dict(shuffled.collect())
+        t0 = time.time()
+        result = ctx.elastic.decommission("exec-0", reason="chaos")
+        assert result["forced"], "the wedged drain should have escalated"
+        assert time.time() - t0 < 15.0, "escalation must not wait out the hang"
+        hangs = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "decommission_hang"]
+        assert hangs, "the injected drain wedge never fired"
+        summary = ctx.metrics_summary()
+        assert summary["executors_lost"] >= 1  # the PR 2 path ran
+        assert summary["elastic"]["decommissions_forced"] == 1
+        # Replicas keep the shuffle whole through the forced loss.
+        assert dict(shuffled.collect()) == expected
+        assert "exec-0" not in ctx._backend._executors  # reaped, not respawned
+        time.sleep(1.0)
+        assert "exec-0" not in ctx._backend._executors
+    finally:
+        ctx.stop()
+
+
 def test_locality_preferred_executor_killed_midstream(monkeypatch):
     """PR 10 satellite: kill the executor holding a cached RDD's
     partitions, then re-run the job. The ExecutorLost scrub must drop

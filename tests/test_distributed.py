@@ -357,6 +357,14 @@ def test_replicated_fetch_fails_over_after_executor_kill(monkeypatch,
         kills = [s for s in faults.read_stats(stats_dir)
                  if s["fault"] == "kill_worker"]
         assert kills, "the injected SIGKILL never fired"
+        # The loss declaration is the REAPER's (0.3s sweep): a kill that
+        # lands near the end of the map stage can finish the job before
+        # the next sweep tick, so poll briefly instead of reading once.
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if ctx.metrics_summary()["executors_lost"] >= 1:
+                break
+            time.sleep(0.2)
         summary = ctx.metrics_summary()
         assert summary["executors_lost"] >= 1
         # THE claim: the loss was absorbed by replicas — no map stage was
@@ -440,6 +448,60 @@ def test_push_plan_reduce_tasks_land_on_premerge_owner():
         # counted the process-tier reduce dispatches.
         hist = ctx.metrics_summary()["locality"]
         assert hist["process"] >= len(matched)
+    finally:
+        ctx.stop()
+
+
+def test_elastic_scale_up_mid_job_and_results_match():
+    """Elastic serving plane (PR 12): a 1-executor fleet under a burst of
+    slow tasks scales itself up mid-job — ExecutorAdded fires, the NEW
+    executors actually receive tasks (TaskEnd executor ids beyond the
+    initial fleet), and the result is identical to a static 3-executor
+    run of the same job."""
+    from vega_tpu.scheduler import events as ev
+
+    def burst_job(ctx):
+        def slow(x):
+            time.sleep(0.25)
+            return x * 3 + 1
+
+        return sorted(ctx.parallelize(list(range(24)), 24)
+                      .map(slow).collect())
+
+    _retire_active_context()
+    ctx = v.Context("distributed", num_workers=2, num_executors=3)
+    try:
+        expected = burst_job(ctx)  # static max-size fleet, same job
+    finally:
+        ctx.stop()
+
+    ctx = v.Context(
+        "distributed", num_workers=2, num_executors=1,
+        elastic_enabled=True, elastic_min_executors=1,
+        elastic_max_executors=3, elastic_decision_interval_s=0.25,
+        elastic_scale_up_threshold=1.0, elastic_scale_down_threshold=0.0,
+    )
+    try:
+        ends = []
+
+        class _Cap(ev.Listener):
+            def on_event(self, event):
+                if isinstance(event, ev.TaskEnd) and event.success:
+                    ends.append(event)
+
+        ctx.bus.add_listener(_Cap())
+        assert burst_job(ctx) == expected  # identical to the static run
+        ctx.bus.flush()
+        summary = ctx.metrics_summary()
+        assert summary["elastic"]["executors_added"] >= 1, \
+            "the burst never triggered a scale-up"
+        executors = {e.executor for e in ends}
+        grown = executors - {"exec-0"}
+        assert grown, (
+            f"no task ever landed on a scaled-up executor: {executors}")
+        status = ctx.fleet_status()
+        assert status["elastic"]["enabled"] and \
+            status["elastic"]["live_executors"] >= 2
     finally:
         ctx.stop()
 

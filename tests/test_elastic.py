@@ -1,0 +1,215 @@
+"""Elastic serving plane: admission control + blacklist decay units.
+
+Admission (scheduler/jobserver.py): per-pool bounded in-flight jobs at
+the submit_job front door — typed JobRejectedError under
+admission_mode=reject, blocking backpressure under admission_mode=block.
+These run in LOCAL mode: admission is pure driver-side policy.
+
+Blacklist decay (distributed/backend.py): consecutive dispatch-failure
+counts age out after blacklist_decay_s so a recovered-but-once-flaky
+executor rejoins rotation. Exercised against a real 2-executor fleet's
+picker (no jobs needed — the decision function is the unit).
+
+The distributed scale-up-mid-job test lives in test_distributed.py; the
+decommission chaos ladder in test_chaos.py.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+import vega_tpu as v
+from vega_tpu.errors import JobRejectedError
+
+
+def _retire_active_context():
+    prev = v.Context.active()
+    if prev is not None:
+        prev.stop()
+
+
+@pytest.fixture()
+def local_ctx(request):
+    _retire_active_context()
+    ctx = v.Context("local", **getattr(request, "param", {}))
+    yield ctx
+    ctx.stop()
+
+
+def _hold_job(ctx, release: threading.Event, partitions=4):
+    """A job whose tasks park until `release` fires."""
+    def holdup(x):
+        release.wait(15.0)
+        return x
+
+    return ctx.submit_job(ctx.parallelize(range(partitions), partitions)
+                          .map(holdup), lambda tc, it: sum(it))
+
+
+@pytest.mark.parametrize("local_ctx", [dict(pool_max_queued=1)],
+                         indirect=True)
+def test_pool_bounded_rejection_typed_and_bounded(local_ctx):
+    """A pool at its bound rejects with the typed error, the in-flight
+    count never exceeds the bound, and the slot frees on settle."""
+    ctx = local_ctx
+    release = threading.Event()
+    f1 = _hold_job(ctx, release)
+    try:
+        with pytest.raises(JobRejectedError) as excinfo:
+            ctx.submit_job(ctx.parallelize(range(2), 2),
+                           lambda tc, it: sum(it))
+        assert excinfo.value.pool == "default"
+        assert excinfo.value.bound == 1
+        status = ctx.fleet_status()["admission"]
+        assert status["mode"] == "reject"
+        assert status["pools"]["default"]["in_flight"] == 1  # never above
+    finally:
+        release.set()
+    assert sum(f1.result(10.0)) == sum(range(4))
+    # The settle released the admission slot: the next job admits.
+    f3 = ctx.submit_job(ctx.parallelize(range(3), 3),
+                        lambda tc, it: sum(it))
+    assert sum(f3.result(10.0)) == sum(range(3))
+    assert ctx.metrics_summary()["jobs_rejected"] == 1
+
+
+# num_workers=8: the held jobs must not also exhaust the 1-core local
+# backend's task slots, or the admitted job starves on CAPACITY (the
+# arbiter's concern) rather than admission (this test's concern).
+@pytest.mark.parametrize("local_ctx",
+                         [dict(pool_max_queued=2, num_workers=8)],
+                         indirect=True)
+def test_bounds_are_per_pool(local_ctx):
+    """One full pool must not block another pool's admission, and a
+    set_pool(max_queued=) override beats the Configuration default."""
+    ctx = local_ctx
+    ctx.set_pool("tight", weight=1, max_queued=1)
+    release = threading.Event()
+    ctx.set_local_property("pool", "tight")
+    f1 = _hold_job(ctx, release)
+    try:
+        with pytest.raises(JobRejectedError):
+            _hold_job(ctx, release)  # tight is full at its OVERRIDE bound
+        ctx.set_local_property("pool", None)
+        # default pool (bound 2) still admits
+        f2 = ctx.submit_job(ctx.parallelize(range(2), 2),
+                            lambda tc, it: sum(it))
+        assert sum(f2.result(10.0)) == 1
+    finally:
+        ctx.set_local_property("pool", None)
+        release.set()
+    assert f1.result(10.0)
+
+
+@pytest.mark.parametrize(
+    "local_ctx", [dict(pool_max_queued=1, admission_mode="block")],
+    indirect=True)
+def test_admission_block_backpressure_unblocks_on_drain(local_ctx):
+    """admission_mode=block parks the submitter instead of raising; the
+    park ends when a job of the pool settles (drain)."""
+    ctx = local_ctx
+    release = threading.Event()
+    f1 = _hold_job(ctx, release)
+    admitted_at = {}
+    done = threading.Event()
+
+    def submitter():
+        f2 = ctx.submit_job(ctx.parallelize(range(3), 3),
+                            lambda tc, it: sum(it))
+        admitted_at["t"] = time.monotonic()
+        admitted_at["result"] = sum(f2.result(10.0))
+        done.set()
+
+    t = threading.Thread(target=submitter, daemon=True)
+    t.start()
+    time.sleep(0.8)
+    assert not done.is_set(), "blocked submit returned while pool full"
+    t_release = time.monotonic()
+    release.set()  # drain: f1 settles, admission slot frees
+    assert done.is_set() or done.wait(10.0)
+    assert admitted_at["result"] == sum(range(3))
+    assert admitted_at["t"] >= t_release
+    assert sum(f1.result(10.0)) == sum(range(4))
+    assert ctx.metrics_summary()["jobs_rejected"] == 0  # block != reject
+
+
+def test_unbounded_by_default(local_ctx):
+    """pool_max_queued=0 (the default) keeps the legacy unbounded
+    admission: many concurrent jobs all admit."""
+    ctx = local_ctx
+    release = threading.Event()
+    futures = [_hold_job(ctx, release, partitions=2) for _ in range(6)]
+    status = ctx.fleet_status()["admission"]
+    assert status["pools"]["default"]["in_flight"] == 6
+    assert status["pools"]["default"]["max_queued"] is None
+    release.set()
+    assert all(f.result(10.0) is not None for f in futures)
+
+
+# --------------------------------------------------------------------------
+# Blacklist decay (distributed backend picker unit)
+
+
+def _task_stub():
+    return types.SimpleNamespace(speculative=False,
+                                 exclude_executors=frozenset(),
+                                 preferred_locs=())
+
+
+def test_blacklist_decays_and_clears_on_decommission():
+    """A blacklisted executor (consecutive dispatch failures at the
+    threshold) is skipped by the picker while fresh, rejoins rotation
+    once its last failure is older than blacklist_decay_s, and a
+    decommissioned slot's advisory state dies with the slot."""
+    _retire_active_context()
+    ctx = v.Context("distributed", num_workers=1, num_executors=2,
+                    blacklist_decay_s=0.5, locality_wait_s=0.0,
+                    decommission_timeout_s=5.0)
+    try:
+        backend = ctx._backend
+        flaky = backend._executors["exec-0"]
+        threshold = ctx.conf.executor_blacklist_threshold
+        flaky.failures = threshold
+        flaky.last_failure_at = time.time()
+        picks = {backend._pick_executor(_task_stub()).executor_id
+                 for _ in range(8)}
+        assert picks == {"exec-1"}, "fresh blacklist must deprioritize"
+        # Age the failure count past the decay window: forgiven.
+        flaky.last_failure_at = time.time() - 1.0
+        picks = {backend._pick_executor(_task_stub()).executor_id
+                 for _ in range(8)}
+        assert picks == {"exec-0", "exec-1"}, \
+            "decayed blacklist must rejoin rotation"
+        assert flaky.failures == 0  # forgiven lazily at pick time
+        # Decommission clears the slot's advisory state entirely: the
+        # known-hash set and the _Executor (with its counters) go away.
+        backend._known_hashes.setdefault("exec-0", set()).add("sha")
+        ctx.elastic.decommission("exec-0", reason="test")
+        assert "exec-0" not in backend._executors
+        assert "exec-0" not in backend._known_hashes
+        assert "exec-0" not in backend.service.workers
+        # The survivor still serves jobs.
+        assert ctx.parallelize(list(range(10)), 2).count() == 10
+    finally:
+        ctx.stop()
+
+
+def test_fleet_status_shape_distributed():
+    """ctx.fleet_status() surfaces fleet membership, arbiter depths,
+    admission and controller state in one call."""
+    _retire_active_context()
+    ctx = v.Context("distributed", num_workers=1, num_executors=2)
+    try:
+        status = ctx.fleet_status()
+        ids = {row["executor_id"] for row in status["fleet"]}
+        assert ids == {"exec-0", "exec-1"}
+        assert all(row["alive"] and not row["draining"]
+                   for row in status["fleet"])
+        assert status["scheduler"]["running"] == 0
+        assert status["elastic"]["enabled"] is False
+        assert status["elastic"]["live_executors"] == 2
+        assert status["elastic"]["executor_seconds"] >= 0.0
+    finally:
+        ctx.stop()

@@ -512,7 +512,10 @@ def test_pick_executor_speculation_rules():
         assert chosen.executor_id == "exec-1"
 
     # Blacklisted survivor: the speculative launch is SKIPPED (raises)...
+    # (a FRESH blacklist: the decay plane forgives counts whose last
+    # failure is older than blacklist_decay_s, so stamp the clock)
     e1.failures = backend.conf.executor_blacklist_threshold
+    e1.last_failure_at = time.time()
     with pytest.raises(NetworkError):
         backend._pick_executor(task(True, {"exec-0"}))
     # ...while an ordinary task still runs somewhere (advisory blacklist).
@@ -716,9 +719,11 @@ def test_pick_executor_delay_wait_expiry_and_immediate_demote():
     assert ex is e1 and time.monotonic() - t0 < 0.2
 
     # Blacklisted-but-alive preferred executor: demote immediately too.
+    # (fresh blacklist — stamp the decay clock so it counts)
     e0.restarts = 0
     e0.alive = True
     e0.failures = backend.conf.executor_blacklist_threshold
+    e0.last_failure_at = time.time()
     t0 = time.monotonic()
     ex, tier = backend._pick_with_locality_wait(_placement_task(["hostA"]))
     assert ex is e1 and time.monotonic() - t0 < 0.2

@@ -16,6 +16,7 @@ from vega_tpu.env import Configuration, DeploymentMode, Env
 from vega_tpu.errors import (
     CancelledError,
     FetchFailedError,
+    JobRejectedError,
     NetworkError,
     PartialJobError,
     ShuffleError,
@@ -68,6 +69,7 @@ __all__ = [
     "FetchFailedError",
     "HashPartitioner",
     "JobFuture",
+    "JobRejectedError",
     "NetworkError",
     "PartialJobError",
     "PartialResult",

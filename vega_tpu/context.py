@@ -126,6 +126,19 @@ class Context:
             # pools, quotas, and cancellation apply uniformly. Jobs run
             # concurrently, each on its own event-loop thread.
             self.job_server = JobServer(self.scheduler, conf)
+            # Elastic serving plane (scheduler/elastic.py): the
+            # autoscaler exists for any fleet-shaped backend so manual
+            # decommission and fleet_status work even with the control
+            # loop off; the loop itself only runs under elastic_enabled.
+            self.elastic = None
+            if hasattr(self._backend, "fleet_snapshot"):
+                from vega_tpu.scheduler.elastic import ElasticController
+
+                self.elastic = ElasticController(
+                    self._backend, self.job_server.arbiter,
+                    self.scheduler, conf, self.bus)
+                if getattr(conf, "elastic_enabled", False):
+                    self.elastic.start()
             # Thread-local submission properties (Spark's
             # setLocalProperty): "pool" selects the scheduling pool for
             # jobs submitted from this thread.
@@ -319,12 +332,16 @@ class Context:
         return default if props is None else props.get(key, default)
 
     def set_pool(self, name: str, weight: int = 1,
-                 max_concurrent_tasks: Optional[int] = None):
+                 max_concurrent_tasks: Optional[int] = None,
+                 max_queued: Optional[int] = None):
         """Declare/configure a scheduling pool (weight skews the fair
-        share; max_concurrent_tasks is a hard per-pool in-flight quota).
+        share; max_concurrent_tasks is a hard per-pool in-flight quota;
+        max_queued bounds ADMISSION — in-flight jobs of the pool beyond
+        it are rejected or blocked per Configuration.admission_mode).
         Select it per thread with ``set_local_property("pool", name)`` or
         per job with ``submit_job(..., pool=name)``."""
-        return self.job_server.set_pool(name, weight, max_concurrent_tasks)
+        return self.job_server.set_pool(name, weight, max_concurrent_tasks,
+                                        max_queued)
 
     def submit_job(self, rdd: RDD, func: Callable,
                    partitions: Optional[List[int]] = None,
@@ -408,6 +425,22 @@ class Context:
             log.warning("event bus flush timed out; metrics may lag")
         return self.metrics.summary()
 
+    def fleet_status(self) -> dict:
+        """One view of the serving plane: fleet membership/occupancy
+        (per-executor in-flight), the arbiter's running/queued depths
+        (global and per pool), per-pool admission in-flight vs bounds,
+        and the elastic controller's state. Works in local mode too —
+        the fleet section is just empty there."""
+        backend = self._backend
+        return {
+            "fleet": backend.fleet_snapshot()
+            if hasattr(backend, "fleet_snapshot") else [],
+            "scheduler": self.job_server.arbiter.stats(),
+            "admission": self.job_server.admission_status(),
+            "elastic": self.elastic.status() if self.elastic is not None
+            else {"enabled": False},
+        }
+
     def storage_status(self) -> dict:
         """Tier occupancy + spill/promote counters of this process's block
         stores (cache + shuffle). bench.py embeds this in its detail so
@@ -424,6 +457,11 @@ class Context:
         if self._stopped:
             return
         self._stopped = True
+        # The autoscaler goes first: a control loop mid-decision must not
+        # spawn or decommission against a backend that is tearing down
+        # (teardown=True also aborts any mid-ladder decommission).
+        if self.elastic is not None:
+            self.elastic.stop(teardown=True)
         # Wind the job plane down first: cancel in-flight jobs and settle
         # their futures (nobody stays parked on result()) BEFORE the
         # backend and stores those jobs might still be touching go away.

@@ -84,7 +84,12 @@ class _Executor:
         self.alive = True
         self.reaped = False      # declared lost; never resurrects
         self.respawning = False  # a replacement launch is in flight
+        # Graceful decommission (scheduler/elastic.py): a draining slot
+        # takes no new placements, leaves the peer registry, and never
+        # respawns — it is on its way OUT, not failed.
+        self.draining = False
         self.failures = 0        # dispatch/transport failures (blacklist)
+        self.last_failure_at = 0.0  # blacklist decay clock
         self.lost_at = 0.0       # when the reaper declared it lost
         self.sockets: Set[socket.socket] = set()  # in-flight dispatches
 
@@ -135,6 +140,12 @@ class DistributedBackend(TaskBackend):
                 hosts = Hosts.load(explicit).slaves or None
         n = num_executors or getattr(conf, "num_executors", None) or 2
         local_hosts = hosts or ["127.0.0.1"] * n
+        # Elastic scale-up (scheduler/elastic.py): fresh slots get the
+        # next never-used index and rotate over the configured host set
+        # (local fleets: all 127.0.0.1; ssh fleets: spread like the
+        # initial spawn did).
+        self._slot_ids = itertools.count(len(local_hosts))
+        self._scale_hosts = list(local_hosts)
         self._spawn_workers(local_hosts)
         self._reaper = threading.Thread(
             target=self._reaper_loop, name="executor-reaper", daemon=True
@@ -186,6 +197,27 @@ class DistributedBackend(TaskBackend):
             # carry it so nested tooling (benchmarks, diagnostics) sees
             # the same switch the driver scheduled under.
             "VEGA_TPU_LOCALITY_WAIT_S": str(conf.locality_wait_s),
+            # Elastic serving plane: driver-side policy knobs (the control
+            # loop, admission bounds, blacklist decay), carried like
+            # LOCALITY_WAIT_S so nested tooling in workers sees the same
+            # switches the driver scheduled under.
+            "VEGA_TPU_ELASTIC_ENABLED":
+                "1" if getattr(conf, "elastic_enabled", False) else "0",
+            "VEGA_TPU_ELASTIC_MIN_EXECUTORS": str(
+                conf.elastic_min_executors),
+            "VEGA_TPU_ELASTIC_MAX_EXECUTORS": str(
+                conf.elastic_max_executors),
+            "VEGA_TPU_ELASTIC_SCALE_UP_THRESHOLD": str(
+                conf.elastic_scale_up_threshold),
+            "VEGA_TPU_ELASTIC_SCALE_DOWN_THRESHOLD": str(
+                conf.elastic_scale_down_threshold),
+            "VEGA_TPU_ELASTIC_DECISION_INTERVAL_S": str(
+                conf.elastic_decision_interval_s),
+            "VEGA_TPU_DECOMMISSION_TIMEOUT_S": str(
+                conf.decommission_timeout_s),
+            "VEGA_TPU_POOL_MAX_QUEUED": str(conf.pool_max_queued),
+            "VEGA_TPU_ADMISSION_MODE": str(conf.admission_mode),
+            "VEGA_TPU_BLACKLIST_DECAY_S": str(conf.blacklist_decay_s),
             # Respawned incarnations disarm one-shot fault injections
             # (faults.py): a chaos-killed slot comes back healthy.
             "VEGA_TPU_FAULT_INCARNATION": str(incarnation),
@@ -326,18 +358,7 @@ class DistributedBackend(TaskBackend):
         with self._lock:
             executors = list(self._executors.values())
         for ex in executors:
-            try:
-                host, port = protocol.parse_uri(ex.task_uri)
-                with protocol.connect(host, port, timeout=2.0) as sock:
-                    protocol.send_msg(sock, "shutdown")
-                    protocol.recv_msg(sock)
-            except NetworkError:
-                pass
-            if ex.process is not None:
-                try:
-                    ex.process.wait(timeout=5.0)
-                except subprocess.TimeoutExpired:
-                    ex.process.kill()
+            self._shutdown_worker(ex)
         if self._reaper.is_alive():
             self._reaper.join(timeout=2.0)
         self.service.stop()
@@ -435,15 +456,18 @@ class DistributedBackend(TaskBackend):
         most executor_reap_interval_s away."""
         with self._lock:
             return any(not ex.alive and ex.process is not None
+                       and not ex.draining
                        and (ex.respawning
                             or ex.restarts < self.conf.executor_max_restarts)
                        for ex in self._executors.values())
 
     def _maybe_respawn(self) -> None:
         with self._lock:
+            # Draining slots never respawn: they are being retired on
+            # purpose (elastic scale-down), not recovered.
             dead = [ex for ex in self._executors.values()
                     if ex.reaped and ex.process is not None
-                    and not ex.respawning]
+                    and not ex.respawning and not ex.draining]
         for ex in dead:
             if self._stop_event.is_set():
                 return
@@ -503,11 +527,165 @@ class DistributedBackend(TaskBackend):
             sink(ev.ExecutorRestarted(executor_id=wid, host=ex.host,
                                       attempt=attempt))
 
+    # ----------------------------------------------------------- elastic fleet
+    def add_executor(self) -> str:
+        """Scale-up: spawn ONE brand-new executor slot mid-run (the PR 2
+        `_launch` path — readiness-gated, task-port-confirmed, stdout-
+        drained), register it, and announce `ExecutorAdded` on the bus.
+        The new slot enters `_pick_executor` rotation the moment it lands
+        in `_executors`. Raises NetworkError if the worker never becomes
+        ready — the caller (the elastic control loop) logs and retries on
+        a later decision tick."""
+        with self._lock:
+            if self._stopped:
+                raise NetworkError("backend is stopped; cannot scale up")
+            idx = next(self._slot_ids)
+        executor_id = f"exec-{idx}"
+        host = self._scale_hosts[idx % len(self._scale_hosts)]
+        proc = self._launch(executor_id, host)
+        line = self._wait_ready(executor_id, proc, time.time() + 30.0)
+        _tag, wid, task_uri = line.split()
+        try:
+            self._confirm_task_port(wid, task_uri)
+        except NetworkError:
+            proc.kill()  # READY-but-unserving: don't leak the process
+            raise
+        with self._lock:
+            if self._stopped:
+                proc.kill()  # stop() raced the launch: don't leak
+                raise NetworkError("backend stopped during scale-up")
+            self._executors[wid] = _Executor(wid, task_uri, host, proc)
+            fleet = len([e for e in self._executors.values()
+                         if e.alive and not e.draining])
+        self._drain_stdout(wid, proc)
+        log.info("elastic scale-up: %s on %s (fleet now %d)", wid, host,
+                 fleet)
+        sink = self.event_sink
+        if sink is not None:
+            sink(ev.ExecutorAdded(executor_id=wid, host=host,
+                                  fleet_size=fleet))
+        return wid
+
+    def claim_decommission(self, executor_id: str,
+                           min_live: int = 0) -> str:
+        """Atomically claim a slot for decommission. Returns "ok" (the
+        slot is now draining: no new placements, out of the shuffle-peer
+        registry, never respawned), "unknown", "claimed" (a racing
+        decommission already holds it — two callers can never both run
+        the ladder), or "floor" (retiring this LIVE slot would leave
+        fewer than `min_live` alive non-draining executors). The floor
+        check and the claim share ONE lock acquisition, so concurrent
+        decommissions of DIFFERENT victims cannot jointly shrink the
+        fleet below the floor either."""
+        with self._lock:
+            ex = self._executors.get(executor_id)
+            if ex is None:
+                return "unknown"
+            if ex.draining:
+                return "claimed"
+            if ex.alive:
+                live = len([e for e in self._executors.values()
+                            if e.alive and not e.draining])
+                if live - 1 < min_live:
+                    return "floor"
+            ex.draining = True
+        self.service.set_draining(executor_id, True)
+        return "ok"
+
+    def release_decommission(self, executor_id: str) -> None:
+        """Drop a decommission claim (abandoned/failed ladder): the slot
+        re-enters placement and the peer registry. No-op for a slot the
+        ladder already reaped."""
+        with self._lock:
+            ex = self._executors.get(executor_id)
+            if ex is None:
+                return
+            ex.draining = False
+        self.service.set_draining(executor_id, False)
+
+    @staticmethod
+    def _shutdown_worker(ex: _Executor, graceful: bool = True) -> None:
+        """One worker's shutdown handshake + process reap (shared by
+        stop() and remove_executor so the two cannot drift)."""
+        if graceful:
+            try:
+                host, port = protocol.parse_uri(ex.task_uri)
+                with protocol.connect(host, port, timeout=2.0) as sock:
+                    protocol.send_msg(sock, "shutdown")
+                    protocol.recv_msg(sock)
+            except NetworkError:
+                pass  # fall through to the process reap below
+        if ex.process is not None:
+            try:
+                ex.process.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                ex.process.kill()
+
+    def remove_executor(self, executor_id: str, graceful: bool = True) -> None:
+        """Reap a decommissioned slot: drop it from the executor table and
+        the worker registry FIRST (so the liveness reaper never sees its
+        exit as a loss — `reaped` is also set under the same lock, which
+        covers a sweep that snapshotted the victim BEFORE this pop and
+        would otherwise _mark_lost its graceful exit mid-tick), then shut
+        the process down — gracefully when the worker is healthy, straight
+        kill after a forced escalation. Also clears the slot's advisory
+        state (known-hash set, blacklist count dies with the _Executor
+        object) so a future slot under a fresh id starts clean."""
+        with self._lock:
+            ex = self._executors.pop(executor_id, None)
+            self._known_hashes.pop(executor_id, None)
+            if ex is not None:
+                ex.draining = True
+                ex.alive = False
+                ex.reaped = True  # _mark_lost's guard: never a "loss"
+        if ex is None:
+            return
+        self.service.unregister_worker(executor_id)
+        self._shutdown_worker(ex, graceful=graceful)
+
+    def declare_lost(self, executor_id: str, reason: str) -> None:
+        """Escalation entry for the elastic decommission ladder: a victim
+        that wedged mid-drain is handed to the PR 2 executor-lost path
+        (socket teardown, output unregistration, listener scrub,
+        ExecutorLost on the bus)."""
+        with self._lock:
+            ex = self._executors.get(executor_id)
+        if ex is not None:
+            self._mark_lost(ex, reason)
+
+    def executor_inflight(self) -> Dict[str, int]:
+        """Live per-executor in-flight dispatch counts (from the cancel-
+        routing map): the elastic loop's occupancy watermark and the
+        decommission drain gate."""
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for eid in self._running_on.values():
+                counts[eid] = counts.get(eid, 0) + 1
+            return counts
+
+    def fleet_snapshot(self) -> List[dict]:
+        """One row per slot (id/host/state/in-flight/restarts) for
+        ctx.fleet_status() and the elastic controller's decisions."""
+        inflight = self.executor_inflight()
+        with self._lock:
+            return [{
+                "executor_id": ex.executor_id,
+                "host": ex.host,
+                "alive": ex.alive,
+                "draining": ex.draining,
+                "restarts": ex.restarts,
+                "inflight": inflight.get(ex.executor_id, 0),
+            } for ex in self._executors.values()]
+
     # -------------------------------------------------------------- dispatch
     @property
     def parallelism(self) -> int:
+        # Draining slots are excluded: the arbiter must stop feeding a
+        # fleet slice that takes no new placements, or queued tasks park
+        # against capacity that will never serve them.
         with self._lock:
-            n = max(1, len([e for e in self._executors.values() if e.alive]))
+            n = max(1, len([e for e in self._executors.values()
+                            if e.alive and not e.draining]))
         return n * self.conf.num_workers
 
     # Locality-tier names, indexed by score (0 is best): PROCESS_LOCAL
@@ -516,13 +694,30 @@ class DistributedBackend(TaskBackend):
     _TIER_NAMES = ("process", "host", "any")
 
     def shuffle_peer_uris(self) -> List[str]:
-        """Live workers' shuffle-server URIs — the same registry
-        `list_shuffle_peers` serves the map/reduce planes, so the DAG
-        scheduler's push-owner computation (dag._reduce_side_prefs)
-        rotates over the same peer set the mappers push along."""
+        """Live, non-draining workers' shuffle-server URIs — the same
+        registry `list_shuffle_peers` serves the map/reduce planes, so the
+        DAG scheduler's push-owner computation (dag._reduce_side_prefs)
+        rotates over the same peer set the mappers push along. A draining
+        slot leaves this set the moment decommission starts: no new
+        replica or pre-merge state lands on the node being retired."""
         return [info["shuffle_uri"]
-                for info in self.service.live_workers().values()
-                if info.get("shuffle_uri")]
+                for wid, info in self.service.live_workers().items()
+                if info.get("shuffle_uri")
+                and wid not in self.service.draining]
+
+    def _effective_failures(self, ex: _Executor, now: float) -> int:
+        """Consecutive dispatch-failure count with time decay
+        (blacklist_decay_s): a count whose LAST failure is older than the
+        decay window is forgiven, so a recovered-but-once-flaky executor
+        rejoins rotation instead of staying advisory-deprioritized
+        forever. 0 disables decay. Caller holds self._lock."""
+        decay = float(getattr(self.conf, "blacklist_decay_s", 0.0) or 0.0)
+        if decay > 0 and ex.failures \
+                and now - ex.last_failure_at >= decay:
+            log.info("blacklist decay: forgiving %d stale failures of %s",
+                     ex.failures, ex.executor_id)
+            ex.failures = 0
+        return ex.failures
 
     def _match_tier(self, executor: _Executor, locs) -> int:
         """0 PROCESS_LOCAL, 1 HOST_LOCAL, 2 ANY for `executor` against a
@@ -560,15 +755,17 @@ class DistributedBackend(TaskBackend):
         immediately rather than starve. Caller holds self._lock."""
         if best_tier <= 1:
             return False  # already host-local or better
+        now = time.time()
         for ex in self._executors.values():
-            if ex.alive or ex.process is None:
+            if ex.alive or ex.process is None or ex.draining:
                 continue
             if not (ex.respawning
                     or ex.restarts < self.conf.executor_max_restarts):
                 continue
             if ex.executor_id in exclude:
                 continue
-            if ex.failures >= self.conf.executor_blacklist_threshold:
+            if self._effective_failures(ex, now) >= \
+                    self.conf.executor_blacklist_threshold:
                 continue
             if ex.host in locs:
                 return True
@@ -607,9 +804,17 @@ class DistributedBackend(TaskBackend):
         locs = getattr(task, "preferred_locs", None) or ()
         wait_s = float(getattr(self.conf, "locality_wait_s", 0.0) or 0.0)
         with self._lock:
+            now = time.time()
             alive = [e for e in self._executors.values() if e.alive]
             if not alive:
                 raise NetworkError("no live executors")
+            # Draining slots (graceful decommission in progress) take no
+            # new placements — unless they are ALL that's left, in which
+            # case stranding the task would be worse than one more task
+            # on a leaving node.
+            active = [e for e in alive if not e.draining]
+            if active:
+                alive = active
             threshold = self.conf.executor_blacklist_threshold
             if exclude:
                 eligible = [e for e in alive
@@ -617,14 +822,16 @@ class DistributedBackend(TaskBackend):
                 if eligible or speculative:
                     alive = eligible  # advisory for ordinary retries only
             if speculative:
-                alive = [e for e in alive if e.failures < threshold]
+                alive = [e for e in alive
+                         if self._effective_failures(e, now) < threshold]
                 if not alive:
                     raise NetworkError(
                         "no eligible executor for speculative attempt "
                         f"(excluded={set(exclude) or '{}'})"
                     )
             else:
-                clean = [e for e in alive if e.failures < threshold]
+                clean = [e for e in alive
+                         if self._effective_failures(e, now) < threshold]
                 if clean:
                     alive = clean  # blacklist advisory: better flaky than none
             if wait_s <= 0:
@@ -970,6 +1177,7 @@ class DistributedBackend(TaskBackend):
                                 executor.executor_id, e)
                     with self._lock:
                         executor.failures += 1
+                        executor.last_failure_at = time.time()
                         if executor.reaped:
                             executor.alive = False  # never resurrect
                         else:

@@ -56,6 +56,13 @@ class DriverService:
         # Configuration.executor_liveness_timeout_s by the backend.
         self.liveness_timeout_s = liveness_timeout_s
         self.workers: Dict[str, dict] = {}  # executor_id -> info
+        # Executors being gracefully decommissioned (scheduler/elastic.py):
+        # still registered and heartbeating — liveness must keep covering
+        # them through the drain — but excluded from the shuffle-peer
+        # registry so no new replica/pre-merge state lands on a leaving
+        # node. Maintained via set_draining by DistributedBackend's
+        # claim_decommission / release_decommission / unregister_worker.
+        self.draining: set = set()
         self._lock = threading.Lock()
         self._server = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -97,10 +104,14 @@ class DriverService:
         if msg_type == "list_shuffle_peers":
             # Replica placement (shuffle_replication > 1): map tasks ask
             # which live executors can hold a copy of their buckets.
+            # Draining executors are excluded — new shuffle state must
+            # not land on a node mid-decommission.
+            with self._lock:
+                draining = set(self.draining)
             return {
                 wid: info["shuffle_uri"]
                 for wid, info in self.live_workers().items()
-                if info.get("shuffle_uri")
+                if info.get("shuffle_uri") and wid not in draining
             }
         if msg_type == "has_outputs":
             return self.map_output_tracker.has_outputs(payload)
@@ -124,6 +135,22 @@ class DriverService:
                 wid: info for wid, info in self.workers.items()
                 if now - info["last_seen"] < max_age
             }
+
+    def set_draining(self, executor_id: str, draining: bool) -> None:
+        """Mark/unmark an executor as draining (graceful decommission)."""
+        with self._lock:
+            if draining:
+                self.draining.add(executor_id)
+            else:
+                self.draining.discard(executor_id)
+
+    def unregister_worker(self, executor_id: str) -> None:
+        """Decommission finalizer: drop the worker's registration so
+        liveness, peer listings and locality resolution stop seeing it.
+        Driver-side only — the backend calls this directly, no RPC."""
+        with self._lock:
+            self.workers.pop(executor_id, None)
+            self.draining.discard(executor_id)
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -49,6 +49,11 @@ class Configuration:
     num_workers: int = dataclasses.field(
         default_factory=lambda: os.cpu_count() or 4
     )
+    # Initial executor count for distributed mode (None -> hosts file if
+    # configured, else 2 — the backend's historical default). The elastic
+    # plane starts here and moves the fleet between
+    # elastic_min/max_executors.
+    num_executors: Optional[int] = None
     # Round-trip tasks through serialization even in local mode, like the
     # reference does (local_scheduler.rs:345-351): catches unserializable
     # closures early. Costs wall time; disable for pure-local perf runs.
@@ -232,6 +237,53 @@ class Configuration:
     # queued on-chip A/B (benchmarks/tpu_jobs/03_radix_ab.sh, which
     # also measures packed) decides.
     dense_sort_impl: str = "auto"
+    # --- elastic serving plane (scheduler/elastic.py; distributed mode) ---
+    # Master switch for the autoscaler control loop: the driver samples
+    # load signals (arbiter queue depth, per-pool backlog, per-executor
+    # in-flight watermarks) every elastic_decision_interval_s and
+    # spawns/decommissions executors between the min/max bounds. Off by
+    # default: the fleet stays exactly as spawned (the reference sizes
+    # it once at context.rs launch time and never revisits).
+    elastic_enabled: bool = False
+    # Fleet bounds the autoscaler may move between. The initial fleet is
+    # num_executors/hosts as before; scale-down never drains below min,
+    # scale-up never spawns past max.
+    elastic_min_executors: int = 1
+    elastic_max_executors: int = 8
+    # Scale UP when (running + queued tasks) per live executor SLOT
+    # (num_workers slots per executor) holds above this watermark for a
+    # full decision interval. 1.0 = grow as soon as the fleet is more
+    # than fully subscribed for an interval.
+    elastic_scale_up_threshold: float = 2.0
+    # Scale DOWN (graceful decommission of one executor per decision)
+    # when fleet occupancy — running tasks / total slots — holds BELOW
+    # this fraction for a full decision interval with nothing queued.
+    elastic_scale_down_threshold: float = 0.25
+    # Sampling period of the control loop; a watermark must hold for one
+    # full interval (two consecutive samples) before the loop acts, so a
+    # single bursty sample never flaps the fleet.
+    elastic_decision_interval_s: float = 1.0
+    # Graceful decommission: how long the victim may take to drain its
+    # in-flight tasks before the drain escalates to the PR 2
+    # executor-lost path (socket teardown, output unregistration, task
+    # failover) instead of waiting forever on a wedged victim.
+    decommission_timeout_s: float = 10.0
+    # Admission control (scheduler/jobserver.py): maximum jobs a pool may
+    # have in flight (submitted, not yet settled) before submit_job stops
+    # admitting more — the bound that replaces unbounded queueing at the
+    # multi-tenant front door. 0 = unbounded (legacy behavior).
+    # Per-pool overrides via ctx.set_pool(..., max_queued=N).
+    pool_max_queued: int = 0
+    # What a full pool does to the submitter: "reject" raises the typed
+    # JobRejectedError immediately; "block" parks the submitting thread
+    # until a job of that pool settles (backpressure).
+    admission_mode: str = "reject"
+    # Dispatch-failure blacklists age out: an executor whose last
+    # transport failure is older than this many seconds has its
+    # consecutive-failure count forgiven, so a recovered-but-once-flaky
+    # executor rejoins _pick_executor rotation instead of staying
+    # advisory-deprioritized forever. 0 disables decay (legacy).
+    blacklist_decay_s: float = 60.0
     # Speculative dense-key table plan for warm named reduces (scatter
     # table + psum + hash-mask compact; dense_rdd.py). "auto" (default)
     # activates it on CPU only — measured 3-4x on the bench reduce there
@@ -252,21 +304,23 @@ class Configuration:
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
                      "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
                      "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR",
-                     "SCHEDULER_MODE", "SHUFFLE_PLAN"):
+                     "SCHEDULER_MODE", "SHUFFLE_PLAN", "ADMISSION_MODE"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",
+                     "NUM_EXECUTORS",
                      "CACHE_CAPACITY_BYTES", "MAX_FAILURES",
                      "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
                      "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
                      "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
                      "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES",
-                     "SHUFFLE_REPLICATION"):
+                     "SHUFFLE_REPLICATION", "ELASTIC_MIN_EXECUTORS",
+                     "ELASTIC_MAX_EXECUTORS", "POOL_MAX_QUEUED"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
                      "SPECULATION_ENABLED", "FETCH_BATCH_ENABLED",
-                     "TASK_BINARY_DEDUP"):
+                     "TASK_BINARY_DEDUP", "ELASTIC_ENABLED"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
         for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",
@@ -275,7 +329,10 @@ class Configuration:
                      "HEARTBEAT_INTERVAL_S", "EXECUTOR_LIVENESS_TIMEOUT_S",
                      "EXECUTOR_REAP_INTERVAL_S", "EXECUTOR_RESTART_BACKOFF_S",
                      "FETCH_RETRY_INTERVAL_S", "FETCH_SLOW_SERVER_S",
-                     "LOCALITY_WAIT_S"):
+                     "LOCALITY_WAIT_S", "ELASTIC_SCALE_UP_THRESHOLD",
+                     "ELASTIC_SCALE_DOWN_THRESHOLD",
+                     "ELASTIC_DECISION_INTERVAL_S", "DECOMMISSION_TIMEOUT_S",
+                     "BLACKLIST_DECAY_S"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), float(env[pref + name]))
         return cfg

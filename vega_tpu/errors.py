@@ -78,6 +78,28 @@ class TaskCancelledError(VegaError):
     already done."""
 
 
+class JobRejectedError(VegaError):
+    """Admission control refused a job at submit time: its pool already
+    holds `pool_max_queued` in-flight jobs (scheduler/jobserver.py). The
+    typed replacement for unbounded queueing at the multi-tenant front
+    door — callers retry, shed load, or submit under
+    ``admission_mode="block"`` to wait for capacity instead."""
+
+    def __init__(self, pool, queued, bound):
+        self.pool = pool
+        self.queued = queued
+        self.bound = bound
+        super().__init__(
+            f"pool {pool!r} is full: {queued} jobs in flight >= "
+            f"pool_max_queued={bound} (admission_mode=reject)"
+        )
+
+    def __reduce__(self):
+        # Explicit reconstruction: default exception pickling calls
+        # cls(message) which doesn't match this signature.
+        return (JobRejectedError, (self.pool, self.queued, self.bound))
+
+
 class TraceFallbackError(VegaError):
     """A user function could not be traced for the TPU tier.
 

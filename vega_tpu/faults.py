@@ -68,6 +68,14 @@ tests:
                                      ack — the mapper must degrade that
                                      row to pull, and a retried push must
                                      never double-merge
+  VEGA_TPU_FAULT_DECOMMISSION_HANG_S wedge a graceful decommission's drain
+                                     for S seconds (driver-side hook in
+                                     scheduler/elastic.py: the victim
+                                     reads as still-busy for S seconds) —
+                                     S past decommission_timeout_s forces
+                                     the drain-timeout escalation to the
+                                     executor-lost path; combine with
+                                     ..._EXECUTOR to wedge one victim
   VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
   VEGA_TPU_FAULT_DROP_BINARY_N       drop the cached stage binary for the
                                      first N `binary_cached` task_v2
@@ -139,6 +147,8 @@ class FaultInjector:
         self.merged_delay_s = _float("MERGED_DELAY_S") if armed else 0.0
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
         self.drop_binary_n = _int("DROP_BINARY_N") if armed else 0
+        self.decommission_hang_s = \
+            _float("DECOMMISSION_HANG_S") if armed else 0.0
         self.stats_dir = env.get(pref + "STATS_DIR") or None
 
         self._tasks_done = 0
@@ -154,6 +164,7 @@ class FaultInjector:
             or self.fetch_delay_s or self.corrupt_spill_n
             or self.fetch_stream_drop_n or self.drop_binary_n
             or self.push_drop_n or self.merged_delay_s
+            or self.decommission_hang_s
         )
 
     def _targets_me(self) -> bool:
@@ -303,6 +314,23 @@ class FaultInjector:
         log.warning("FAULT: dropping cached task binary (forcing "
                     "need_binary re-ship)")
         return True
+
+    def decommission_hang(self, executor_id: str) -> float:
+        """scheduler/elastic.py, at drain start: seconds the victim should
+        read as still-busy (a wedged victim that never drains). DRIVER-
+        side hook, so the executor filter compares against the VICTIM's
+        id, not this process's Env.executor_id. Returns 0.0 when unarmed
+        or the victim doesn't match."""
+        if not (self.active and self.decommission_hang_s):
+            return 0.0
+        if self.executor_filter is not None \
+                and self.executor_filter != executor_id:
+            return 0.0
+        self._record("decommission_hang", executor=executor_id,
+                     hang_s=self.decommission_hang_s)
+        log.warning("FAULT: wedging decommission drain of %s for %.1fs",
+                    executor_id, self.decommission_hang_s)
+        return self.decommission_hang_s
 
     def corrupt_spilled(self, disk_store, key: str) -> None:
         """shuffle/store.py, after a bucket spills: flip payload bytes in

@@ -106,6 +106,53 @@ class MapOutputTracker:
             self._outputs.pop(shuffle_id, None)
             self._sizes.pop(shuffle_id, None)
 
+    # --- graceful decommission (scheduler/elastic.py) ----------------------
+    def outputs_on_server(self, uri: str):
+        """Migration manifest for a decommissioning server: every
+        (shuffle_id, map_id, location_list, per_reduce_sizes_or_None)
+        whose locations include `uri`. Sizes come from the locality
+        plane's per-bucket accounting — when present, their length IS the
+        shuffle's reduce count, which is what lets the migrator fetch the
+        full bucket row without scheduler help; when absent the caller
+        falls back to scrub-and-recompute."""
+        with self._lock:
+            out = []
+            for shuffle_id, locs in self._outputs.items():
+                sizes = self._sizes.get(shuffle_id, {})
+                for map_id, lst in enumerate(locs):
+                    if uri in lst:
+                        row = sizes.get(map_id)
+                        out.append((shuffle_id, map_id, list(lst),
+                                    list(row) if row else None))
+            return out
+
+    def server_bytes(self, uri: str) -> int:
+        """Registered shuffle bytes held by `uri` (per the advisory size
+        accounting): the elastic controller's victim-selection signal —
+        decommissioning the server with the least state to migrate."""
+        total = 0
+        with self._lock:
+            for shuffle_id, locs in self._outputs.items():
+                sizes = self._sizes.get(shuffle_id, {})
+                for map_id, lst in enumerate(locs):
+                    if uri in lst:
+                        total += sum(sizes.get(map_id, ()))
+        return total
+
+    def replace_location(self, shuffle_id: int, map_id: int,
+                         old_uri: str, new_uri: str) -> None:
+        """Migration rebind: the bucket row moved from `old_uri` to
+        `new_uri` — swap the location in place (order preserved,
+        duplicates collapsed). No generation bump here: the migrator bumps
+        ONCE after the whole sweep, like the reaper's bulk unregister."""
+        with self._cond:
+            locs = self._outputs.get(shuffle_id)
+            if locs is None or map_id >= len(locs):
+                return
+            replaced = [new_uri if u == old_uri else u for u in locs[map_id]]
+            locs[map_id] = list(dict.fromkeys(replaced))  # order-preserving
+            self._cond.notify_all()
+
     # --- per-bucket size accounting (locality plane) -----------------------
     def register_map_sizes(self, shuffle_id: int,
                            sizes_by_map: Dict[int, List[int]]) -> None:

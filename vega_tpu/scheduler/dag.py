@@ -987,6 +987,51 @@ class DAGScheduler:
                     job.failed.add(stage)
                     job.last_fetch_failure = time.time()
 
+    def apply_decommission(self, shuffle_uri: str,
+                           rebind: Dict[tuple, str],
+                           lost: Set[tuple]) -> None:
+        """Graceful-decommission scrub (scheduler/elastic.py) — the gentle
+        sibling of _on_executor_lost. The leaving server's locations leave
+        every cached map stage's output_locs: REBOUND entries — bucket
+        rows the migrator copied to a surviving peer — swap in the
+        survivor's uri in place, so the stage stays available with zero
+        recompute and zero FetchFailed; everything else (replica-covered
+        copies, unmigratable LOST entries, and partitions of still-RUNNING
+        stages whose completion would otherwise register the dead server)
+        is simply removed, so completion/resubmission recomputes exactly
+        the holes. Running jobs whose lineage reaches a LOST shuffle get
+        the stage marked failed proactively — same rationale as the
+        executor-lost path: recovery must not hinge on a reducer
+        observing a FetchFailed."""
+        with self._stages_lock:
+            stages = list(self._shuffle_to_map_stage.values())
+            jobs = list(self._running_jobs.values())
+        lost_shuffles = {shuffle_id for shuffle_id, _ in lost}
+        for stage in stages:
+            shuffle_id = stage.shuffle_dep.shuffle_id
+            for p in range(stage.num_partitions):
+                new_uri = rebind.get((shuffle_id, p))
+                locs = stage.output_locs[p]
+                if new_uri and shuffle_uri in locs:
+                    swapped = [new_uri if u == shuffle_uri else u
+                               for u in locs]
+                    # Order-preserving dedupe; list replacement is
+                    # GIL-atomic (same contract as
+                    # remove_outputs_on_server).
+                    stage.output_locs[p] = list(dict.fromkeys(swapped))
+            stage.remove_outputs_on_server(shuffle_uri)
+        if not lost_shuffles:
+            return
+        for job in jobs:
+            for stage in stages:
+                if stage.shuffle_dep.shuffle_id in lost_shuffles \
+                        and stage.shuffle_dep.shuffle_id \
+                        in job.lineage_shuffle_ids \
+                        and not stage.is_available:
+                    job.running.discard(stage)
+                    job.failed.add(stage)
+                    job.last_fetch_failure = time.time()
+
     def _stage_by_id(self, stage_id: int) -> Optional[Stage]:
         with self._stages_lock:
             stages = list(self._shuffle_to_map_stage.values())

@@ -125,6 +125,57 @@ class ExecutorRestarted(Event):
 
 
 @dataclasses.dataclass
+class ExecutorAdded(Event):
+    """The elastic control loop (scheduler/elastic.py) scaled the fleet UP:
+    a brand-new executor slot was spawned mid-run, registered with the
+    DriverService, and entered _pick_executor rotation. Distinct from
+    ExecutorRestarted (a dead slot's replacement): this slot never
+    existed before. fleet_size is the live fleet AFTER the add."""
+
+    executor_id: str = ""
+    host: str = ""
+    fleet_size: int = 0
+
+
+@dataclasses.dataclass
+class ExecutorDecommissioned(Event):
+    """The elastic control loop retired an executor gracefully: the slot
+    drained (no new placements), its live shuffle state was migrated —
+    replica-covered outputs simply dropped the leaving location,
+    unreplicated outputs were re-pushed to a surviving peer, and anything
+    unmigratable was scrubbed for recompute — then the process was reaped
+    and unregistered. `forced` marks a drain that timed out and escalated
+    to the executor-lost path instead (chaos: a wedged victim)."""
+
+    executor_id: str = ""
+    host: str = ""
+    # Outputs whose only copy was re-pushed to a surviving peer, and the
+    # bucket bytes that move cost.
+    migrated_outputs: int = 0
+    migrated_bytes: int = 0
+    # Outputs that needed no migration: a surviving replica already held
+    # them (shuffle_replication >= 2 / push-plan copies).
+    replica_covered: int = 0
+    # Outputs that could not be migrated (unknown bucket counts, fetch
+    # failure mid-copy): scrubbed so lineage recomputes them on demand.
+    recomputed_outputs: int = 0
+    forced: bool = False
+    duration_s: float = 0.0
+
+
+@dataclasses.dataclass
+class JobRejected(Event):
+    """Admission control refused a submit_job at the front door: the pool
+    already held pool_max_queued in-flight jobs under
+    admission_mode=reject (jobserver.py). Blocked submissions
+    (admission_mode=block) do NOT emit this — they park instead."""
+
+    pool: str = "default"
+    queued: int = 0
+    bound: int = 0
+
+
+@dataclasses.dataclass
 class StageResubmitted(Event):
     """A failed stage re-entered submission after a fetch failure — the
     coarse recovery path. In-place fetch retries (transient socket drops)
@@ -377,6 +428,18 @@ class MetricsListener(Listener):
         self.executors_lost = 0
         self.executors_restarted = 0
         self.stages_resubmitted = 0
+        # Elastic serving plane (scheduler/elastic.py): fleet moves and
+        # what graceful decommission cost. benchmarks/elastic_ab.py and
+        # the decommission chaos tests key loss-freeness on these.
+        self.elastic: Dict[str, int] = {
+            "executors_added": 0, "executors_decommissioned": 0,
+            "decommissions_forced": 0, "migrated_outputs": 0,
+            "migrated_bytes": 0, "replica_covered": 0,
+            "recomputed_outputs": 0,
+        }
+        # Admission control (jobserver.py): jobs refused at the front
+        # door under admission_mode=reject.
+        self.jobs_rejected = 0
         # Straggler-mitigation counters: duplicates launched / which copy
         # committed first / completions whose result was discarded by the
         # (stage_id, partition) dedup. benchmarks/straggler_ab.py and the
@@ -518,6 +581,19 @@ class MetricsListener(Listener):
                 self.executors_lost += 1
             elif isinstance(event, ExecutorRestarted):
                 self.executors_restarted += 1
+            elif isinstance(event, ExecutorAdded):
+                self.elastic["executors_added"] += 1
+            elif isinstance(event, ExecutorDecommissioned):
+                el = self.elastic
+                el["executors_decommissioned"] += 1
+                if event.forced:
+                    el["decommissions_forced"] += 1
+                el["migrated_outputs"] += event.migrated_outputs
+                el["migrated_bytes"] += event.migrated_bytes
+                el["replica_covered"] += event.replica_covered
+                el["recomputed_outputs"] += event.recomputed_outputs
+            elif isinstance(event, JobRejected):
+                self.jobs_rejected += 1
             elif isinstance(event, StageResubmitted):
                 self.stages_resubmitted += 1
             elif isinstance(event, ShuffleFetchCompleted):
@@ -571,6 +647,8 @@ class MetricsListener(Listener):
                 "executors_lost": self.executors_lost,
                 "executors_restarted": self.executors_restarted,
                 "stages_resubmitted": self.stages_resubmitted,
+                "elastic": dict(self.elastic),
+                "jobs_rejected": self.jobs_rejected,
                 "spills": self.spill_count,
                 "promotes": self.promote_count,
                 "spilled_bytes": dict(self.spilled_bytes),

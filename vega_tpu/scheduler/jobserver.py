@@ -43,8 +43,9 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from vega_tpu.errors import CancelledError, VegaError
+from vega_tpu.errors import CancelledError, JobRejectedError, VegaError
 from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.dag import _WAKE, DAGScheduler, _Job
 from vega_tpu.scheduler.task import Task, TaskEndEvent
 
@@ -61,11 +62,18 @@ class PoolConfig:
     tenant-quota knob). Both govern BACKEND slots: a single-partition
     no-parent job runs inline on its own driver thread (the scheduler's
     latency fast path, reference local_execution) and occupies no
-    executor slot, so it neither counts against nor waits on a quota."""
+    executor slot, so it neither counts against nor waits on a quota.
+
+    ``max_queued`` is the ADMISSION bound (jobs, not tasks): at most
+    this many jobs of the pool may be in flight — submitted, not yet
+    settled — before ``submit_job`` rejects (JobRejectedError) or
+    blocks (``admission_mode=block``). None falls back to
+    Configuration.pool_max_queued; 0 means unbounded."""
 
     name: str = "default"
     weight: int = 1
     max_concurrent_tasks: Optional[int] = None
+    max_queued: Optional[int] = None
 
 
 _DEFAULT_POOL = PoolConfig()
@@ -110,11 +118,17 @@ class TaskArbiter:
 
     # ------------------------------------------------------------ config
     def set_pool(self, name: str, weight: int = 1,
-                 max_concurrent_tasks: Optional[int] = None) -> PoolConfig:
-        cfg = PoolConfig(name, max(1, int(weight)), max_concurrent_tasks)
+                 max_concurrent_tasks: Optional[int] = None,
+                 max_queued: Optional[int] = None) -> PoolConfig:
+        cfg = PoolConfig(name, max(1, int(weight)), max_concurrent_tasks,
+                         max_queued)
         with self._lock:
             self._pools[name] = cfg
         return cfg
+
+    def pool_config(self, name: str) -> Optional[PoolConfig]:
+        with self._lock:
+            return self._pools.get(name)
 
     def set_mode(self, mode: str) -> None:
         if mode not in ("fifo", "fair"):
@@ -158,6 +172,12 @@ class TaskArbiter:
                 "running": self._running_total,
                 "queued": sum(len(dq) for dq in self._pending.values()),
                 "running_by_pool": dict(self._running_by_pool),
+                # Per-pool backlog: one of the elastic control loop's
+                # load signals (scheduler/elastic.py) and the queue-depth
+                # face of ctx.fleet_status().
+                "queued_by_pool": {name: len(dq)
+                                   for name, dq in self._pending.items()
+                                   if dq},
             }
 
     def _capacity(self) -> int:
@@ -371,6 +391,7 @@ class JobServer:
 
     def __init__(self, scheduler: DAGScheduler, conf=None):
         self.scheduler = scheduler
+        self.conf = conf
         mode = getattr(conf, "scheduler_mode", "fifo") if conf is not None \
             else "fifo"
         self.arbiter = TaskArbiter(scheduler.backend, mode)
@@ -378,11 +399,20 @@ class JobServer:
         self._live: Dict[int, JobFuture] = {}
         self._stopped = False
         self._lock = named_lock("scheduler.jobserver.JobServer._lock")
+        # Admission control: per-pool count of in-flight jobs (admitted,
+        # not yet settled). Guarded by its OWN plain Condition — like the
+        # MapOutputTracker's, deliberately outside the sync-witness so
+        # blocked submitters (admission_mode=block) can park on it
+        # without wedging the witness graph.
+        self._admission = threading.Condition()
+        self._pool_live: Dict[str, int] = {}
 
     # ------------------------------------------------------------ config
     def set_pool(self, name: str, weight: int = 1,
-                 max_concurrent_tasks: Optional[int] = None) -> PoolConfig:
-        return self.arbiter.set_pool(name, weight, max_concurrent_tasks)
+                 max_concurrent_tasks: Optional[int] = None,
+                 max_queued: Optional[int] = None) -> PoolConfig:
+        return self.arbiter.set_pool(name, weight, max_concurrent_tasks,
+                                     max_queued)
 
     def set_scheduler_mode(self, mode: str) -> None:
         self.arbiter.set_mode(mode)
@@ -391,27 +421,142 @@ class JobServer:
     def scheduler_mode(self) -> str:
         return self.arbiter.mode
 
+    # --------------------------------------------------------- admission
+    def _pool_bound(self, pool: str) -> Optional[int]:
+        """Effective admission bound for `pool`: an explicit
+        set_pool(..., max_queued=) wins; otherwise
+        Configuration.pool_max_queued. 0 / unset = unbounded (None)."""
+        cfg = self.arbiter.pool_config(pool)
+        if cfg is not None and cfg.max_queued is not None:
+            return cfg.max_queued or None
+        default = int(getattr(self.conf, "pool_max_queued", 0) or 0) \
+            if self.conf is not None else 0
+        return default or None
+
+    def _admit(self, pool: str) -> None:
+        """The multi-tenant front door's backstop against unbounded
+        queueing: a pool at its max_queued bound either rejects the
+        submission with the typed JobRejectedError (admission_mode=
+        reject, the default) or parks the submitting thread until a job
+        of the pool settles (admission_mode=block — backpressure). The
+        bound is enforced HERE, atomically with the count increment, so
+        the pool can never exceed it however many threads race."""
+        mode = str(getattr(self.conf, "admission_mode", "reject")
+                   if self.conf is not None else "reject")
+        if mode not in ("reject", "block"):
+            # Same crispness as set_mode's scheduler_mode check: a typo'd
+            # mode must not silently behave as "reject".
+            raise VegaError(f"unknown admission_mode {mode!r} "
+                            "(expected 'reject' or 'block')")
+        with self._admission:
+            while True:
+                if self._stopped:
+                    raise VegaError("job server is stopped")
+                # Re-read the bound every pass: an operator raising a
+                # pool's max_queued to relieve pressure must unpark the
+                # waiters already here, not only admit fresh arrivals.
+                bound = self._pool_bound(pool)
+                in_flight = self._pool_live.get(pool, 0)
+                if bound is None or in_flight < bound:
+                    self._pool_live[pool] = in_flight + 1
+                    return
+                if mode != "block":
+                    bus = getattr(self.scheduler, "bus", None)
+                    if bus is not None:
+                        bus.post(ev.JobRejected(pool=pool,
+                                                queued=in_flight,
+                                                bound=bound))
+                    raise JobRejectedError(pool, in_flight, bound)
+                # Backpressure: wake on any settle (notify_all in
+                # _release_admission) or the 0.5s re-check tick — the
+                # tick also observes a concurrent stop().
+                self._admission.wait(timeout=0.5)
+
+    def _release_admission(self, pool: str) -> None:
+        with self._admission:
+            left = self._pool_live.get(pool, 1) - 1
+            if left <= 0:
+                self._pool_live.pop(pool, None)
+            else:
+                self._pool_live[pool] = left
+            self._admission.notify_all()
+
+    def admission_status(self) -> Dict[str, Any]:
+        """Per-pool in-flight jobs vs their admission bounds — the queue-
+        depth face of ctx.fleet_status()."""
+        with self._admission:
+            live = dict(self._pool_live)
+        return {
+            "mode": str(getattr(self.conf, "admission_mode", "reject")
+                        if self.conf is not None else "reject"),
+            "default_max_queued": int(
+                getattr(self.conf, "pool_max_queued", 0) or 0)
+            if self.conf is not None else 0,
+            "pools": {pool: {"in_flight": n,
+                             "max_queued": self._pool_bound(pool)}
+                      for pool, n in sorted(live.items())},
+        }
+
     # -------------------------------------------------------- submission
     def submit(self, rdd, func, partitions: Optional[List[int]] = None,
                pool: Optional[str] = None, on_task_success=None,
                transform: Optional[Callable[[list], Any]] = None
                ) -> JobFuture:
+        pool_name = pool or "default"
         if partitions is None:
             partitions = list(range(rdd.num_partitions))
-        job = _Job(rdd, func, list(partitions), on_task_success,
-                   pool=pool or "default")
-        future = JobFuture(job, self, transform)
-        with self._lock:
-            if self._stopped:
-                raise VegaError("job server is stopped")
+        # Admission BEFORE any job state exists: a rejected tenant costs
+        # nothing — no job id, no thread, no arbiter entries.
+        self._admit(pool_name)
+        # One admission slot, released exactly ONCE — whichever fires
+        # first of the settle callback and the error path below. The
+        # guard lives under the admission condition (an RLock), so a
+        # stop() force-failing the future while the error path unwinds
+        # cannot double-release and let the pool exceed its bound.
+        released: List[bool] = []
+
+        def release_once(_f=None) -> None:
+            with self._admission:
+                if released:
+                    return
+                released.append(True)
+                self._release_admission(pool_name)
+
+        job = None
+        try:
+            job = _Job(rdd, func, list(partitions), on_task_success,
+                       pool=pool_name)
+            future = JobFuture(job, self, transform)
+            # The admission slot is held for the job's whole life:
+            # released when the future settles (success, failure, cancel,
+            # or stop()'s force-fail), which is also what unblocks parked
+            # admission_mode=block submitters.
+            future.add_done_callback(release_once)
+            with self._lock:
+                if self._stopped:
+                    raise VegaError("job server is stopped")
+                if partitions:
+                    self._live[job.job_id] = future
             if partitions:
-                self._live[job.job_id] = future
+                # Inside the try: a failed thread SPAWN (RuntimeError
+                # under thread exhaustion — exactly the overload admission
+                # exists for) must not strand the admission slot and a
+                # dead _live entry forever.
+                thread = threading.Thread(
+                    target=self._drive, args=(job, future),
+                    name=f"vega-job-{job.job_id}", daemon=True)
+                thread.start()
+        except BaseException:
+            # No work started: drop the dead registration and release the
+            # admission slot (a no-op if a racing stop() already settled
+            # the future and fired the callback).
+            if job is not None:
+                with self._lock:
+                    self._live.pop(job.job_id, None)
+            release_once()
+            raise
         if not partitions:
             future._complete([])
-            return future
-        thread = threading.Thread(target=self._drive, args=(job, future),
-                                  name=f"vega-job-{job.job_id}", daemon=True)
-        thread.start()
         return future
 
     def _drive(self, job: _Job, future: JobFuture) -> None:
@@ -454,6 +599,10 @@ class JobServer:
                 return
             self._stopped = True
             futures = list(self._live.values())
+        # Unpark any submitter blocked in _admit: the stopped flag turns
+        # its wait into a crisp VegaError instead of a forever-park.
+        with self._admission:
+            self._admission.notify_all()
         for future in futures:
             future.cancel("job server stopped with the job in flight")
         deadline = time.monotonic() + timeout_s
