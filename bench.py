@@ -550,6 +550,15 @@ def main():
         if backend == "tpu":
             # v5e HBM bandwidth ~819 GB/s.
             detail["hbm_utilization_lower_bound"] = round(gbps_lb / 819, 3)
+        # Exchange planner records (DenseExchangePlanned -> MetricsListener):
+        # launches per chosen collective program, staged round total, the
+        # largest per-shard peak estimate, and launches even the ring
+        # program could not bound under dense_hbm_budget. Under the
+        # default budget the bench shapes resolve one-shot (all_to_all>0,
+        # staged/ring 0); a constrained-budget run is attributable here
+        # (benchmarks/exchange_planner_ab.py is the dedicated A/B).
+        detail["exchange_plans"] = ctx.metrics_summary().get(
+            "exchange_plans", {})
         # Tiered-store occupancy + spill/promote counters: attributes any
         # RSS/HBM movement to spill traffic (0 spills == fully resident).
         detail["storage"] = ctx.storage_status()

@@ -402,6 +402,45 @@ def config11_elastic(ctx, scale=1.0, bank=None):
             out["short_p50_s"]["elastic"])
 
 
+def config12_exchange_planner(ctx, scale=1.0, bank=None):
+    """PR 13 collective-aware exchange planner: a reduce+sort pipeline
+    whose one-shot all_to_all footprint busts a deliberately constrained
+    dense_hbm_budget, one-shot vs planner-staged
+    (benchmarks/exchange_planner_ab.py: interleaved legs, medians of 3,
+    bit-identical + est-peak<=budget + streamed-sizing accepts asserted
+    by the A/B itself). Runs in a SUBPROCESS — the A/B flips
+    process-global dense_exchange/dense_hbm_budget config and the Env is
+    a process singleton. Reported through the standard columns: host_s =
+    one-shot warm wall, device_s = planned (staged) warm wall, so
+    device_vs_host reads as the wall COST of bounding peak HBM (~1x is
+    the hope on a real chip; the CPU proxy pays the extra append
+    passes). Device-tier work — tpu_jobs/12 runs it on the chip."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows = max(100_000, int(400_000 * scale))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "benchmarks", "exchange_planner_ab.py"),
+         str(rows)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, \
+        f"exchange_planner_ab failed: {proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    acc = out["accept"]
+    assert acc["bit_identical"], "planner legs diverged"
+    assert acc["staged_on_device"], \
+        "constrained-budget exchange did not run the staged plan on device"
+    assert acc["est_peak_le_budget"], \
+        "staged plan's estimated peak exceeded the budget"
+    assert acc["streamed_exact"], "streamed fold diverged at planner sizing"
+    if bank:
+        bank(rows, out["warm_s"]["planned"])
+    return rows, out["warm_s"]["one_shot"], out["warm_s"]["planned"]
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -419,6 +458,8 @@ CONFIGS = {
          config10_frame),
     11: ("elastic fleet vs static max fleet (bursty short-job p50 + "
          "executor-seconds)", config11_elastic),
+    12: ("exchange planner one-shot vs staged under constrained HBM "
+         "budget", config12_exchange_planner),
 }
 
 

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Exchange-planner A/B (PR 13) on the real chip: the question the CPU
+# proxy cannot answer is the WALL cost of the staged plan where the
+# collectives are real ICI transfers — the proxy pays extra append
+# passes yet lands within sandbox noise of the one-shot, while on the chip the
+# bounded [group, slot] buffers trade one fused all_to_all for K
+# ppermute rounds riding neighbor links. est-peak<=budget, bit-identical
+# and the streamed 1B sizing accepts are asserted by the A/B itself; the
+# planned_vs_one_shot ratio is the number that decides whether the
+# planner's staged threshold needs tuning on hardware. One JSON line.
+cd /root/repo
+exec env VEGA_EXCHANGE_PLANNER_AB_TPU=1 \
+    python benchmarks/exchange_planner_ab.py 4000000

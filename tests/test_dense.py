@@ -2146,3 +2146,179 @@ def test_dense_unspilled_eviction_still_recomputes(dctx):
     assert r._block is None
     assert not Env.get().cache.contains_raw(dr._dense_spill_key(r))
     assert r.sum() == total  # recompute-from-lineage transparency
+
+
+# ---------------------------------------------------------------------------
+# collective-aware exchange planner (PR 13)
+# ---------------------------------------------------------------------------
+
+
+def _budget(dctx, value):
+    """Set dense_hbm_budget for the test body; returns the old value."""
+    from vega_tpu.env import Env
+
+    conf = Env.get().conf
+    old = conf.dense_hbm_budget
+    conf.dense_hbm_budget = value
+    return conf, old
+
+
+def test_exchange_planner_program_parity(dctx):
+    """Acceptance: dense_exchange=auto resolves per launch through the
+    cost model — under a deliberately small dense_hbm_budget the SAME
+    named-reduce/group/join/sort pipelines run the staged (K>1 rounds)
+    program fully on device, with estimated peak <= budget, results
+    bit-identical to the one-shot leg, and plan records readable on the
+    node and the module counters."""
+    from vega_tpu.env import Env
+    from vega_tpu.tpu import exchange_plan
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    conf = Env.get().conf
+    assert conf.dense_exchange == "auto"  # the shipped default
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 997, size=200_000).astype(np.int32)
+    vals = rng.randint(0, 1 << 20, size=200_000).astype(np.int32)
+    tk = np.arange(997, dtype=np.int32)
+    tv = (tk * 7).astype(np.int32)
+    # Unique sort keys: duplicate-key ties keep exchange ARRIVAL order,
+    # which legitimately differs between collective programs (true of
+    # ring vs all_to_all since PR 2) — uniqueness makes the sort leg's
+    # bit-identical claim well-defined.
+    skeys = rng.permutation(200_000).astype(np.int32)
+
+    def pipelines():
+        src = dctx.dense_from_numpy(keys, vals)
+        nodes = {
+            "rbk": src.reduce_by_key(op="add"),
+            "gbk": src.group_by_key(),
+            "join": src.join(dctx.dense_from_numpy(tk, tv)),
+            "sort": dctx.dense_from_numpy(skeys, vals).sort_by_key(),
+        }
+        out = {
+            "rbk": dict(nodes["rbk"].collect()),
+            "gbk": {k: sorted(vs) for k, vs in nodes["gbk"].collect()},
+            "join": sorted(nodes["join"].collect()),
+            "sort": nodes["sort"].collect(),
+        }
+        return nodes, out
+
+    # Leg A: forced one-shot all_to_all at the default budget.
+    old_mode = conf.dense_exchange
+    conf.dense_exchange = "all_to_all"
+    # The warm table plan would elide the rbk exchange entirely on rerun
+    # — keep the planner exercised on every leg.
+    old_table = conf.dense_table_plan
+    conf.dense_table_plan = "off"
+    try:
+        nodes_a, leg_a = pipelines()
+    finally:
+        conf.dense_exchange = old_mode
+    for node in nodes_a.values():
+        assert node._exchange_plan.program == "all_to_all"
+
+    # Leg B: auto under a budget the one-shot footprint busts (the
+    # 200k-row operand block is 32768 rows/shard x 8 B; the one-shot's
+    # [n, slot] buffers put its estimate ~1.31 MB/shard, and the join's
+    # JOINT two-sided launch ~1.65 MB). 1.28 MB sits in the window where
+    # every pipeline stages at K>1 rounds: below the one-shot estimate
+    # and above the join's smallest multi-round staged estimate (g=2,
+    # ~1.25 MB with the 3x staged slot coefficient).
+    conf2, old = _budget(dctx, 1_280_000)
+    exchange_plan.reset_plan_counters()
+    try:
+        nodes_b, leg_b = pipelines()
+    finally:
+        conf2.dense_hbm_budget = old
+        conf.dense_table_plan = old_table
+    assert leg_b == leg_a  # bit-identical across programs
+    counters = exchange_plan.plan_counters()
+    assert counters.get("staged", 0) >= 4, counters
+    for name, node in nodes_b.items():
+        assert isinstance(node, DenseRDD)  # completed on device
+        plan = node._exchange_plan
+        assert plan.program == "staged", (name, plan)
+        assert plan.rounds > 1, (name, plan)
+        assert plan.fits and plan.est_peak_bytes <= 1_280_000, (name, plan)
+
+    # Host-tier truth for one pipeline (the standing parity oracle).
+    host = host_expected_reduce_by_key(zip(keys.tolist(), vals.tolist()),
+                                       lambda a, b: (a + b) & 0xFFFFFFFF)
+    host = {k: ((s + 2**31) % 2**32) - 2**31 for k, s in host.items()}
+    assert leg_b["rbk"] == host
+
+
+def test_exchange_planner_ring_when_no_group_fits(dctx):
+    """A budget below even the smallest staged group's estimate resolves
+    to ring — the single-bounded-buffer extreme — and still completes
+    with identical results (fits may be False: the planner bounds, it
+    never refuses)."""
+    from vega_tpu.tpu import exchange_plan
+
+    rng = np.random.RandomState(4)
+    keys = rng.randint(0, 500, size=120_000).astype(np.int32)
+    vals = rng.randint(0, 1000, size=120_000).astype(np.int32)
+
+    src = dctx.dense_from_numpy(keys, vals)
+    expected = {k: sorted(vs) for k, vs in src.group_by_key().collect()}
+
+    conf, old = _budget(dctx, 500_000)
+    exchange_plan.reset_plan_counters()
+    try:
+        node = dctx.dense_from_numpy(keys, vals).group_by_key()
+        got = {k: sorted(vs) for k, vs in node.collect()}
+    finally:
+        conf.dense_hbm_budget = old
+    assert got == expected
+    assert node._exchange_plan.program == "ring"
+    assert exchange_plan.plan_counters().get("ring", 0) >= 1
+
+
+def test_exchange_planner_overflow_retry_keeps_contract(dctx):
+    """The staged plan keeps the grown-capacity retry contract: a
+    poisoned (too-small) capacity hint overflows on round 0 and the
+    retry — re-planned at the exact histogram capacities, crossing
+    PROGRAMS mid-loop when the bigger buffers bust the budget — lands
+    the correct result."""
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, 700, size=200_000).astype(np.int32)
+    vals = rng.randint(0, 1000, size=200_000).astype(np.int32)
+    src = dctx.dense_from_numpy(keys, vals)
+    expected = {k: sorted(vs) for k, vs in src.group_by_key().collect()}
+
+    node = dctx.dense_from_numpy(keys, vals).group_by_key()
+    hint_store = dctx.__dict__.setdefault("_dense_capacity_hints", {})
+    hint_store[node._hint_key()] = (64, 256)  # far too small: must flag
+    conf, old = _budget(dctx, 1_100_000)
+    dctx.__dict__["_dense_no_defer"] = True  # inline blocking retry loop
+    try:
+        got = {k: sorted(vs) for k, vs in node.collect()}
+    finally:
+        dctx.__dict__["_dense_no_defer"] = False
+        conf.dense_hbm_budget = old
+    assert got == expected
+    assert node._last_attempts >= 2  # round 0 overflowed, retry landed
+    # The retry's histogram-sized buffers bust the 1.1 MB budget on the
+    # one-shot program, so the landing launch ran staged.
+    assert node._exchange_plan.program == "staged"
+    assert node._exchange_plan.rounds > 1
+
+
+def test_exchange_planner_events_aggregated(dctx):
+    """DenseExchangePlanned rides the bus into MetricsListener: program
+    counts, staged round totals and the peak estimate are queryable from
+    the driver (the bench.py `exchange_plans` detail)."""
+    rng = np.random.RandomState(6)
+    keys = rng.randint(0, 300, size=150_000).astype(np.int32)
+    vals = np.ones(150_000, dtype=np.int32)
+    conf, old = _budget(dctx, 1_100_000)
+    try:
+        node = dctx.dense_from_numpy(keys, vals).group_by_key()
+        node.block()
+    finally:
+        conf.dense_hbm_budget = old
+    xp = dctx.metrics_summary()["exchange_plans"]
+    assert xp["staged"] >= 1
+    assert xp["staged_rounds"] >= 2
+    assert 0 < xp["max_est_peak_bytes"] <= 1_100_000
+    assert xp["over_budget"] == 0

@@ -865,6 +865,60 @@ def test_vg013_fires_in_real_tree_shape(tmp_path):
     assert _rules(res) == ["VG013"]
 
 
+# ---------------------------------------------------------------- VG014
+def test_vg014_fires_on_contract_violations(tmp_path):
+    # Missing the n_shards==1 passthrough gate.
+    res = _lint(tmp_path, "vega_tpu/tpu/newx.py", """\
+        def shiny_exchange(cols, count, bucket, n_shards, slot_capacity,
+                           out_capacity):
+            return cols, count, False
+        """, select=["VG014"])
+    assert _rules(res) == ["VG014"]
+    assert "single-shard gate" in res.findings[0].message
+    # Gate present but a return site breaks the triple contract
+    # (run_lint sweeps the whole tmp tree, so filter to this fixture).
+    res = _lint(tmp_path, "vega_tpu/tpu/newx2.py", """\
+        def lossy_exchange(cols, count, bucket, n_shards, slot_capacity,
+                           out_capacity):
+            if n_shards == 1:
+                return passthrough_exchange(cols, count, 4, out_capacity)
+            return cols, count
+        """, select=["VG014"])
+    f2 = [f for f in res.findings if "newx2" in f.path]
+    assert [f.rule for f in f2] == ["VG014"]
+    assert "3-tuple" in f2[0].message
+
+
+def test_vg014_silent_on_conforming_and_exempt_shapes(tmp_path):
+    # Conforming implementation: gate + triple returns + delegation.
+    clean = _lint(tmp_path, "vega_tpu/tpu/newx3.py", """\
+        def blocked_exchange(cols, count, bucket, n_shards, slot_capacity,
+                             out_capacity, group=1):
+            if n_shards == 1:
+                return passthrough_exchange(cols, count, 4, out_capacity)
+            if group == 1:
+                return ring_exchange(cols, count, bucket, n_shards,
+                                     slot_capacity, out_capacity)
+            return cols, count, False
+        """, select=["VG014"])
+    assert not clean.findings
+    # Exempt: no bucket/n_shards signature (the planner shape), private
+    # helpers, and anything outside vega_tpu/tpu/.
+    exempt = _lint(tmp_path, "vega_tpu/tpu/newx4.py", """\
+        def plan_some_exchange(n_shards, capacity, slot_capacity):
+            return capacity
+
+        def _inner_exchange(cols, count, bucket, n_shards):
+            return cols
+        """, select=["VG014"])
+    assert not exempt.findings
+    out = _lint(tmp_path, "vega_tpu/other/newx5.py", """\
+        def weird_exchange(cols, count, bucket, n_shards):
+            return cols
+        """, select=["VG014"])
+    assert not out.findings
+
+
 # ---------------------------- mutation self-tests against the real tree
 import os as _os
 import shutil as _shutil

@@ -321,10 +321,13 @@ def test_streamed_take_ordered_and_top(ctx):
     assert s.take_ordered(3, key=lambda x: -x) == \
         sorted(vals.tolist(), reverse=True)[:3]
 
-    # streamed range end-to-end (the 1B path's exact shape, small)
+    # streamed range end-to-end (the 1B path's exact shape, small).
+    # 512 KiB: small enough that even the planner's bounded (staged/ring)
+    # footprint — ~3x vs the legacy 6x, so sources this size now fit a
+    # 1 MiB budget resident — still forces streaming.
     from vega_tpu.env import Env
     old = Env.get().conf.dense_hbm_budget
-    Env.get().conf.dense_hbm_budget = 1 << 20
+    Env.get().conf.dense_hbm_budget = 1 << 19
     try:
         big = ctx.dense_range(60_000)
         from vega_tpu.tpu.stream import StreamedDenseRDD
@@ -352,3 +355,53 @@ def test_streamed_accumulator_capacity_bounded(ctx):
     assert red._block.capacity <= 2048, red._block.capacity
     got = dict(red.collect())
     assert got[0] == sum(x for x in range(80_000) if x % 1_000 == 0)
+
+
+def test_planner_chunk_sizing_drops_chunk_count(ctx):
+    """PR 13 satellite: on a synthetic over-budget source the planner's
+    per-exchange footprint estimate (bounded staged/ring transients)
+    yields BIGGER chunks — fewer passes — than the conservative 6x rule,
+    while the legacy rule stays the fallback for mesh-less callers and
+    forced exchange modes."""
+    from vega_tpu.env import Env
+    from vega_tpu.tpu import mesh as mesh_lib
+
+    # The streamed-1B arithmetic shape (pure sizing — no device work).
+    # Mid scales can quantize both rules onto the same pow2/1M-multiple
+    # chunk bucket; the 1B shape is the one the pass count matters at.
+    n_rows, rb, budget = 1_000_000_000, 8, 4 << 30
+    n = mesh_lib.default_mesh().size
+    legacy = planned_chunk_rows(n_rows, rb, budget)  # no mesh: 6x rule
+    planned = planned_chunk_rows(n_rows, rb, budget, n_shards=n)
+    assert legacy is not None and planned is not None
+    assert planned > legacy  # bigger chunks...
+    legacy_chunks = -(-n_rows // legacy)
+    planned_chunks = -(-n_rows // planned)
+    assert planned_chunks < legacy_chunks  # ...fewer passes
+
+    # Forced (non-auto) exchange modes keep the conservative rule: no
+    # plan is available when the program is pinned.
+    conf = Env.get().conf
+    old = conf.dense_exchange
+    conf.dense_exchange = "all_to_all"
+    try:
+        forced = planned_chunk_rows(n_rows, rb, budget, n_shards=n)
+    finally:
+        conf.dense_exchange = old
+    assert forced == legacy
+
+    # End-to-end: the streamed reduce is correct at the planner sizing.
+    conf_budget = conf.dense_hbm_budget
+    conf.dense_hbm_budget = 1 << 19
+    try:
+        s = ctx.dense_range(120_000)
+        from vega_tpu.tpu.stream import StreamedDenseRDD
+        assert isinstance(s, StreamedDenseRDD)
+        got = dict(s.map(lambda x: (x % 7, x))
+                   .reduce_by_key(op="add").collect())
+    finally:
+        conf.dense_hbm_budget = conf_budget
+    exp = {}
+    for x in range(120_000):
+        exp[x % 7] = exp.get(x % 7, 0) + x
+    assert got == exp

@@ -149,10 +149,17 @@ class Configuration:
     # (one lineage unpickle per stage per executor, not per task). An
     # evicted hash recovers via the need_binary re-ship.
     task_binary_cache_entries: int = 32
-    # Dense-tier shuffle collective: "all_to_all" (one fused collective,
-    # [n_shards x slot] peak buffer) or "ring" (n-1 ppermute steps, one-slot
-    # peak buffer — for big blocks on big meshes). See tpu/ring.py.
-    dense_exchange: str = "all_to_all"
+    # Dense-tier shuffle collective. "auto" (default) routes every
+    # exchange launch through the collective-aware planner
+    # (tpu/exchange_plan.py): one-shot "all_to_all" when its estimated
+    # per-shard transient peak fits dense_hbm_budget, the blocked
+    # "staged" program (K sub-rounds of peer groups over shifted
+    # ppermutes, K chosen so the estimate fits) when it doesn't, "ring"
+    # (single bounded buffer, n-1 rounds — the minimum possible peak)
+    # when no larger group fits. Explicit "all_to_all" / "ring" /
+    # "staged" force that program per run. See tpu/ring.py and
+    # tpu/exchange_plan.py.
+    dense_exchange: str = "auto"
     # Cluster membership file for distributed mode (reference: ~/hosts.conf,
     # src/hosts.rs); None -> VEGA_TPU_HOSTS_FILE -> ~/hosts.conf -> local.
     hosts_file: Optional[str] = None

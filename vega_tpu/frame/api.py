@@ -171,13 +171,15 @@ class DataFrame:
 
     _HINT_VALUES = {
         "tier": ("auto", "device", "host"),
-        "exchange": ("all_to_all", "ring"),
+        "exchange": ("auto", "all_to_all", "ring", "staged"),
         "shuffle_plan": ("pull", "push"),
     }
 
     def hint(self, **hints) -> "DataFrame":
         """Planner knobs: fuse=, pushdown=, tier=('auto'|'device'|'host'),
-        exchange=('all_to_all'|'ring'), shuffle_plan=('pull'|'push')."""
+        exchange=('auto'|'all_to_all'|'ring'|'staged') — 'auto' routes
+        through the collective-aware exchange planner —
+        shuffle_plan=('pull'|'push')."""
         unknown = set(hints) - set(planner_lib.DEFAULT_OPTIONS)
         if unknown:
             raise VegaError(

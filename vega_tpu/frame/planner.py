@@ -9,9 +9,10 @@ Two lowerings of the SAME optimized logical plan:
   program per stage; groupBy/agg lowers onto the named-op segment reduce
   (uniform monoid) or a generated traced TUPLE combiner (mixed monoids) —
   monoid selection is by aggregate NAME, never value probing; join/sort
-  lower onto the device exchange kernels, with the per-exchange plugin
-  (`exchange=all_to_all|ring`) chosen by a size heuristic or the frame's
-  `hint()`.
+  lower onto the device exchange kernels, with the per-exchange program
+  chosen by the frame's `hint(exchange=)` or the shared exchange cost
+  model (tpu/exchange_plan.py — the same planner the node-level
+  `dense_exchange=auto` resolution runs).
 * **Host tier** — the identical verbs over ordinary RDD lineages
   (columnar blocks until the first exchange, row tuples after), produced
   whenever the device trace rejects an expression (opaque Python UDFs,
@@ -41,13 +42,11 @@ DEFAULT_OPTIONS = {
     "fuse": True,        # whole-stage fusion (False: one program per verb)
     "pushdown": True,    # column pruning + predicate pushdown into scans
     "tier": "auto",      # auto | device | host
-    "exchange": None,    # device exchange plugin override (all_to_all|ring)
+    "exchange": None,    # device exchange override
+                         # (auto|all_to_all|ring|staged)
     "shuffle_plan": None,  # host-tier distributed shuffle plan (pull|push)
 }
 
-# Ring exchange bounds peak HBM (tpu/ring.py); prefer it once a single
-# exchange's resident working set is a meaningful slice of the budget.
-_RING_FRACTION = 0.25
 
 
 class Compiled:
@@ -242,21 +241,41 @@ def _key_dtype(node, allowed) -> None:
 
 def _pick_exchange(ctx, options: dict, st: _DState, width: int,
                    notes: List[str]) -> Optional[str]:
-    """Per-exchange plugin policy: an explicit hint wins; otherwise prefer
-    the ring exchange (bounded peak HBM) when the estimated working set is
-    a large slice of the budget — decided from source metadata, never by
-    materializing."""
+    """Per-exchange plugin policy: an explicit hint wins; otherwise
+    consult the SAME cost model the node-level dense_exchange=auto
+    resolution runs (tpu/exchange_plan.py — one source of truth, not a
+    drifting copy of its size heuristic): when the model predicts the
+    one-shot footprint busts the HBM budget at this exchange's estimated
+    rows, opt the exchange into planner resolution explicitly and note
+    the predicted program. Decided from source metadata, never by
+    materializing (pure plan algebra — VG013)."""
     if options["exchange"] is not None:
         return options["exchange"]
     if st.est_rows is None:
         return None
     from vega_tpu.env import Env
+    from vega_tpu.tpu import exchange_plan, mesh as mesh_lib
 
+    if getattr(Env.get().conf, "dense_exchange", "auto") != "auto":
+        # A globally forced program (the A/B legs, TPU tuning runs) must
+        # reach the launch untouched: returning "auto" here would stamp
+        # a node-level mode that beats the global config.
+        return None
     budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
-    est = st.est_rows * 4 * max(width, 1)
-    if est * 6 > _RING_FRACTION * budget:  # ~6x exchange footprint
-        notes.append(f"exchange=ring (est {est >> 20} MiB working set)")
-        return "ring"
+    # Device lowering already built mesh-bound source nodes, so the
+    # default mesh is resolved by the time any exchange is picked.
+    plan = exchange_plan.predict_for_rows(
+        st.est_rows, 4 * max(width, 1), mesh_lib.default_mesh().size,
+        budget)
+    if plan.program != "all_to_all":
+        notes.append(
+            f"exchange=auto (planner predicts {plan.program}, est peak "
+            f"{plan.est_peak_bytes >> 20} MiB vs budget "
+            f"{budget >> 20} MiB)")
+    # Never stamp a node-level mode for the default path: the launch
+    # reads the global config, so dense_exchange stays runtime-flippable
+    # (a compiled frame re-executed under a later global force runs the
+    # forced program, not a pinned "auto").
     return None
 
 

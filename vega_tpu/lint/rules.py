@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG013: the project invariants as AST checks.
+"""vegalint rules VG001–VG014: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -10,7 +10,9 @@ cannot see at runtime.
 
 VG001–VG008 are the per-file (and lock-graph) invariants from PRs 3 and
 7; VG013 (PR 11) keeps frame planning pure — no materialization at
-plan-build time. VG009–VG012 are the cross-process CONTRACT rules: a
+plan-build time; VG014 (PR 13) holds every exchange implementation to
+the (cols, count, overflow) / n_shards==1 contract the collective-aware
+planner relies on. VG009–VG012 are the cross-process CONTRACT rules: a
 shared per-file
 index pass (``_contract_extract``, cached by the engine) reduces each
 file to its protocol/config/event surfaces, and global combines join
@@ -1354,3 +1356,93 @@ def vg013(ctx: FileCtx) -> Iterator[Finding]:
                 f"'.{node.attr}' read inside frame planning code — that "
                 "is a device materialization/transfer; planning must stay "
                 "pure (docs/LINTING.md VG013)")
+
+
+# ---------------------------------------------------------------------------
+# VG014 — exchange implementations must keep the exchange contract
+# ---------------------------------------------------------------------------
+# CLAUDE.md: "Every new exchange implementation keeps the (cols, count,
+# overflow) contract and the n_shards==1 passthrough." With the planner
+# (tpu/exchange_plan.py) choosing among exchange programs per launch, a
+# new implementation that forgets either half would corrupt results only
+# on the meshes/budgets that happen to select it — exactly the class a
+# machine check must hold. An exchange ENTRY POINT is a public function
+# in vega_tpu/tpu/ whose name ends in `_exchange` and takes the canonical
+# call shape's `bucket` and `n_shards` arguments — what the exchange
+# sites in dense_rdd.py actually invoke (passthrough_exchange — the
+# shared gate target, which has neither by design — private `_`-prefixed
+# helpers, and non-implementation functions like the planner's
+# plan_exchange are exempt by that signature test). Each must (a)
+# contain the single-shard gate: an `if n_shards == 1:` branch returning
+# a call to passthrough_exchange or a delegation to another *_exchange
+# function, and (b) return the triple at every return site — a literal
+# 3-tuple or such a delegation.
+
+_VG014_DIR = ("vega_tpu", "tpu")
+
+
+def _vg014_is_exchange_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _last_name(node.func)
+    return name is not None and name.endswith("_exchange")
+
+
+def _vg014_gate_ok(fn: ast.AST) -> bool:
+    """An `if n_shards == 1:` whose body returns an exchange call."""
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        if not (isinstance(t, ast.Compare) and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Eq)):
+            continue
+        sides = (t.left, t.comparators[0])
+        names = [s.id for s in sides if isinstance(s, ast.Name)]
+        ones = [s for s in sides
+                if isinstance(s, ast.Constant) and s.value == 1]
+        if "n_shards" not in names or not ones:
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Return) \
+                    and _vg014_is_exchange_call(stmt.value):
+                return True
+    return False
+
+
+@rule("VG014", "exchange entry point violates the exchange contract")
+def vg014(ctx: FileCtx) -> Iterator[Finding]:
+    if not ctx.in_dir(*_VG014_DIR):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, _FUNC_DEFS):
+            continue
+        name = node.name
+        if not name.endswith("_exchange") or name.startswith("_") \
+                or name == "passthrough_exchange":
+            continue
+        args = node.args
+        arg_names = {a.arg for a in args.posonlyargs + args.args
+                     + args.kwonlyargs}
+        if "n_shards" not in arg_names or "bucket" not in arg_names:
+            continue  # not the exchange call shape (e.g. the planner)
+        if not _vg014_gate_ok(node):
+            yield Finding(
+                "VG014", ctx.display, node.lineno, node.col_offset + 1,
+                f"exchange entry point '{name}' is missing the "
+                "single-shard gate (`if n_shards == 1: return "
+                "passthrough_exchange(...)`)" " — every exchange "
+                "implementation must keep the n_shards==1 passthrough "
+                "(CLAUDE.md; docs/LINTING.md VG014)")
+        for ret in _own_nodes(node):
+            if not isinstance(ret, ast.Return):
+                continue
+            v = ret.value
+            triple = isinstance(v, ast.Tuple) and len(v.elts) == 3
+            if not triple and not _vg014_is_exchange_call(v):
+                yield Finding(
+                    "VG014", ctx.display, ret.lineno, ret.col_offset + 1,
+                    f"return in exchange entry point '{name}' is neither "
+                    "a (cols, count, overflow) 3-tuple nor a delegation "
+                    "to another exchange — the exchange contract's "
+                    "return shape (CLAUDE.md; docs/LINTING.md VG014)")

@@ -283,6 +283,29 @@ class ShuffleFetchCompleted(Event):
 
 
 @dataclasses.dataclass
+class DenseExchangePlanned(Event):
+    """One dense exchange launch was planned by the collective-aware
+    planner (tpu/exchange_plan.py): `program` is the collective shape it
+    resolved to (one-shot all_to_all / staged K-round / ring), `rounds`
+    its collective round count, `est_peak_bytes` the modeled per-shard
+    transient-HBM high-water mark the choice was made on, against
+    `budget_bytes` (Configuration.dense_hbm_budget). `fits` is False
+    only when even the minimum-peak program's estimate exceeds the
+    budget (the exchange still runs — the planner bounds, it never
+    refuses). Elided (passthrough) and single-shard exchanges plan
+    nothing and emit nothing."""
+
+    rdd_id: int = -1
+    program: str = ""       # "all_to_all" | "staged" | "ring"
+    rounds: int = 0
+    group: int = 0          # peers per staged round
+    est_peak_bytes: int = 0
+    budget_bytes: int = 0
+    n_shards: int = 0
+    fits: bool = True
+
+
+@dataclasses.dataclass
 class ShufflePushCompleted(Event):
     """One map task finished pushing its bucket row to the owning servers
     (shuffle_plan=push; dependency._push_row). `merged` buckets fed a
@@ -479,6 +502,16 @@ class MetricsListener(Listener):
             "stored": 0, "duplicates": 0, "failed": 0, "targets": 0,
             "wall_s": 0.0,
         }
+        # Dense exchange planner (DenseExchangePlanned): launches per
+        # chosen program, staged round total, the largest per-shard peak
+        # estimate seen, and how many launches could not be bounded under
+        # the budget even by the ring program. bench.py surfaces these as
+        # the `exchange_plans` detail next to the HBM section.
+        self.exchange_plans: Dict[str, Any] = {
+            "all_to_all": 0, "staged": 0, "ring": 0,
+            "staged_rounds": 0, "max_est_peak_bytes": 0,
+            "over_budget": 0,
+        }
         # Task-dispatch-plane counters (TaskEnd.dispatch): driver-side
         # serialized bytes per leg, stage binaries actually shipped vs
         # worker cache hits, need_binary recoveries. benchmarks/
@@ -607,6 +640,15 @@ class MetricsListener(Listener):
                 self.fetch_premerged_buckets += event.premerged_buckets
                 self.fetch_local_blob_reads += event.local_blob_reads
                 self.fetch_merged_rtts += event.merged_rtts
+            elif isinstance(event, DenseExchangePlanned):
+                xp = self.exchange_plans
+                xp[event.program] = xp.get(event.program, 0) + 1
+                if event.program == "staged":
+                    xp["staged_rounds"] += event.rounds
+                if event.est_peak_bytes > xp["max_est_peak_bytes"]:
+                    xp["max_est_peak_bytes"] = event.est_peak_bytes
+                if not event.fits:
+                    xp["over_budget"] += 1
             elif isinstance(event, ShufflePushCompleted):
                 sp = self.shuffle_push
                 sp["pushes"] += 1
@@ -672,5 +714,6 @@ class MetricsListener(Listener):
                 "shuffle_push": {**self.shuffle_push,
                                  "wall_s": round(
                                      self.shuffle_push["wall_s"], 6)},
+                "exchange_plans": dict(self.exchange_plans),
                 "dispatch": dict(self.dispatch),
             }

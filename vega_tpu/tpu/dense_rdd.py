@@ -22,8 +22,9 @@ retried with exact histogram-based sizes on overflow.
 Related public work: DrJAX (arXiv:2403.07128) expresses MapReduce primitives
 as JAX transforms the same way the dense tier lowers RDD ops to shard_map
 programs; Exoshuffle (arXiv:2203.05072) argues for application-level,
-pluggable shuffles — here the exchange implementation is a per-op plugin
-(all_to_all | ring).
+pluggable shuffles — here the exchange implementation is planned per
+launch (all_to_all | staged | ring, cost-modeled under the HBM budget by
+tpu/exchange_plan.py, or forced via dense_exchange).
 """
 
 from __future__ import annotations
@@ -2500,11 +2501,12 @@ class _SourceRDD(DenseRDD):
 
 def dense_range(ctx, n: int, num_partitions=None, dtype=None,
                 chunk_rows: Optional[int] = None):
-    """Device iota source. When estimated block bytes times the exchange
-    footprint (~6x transient copies) exceed Configuration.dense_hbm_budget,
-    returns a StreamedDenseRDD that flows chunk by chunk through the mesh
-    instead of materializing whole (the 1B-row single-chip path); pass
-    chunk_rows to force streaming."""
+    """Device iota source. When the estimated exchange footprint over the
+    whole block (the exchange planner's peak estimate under
+    dense_exchange=auto; ~6x block bytes otherwise) exceeds
+    Configuration.dense_hbm_budget, returns a StreamedDenseRDD that flows
+    chunk by chunk through the mesh instead of materializing whole (the
+    1B-row single-chip path); pass chunk_rows to force streaming."""
     from vega_tpu.env import Env
     from vega_tpu.tpu.stream import planned_chunk_rows, streamed_range
 
@@ -2513,7 +2515,7 @@ def dense_range(ctx, n: int, num_partitions=None, dtype=None,
     rows = planned_chunk_rows(
         n, jnp.dtype(dtype).itemsize,
         getattr(Env.get().conf, "dense_hbm_budget", 4 << 30),
-        chunk_rows,
+        chunk_rows, n_shards=mesh.size,
     )
     if rows is not None and rows < n:
         return streamed_range(ctx, n, rows, mesh, dtype)
@@ -2631,7 +2633,7 @@ def dense_load_npz(ctx, path: str, chunk_rows: Optional[int] = None):
     rows = planned_chunk_rows(
         n, bytes_per_row,
         getattr(Env.get().conf, "dense_hbm_budget", 4 << 30),
-        chunk_rows,
+        chunk_rows, n_shards=mesh_lib.default_mesh().size,
     )
     if rows is not None and rows < n:
         # Reuse the already-loaded host columns — no second npz read.
@@ -2700,12 +2702,11 @@ def _with_exchange(node, exchange: Optional[str]):
     return node
 
 
-def _get_exchange(mode: str):
-    if mode == "ring":
-        from vega_tpu.tpu.ring import ring_exchange
-
-        return ring_exchange
-    return kernels.bucket_exchange
+# The elided / planner-bypassed token builds program-cache keys on paths
+# that never launch a collective (passthrough or single-shard): the key
+# slot stays populated so elided and planned programs of one lineage
+# never collide.
+_X_ELIDED = ("elided",)
 
 
 def _lo_of(names) -> Optional[str]:
@@ -2902,8 +2903,14 @@ def _unrepaired_raise():
 class _ExchangeRDD(DenseRDD):
     """Common driver loop: run the fused exchange program, check overflow
     flags, retry with grown capacities (capacity-factor pattern). The
-    collective implementation (all_to_all vs ring ppermute) comes from
-    Configuration.dense_exchange or the node's exchange_mode attribute."""
+    collective implementation (one-shot all_to_all, staged K-round, or
+    ring) is resolved per launch by the cost model in
+    tpu/exchange_plan.py under Configuration.dense_exchange="auto", or
+    forced by an explicit mode / the node's exchange_mode attribute."""
+
+    # Last resolved plan; stays None on single-shard meshes (the
+    # passthrough plans nothing) so readers must null-check.
+    _exchange_plan = None
 
     def _attach_pending(self, blk: Block) -> Block:
         """Register the deferred entry _run_exchange left behind (if any)
@@ -2923,12 +2930,65 @@ class _ExchangeRDD(DenseRDD):
         if mode is None:
             from vega_tpu.env import Env
 
-            mode = getattr(Env.get().conf, "dense_exchange", "all_to_all")
+            mode = getattr(Env.get().conf, "dense_exchange", "auto")
         return mode
 
     @exchange_mode.setter
     def exchange_mode(self, mode: str) -> None:
         self._exchange_mode = mode
+
+    def _resolve_exchange(self, blks, slot_capacity: int,
+                          out_capacity: int):
+        """Resolve the exchange implementation for ONE launch through the
+        collective-aware planner (tpu/exchange_plan.py): explicit modes
+        map straight to their program; "auto" picks the fewest-rounds
+        program whose estimated per-shard peak fits dense_hbm_budget
+        (all_to_all -> staged -> ring). Returns (exchange_callable,
+        plan_token); the token goes into the program-cache key — the
+        budget is config, not key, so the RESOLVED choice must be.
+
+        Called from inside build(slot, out_cap): capacities are only
+        known per launch (hints, histograms, growth retries), and a
+        retry's grown slot may legitimately shift the plan. `blks` are
+        the operand blocks actually exchanged — a join passes both
+        non-elided sides, and the estimate models the JOINT launch
+        footprint (both operands and outputs live together, the
+        costlier side's transients on top), not the max of the sides.
+        Records the plan on the node (_exchange_plan), the module
+        counters, and the event bus (DenseExchangePlanned ->
+        MetricsListener) for observability."""
+        from vega_tpu.env import Env
+        from vega_tpu.tpu import exchange_plan
+
+        n = self.mesh.size
+        if n == 1:
+            # Passthrough territory: nothing to plan, nothing to record.
+            return kernels.bucket_exchange, ("single",)
+        budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
+        plan = exchange_plan.plan_exchange(
+            n_shards=n,
+            capacity=max(b.capacity for b in blks),
+            slot_capacity=slot_capacity,
+            out_capacity=out_capacity,
+            row_bytes=max(exchange_plan.block_row_bytes(b) for b in blks),
+            budget_bytes=budget,
+            mode=self.exchange_mode,
+            blocks=[(b.capacity, exchange_plan.block_row_bytes(b))
+                    for b in blks],
+        )
+        self._exchange_plan = plan
+        exchange_plan.record_plan(plan)
+        bus = getattr(self.context, "bus", None)
+        if bus is not None:
+            from vega_tpu.scheduler import events as ev
+
+            bus.post(ev.DenseExchangePlanned(
+                rdd_id=self.rdd_id, program=plan.program,
+                rounds=plan.rounds, group=plan.group,
+                est_peak_bytes=plan.est_peak_bytes,
+                budget_bytes=budget, n_shards=n, fits=plan.fits,
+            ))
+        return exchange_plan.exchange_callable(plan), plan.cache_token()
 
     def _hash_histogram(self, blk: Block,
                         chain=()) -> Optional[np.ndarray]:
@@ -3447,7 +3507,6 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         blk = root.block_spec()  # we register our own pending entry
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
-        exchange = _get_exchange(self.exchange_mode)
         sort_impl = _sort_impl()
         this = _detach(self)  # _segment_reduce state without the node
         # Wide int64 adds track signed overflow through the whole exchange
@@ -3618,6 +3677,11 @@ class _ReduceByKeyRDD(_ExchangeRDD):
         self._table_plan = False
 
         def build(slot, out_cap):
+            exchange, x_tok = ((kernels.bucket_exchange, _X_ELIDED)
+                               if elide else
+                               self._resolve_exchange((blk,), slot,
+                                                      out_cap))
+
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(in_names, col_arrays))
                 cols, count = _apply_chain(chain, cols, counts[0])
@@ -3712,7 +3776,7 @@ class _ReduceByKeyRDD(_ExchangeRDD):
 
             key = ("rbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide, elide_sorted,
-                   self.exchange_mode, self._op or _fp(self._func),
+                   self.exchange_mode, x_tok, self._op or _fp(self._func),
                    track_sovf, learn_range, plan, sort_impl)
             prog = _cached_program(
                 key,
@@ -3812,10 +3876,14 @@ class _GroupByKeyRDD(_ExchangeRDD):
         blk = root.block_spec()  # we register our own pending entry
         in_names = list(blk.cols)
         names = [nm for nm, _ in self.parent._schema()]
-        exchange = _get_exchange(self.exchange_mode)
         sort_impl = _sort_impl()
 
         def build(slot, out_cap):
+            exchange, x_tok = ((kernels.bucket_exchange, _X_ELIDED)
+                               if elide else
+                               self._resolve_exchange((blk,), slot,
+                                                      out_cap))
+
             def prog_fn(counts, *col_arrays):
                 cols = dict(zip(in_names, col_arrays))
                 cols, count = _apply_chain(chain, cols, counts[0])
@@ -3840,7 +3908,7 @@ class _GroupByKeyRDD(_ExchangeRDD):
 
             key = ("gbk", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap, elide,
-                   elide_sorted, self.exchange_mode, sort_impl)
+                   elide_sorted, self.exchange_mode, x_tok, sort_impl)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(
@@ -3959,7 +4027,6 @@ class _JoinRDD(_ExchangeRDD):
         rblk = r_root.block_spec()
         l_in = list(lblk.cols)
         r_in = list(rblk.cols)
-        exchange = _get_exchange(self.exchange_mode)
         # Key layout is aligned by _align_keys before a _JoinRDD is built:
         # both sides carry the same key columns (single, or (KEY, KEY_LO)).
         lschema = dict(self.left._schema())
@@ -3975,7 +4042,7 @@ class _JoinRDD(_ExchangeRDD):
         join_cap_used: List[int] = [0]
         n_l = 1 + len(l_in)  # counts + left root columns
 
-        def one_side(cols, count, elide, slot_pair, out_cap):
+        def one_side(cols, count, elide, slot_pair, out_cap, exchange):
             if elide:
                 return kernels.passthrough_exchange(
                     cols, count, cols[KEY].shape[0], out_cap
@@ -3988,6 +4055,13 @@ class _JoinRDD(_ExchangeRDD):
         def build(slot_pair, out_cap):
             join_cap = join_cap_override[0] or out_cap
             join_cap_used[0] = join_cap
+            if l_elide and r_elide:
+                exchange, x_tok = kernels.bucket_exchange, _X_ELIDED
+            else:
+                moving = [b for b, el in ((lblk, l_elide), (rblk, r_elide))
+                          if not el]
+                exchange, x_tok = self._resolve_exchange(
+                    moving, slot_pair, out_cap)
 
             def prog_fn(*args):
                 lc, *lkv = args[:n_l]
@@ -3999,10 +4073,10 @@ class _JoinRDD(_ExchangeRDD):
                     r_chain, dict(zip(r_in, rkv)), rc[0]
                 )
                 lcols, lcount, lof = one_side(
-                    lcols, lcount, l_elide, slot_pair, out_cap
+                    lcols, lcount, l_elide, slot_pair, out_cap, exchange
                 )
                 rcols, rcount, rof = one_side(
-                    rcols, rcount, r_elide, slot_pair, out_cap
+                    rcols, rcount, r_elide, slot_pair, out_cap, exchange
                 )
                 joined, jcount, jtotal = kernels.merge_join_expand(
                     lcols, lcount, rcols, rcount, KEY, join_cap,
@@ -4023,8 +4097,8 @@ class _JoinRDD(_ExchangeRDD):
                  tuple(r_in), _chain_fp(l_chain), _chain_fp(r_chain),
                  slot_pair, out_cap,
                  join_cap, l_elide, r_elide, l_sorted, r_sorted,
-                 self.exchange_mode, self.outer, repr(self.fill_value),
-                 sort_impl),
+                 self.exchange_mode, x_tok, self.outer,
+                 repr(self.fill_value), sort_impl),
                 lambda: _shard_program(
                     self.mesh, prog_fn, 2 + len(l_in) + len(r_in),
                     (_SPEC,) * (3 + len(key_names) + n_vals)),
@@ -4236,10 +4310,11 @@ class _SortByKeyRDD(_ExchangeRDD):
             bounds_dev = mesh_lib.host_put(bounds, repl)
             bounds_lo_dev = None
         ascending = self.ascending
-        exchange = _get_exchange(self.exchange_mode)
         sort_impl = _sort_impl()
 
         def build(slot, out_cap):
+            exchange, x_tok = self._resolve_exchange((blk,), slot, out_cap)
+
             def prog_fn(*args):
                 if composite:
                     bnds, bnds_lo, counts, *col_arrays = args
@@ -4270,7 +4345,7 @@ class _SortByKeyRDD(_ExchangeRDD):
 
             key = ("sort", self.mesh, tuple(in_names), tuple(names),
                    _chain_fp(chain), n, slot, out_cap,
-                   ascending, self.exchange_mode, sort_impl)
+                   ascending, self.exchange_mode, x_tok, sort_impl)
             prog = _cached_program(
                 key,
                 lambda: _shard_program(

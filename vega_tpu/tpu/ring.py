@@ -1,23 +1,32 @@
-"""Ring exchange: peak-memory-bounded alternative to the all_to_all shuffle.
+"""Ring + staged exchanges: peak-memory-bounded alternatives to all_to_all.
 
 bucket_exchange (kernels.py) materializes an [n_shards, slot_capacity] send
 buffer per column — peak memory grows linearly with mesh size, which is the
-HBM hazard for large blocks on big meshes. The ring exchange instead
-processes ONE peer per step: select the rows destined for peer (i+s) mod n,
-ppermute them s hops around the ring, and append what arrives — peak extra
-memory is a single [slot_capacity] buffer per column regardless of mesh
-size, at the cost of n-1 sequential collective steps.
+HBM hazard for large blocks on big meshes. The bounded exchanges here
+instead move rows in ROUNDS of `group` peers each: per round, each shard
+selects the rows destined for peers (i+s) mod n for the round's shifts s,
+ppermutes them around the ring sharing one stacked [group, slot_capacity]
+send/recv buffer per column, and bulk-appends what arrives in ONE scatter
+— peak extra memory is 3*group slots per column regardless of mesh size
+(send slots + received mirrors + the append's stacked contiguous copy —
+the coefficient exchange_plan.transient_rows charges), at
+ceil((n-1)/group) sequential rounds.
 
-This is the same ring-pipelining pattern ring attention uses for long
-sequences (block exchange over ppermute instead of one big collective),
-applied to keyed-data shuffles; lane-adjacent shifts ride neighbor ICI
-links on a physical ring/torus. Cf. "Memory-efficient array redistribution
-through portable collective communication" (arXiv:2112.01075), which builds
-redistributions from the same bounded-footprint collective steps.
+group interpolates the whole trade: group=1 is the classic ring (a single
+bounded buffer, n-1 rounds — ring_exchange delegates here); group=n-1 is
+one round whose buffers match the all_to_all footprint. The collective-
+aware planner (tpu/exchange_plan.py) picks the group per launch so the
+estimated peak fits Configuration.dense_hbm_budget — the decomposition of
+"Memory-efficient array redistribution through portable collective
+communication" (arXiv:2112.01075): arbitrary reshards as *sequences* of
+bounded-footprint collective blocks. Lane-adjacent shifts ride neighbor
+ICI links on a physical ring/torus (the ring-attention pipelining
+pattern applied to keyed-data shuffles).
 
-Select per shuffle with the exchange="ring" keyword
+Select per shuffle with the exchange= keyword
 (DenseRDD.reduce_by_key/group_by_key/join/sort_by_key) or globally via
-Configuration.dense_exchange / VEGA_TPU_DENSE_EXCHANGE=ring.
+Configuration.dense_exchange / VEGA_TPU_DENSE_EXCHANGE: "auto" (default)
+routes through the planner, "ring"/"staged"/"all_to_all" force a program.
 """
 
 from __future__ import annotations
@@ -45,14 +54,48 @@ def ring_exchange(
     sort_impl: str = None,
 ) -> Tuple[Cols, jax.Array, jax.Array]:
     """Drop-in replacement for kernels.bucket_exchange (same contract:
-    returns (cols, new_count, overflow_flag); pregrouped means rows are
-    already contiguous per bucket, so grouping collapses to a bincount;
-    sort_impl is the caller's resolved dense_sort_impl, threaded so the
-    grouping escape hatch matches the caller's program-cache key)."""
+    returns (cols, new_count, overflow_flag)): the group=1 extreme of the
+    staged exchange — one bounded [slot_capacity] buffer per column,
+    n-1 sequential ppermute rounds."""
+    if n_shards == 1:
+        return kernels.passthrough_exchange(cols, count, bucket.shape[0],
+                                            out_capacity)
+    return staged_exchange(cols, count, bucket, n_shards, slot_capacity,
+                           out_capacity, pregrouped=pregrouped,
+                           sort_impl=sort_impl, group=1)
+
+
+def staged_exchange(
+    cols: Cols,
+    count: jax.Array,
+    bucket: jax.Array,
+    n_shards: int,
+    slot_capacity: int,
+    out_capacity: int,
+    pregrouped: bool = False,
+    sort_impl: str = None,
+    group: int = 1,
+) -> Tuple[Cols, jax.Array, jax.Array]:
+    """Blocked/staged exchange: rows move in ceil((n-1)/group) rounds of
+    `group` shifted ppermutes each. Same contract as
+    kernels.bucket_exchange — returns (cols, new_count, overflow_flag);
+    pregrouped means rows are already contiguous per bucket, so grouping
+    collapses to a bincount; sort_impl is the caller's resolved
+    dense_sort_impl, threaded so the grouping escape hatch matches the
+    caller's program-cache key.
+
+    Per round the live transient per column is one stacked
+    [group, slot_capacity] send buffer plus its received mirror, and the
+    round's arrivals land in ONE bulk scatter into the output — fewer
+    O(out_capacity) append passes than the classic ring (rounds, not
+    n-1) while the peak stays bounded at 2*group slots. The planner
+    (tpu/exchange_plan.py) chooses `group` so that bound fits the HBM
+    budget."""
     capacity = bucket.shape[0]
     if n_shards == 1:
         return kernels.passthrough_exchange(cols, count, capacity,
                                             out_capacity)
+    group = max(1, min(int(group), n_shards - 1))
     mask = kernels.valid_mask(capacity, count)
     bucket = jnp.where(mask, bucket, n_shards)
 
@@ -96,33 +139,48 @@ def ring_exchange(
         }
         return slot, n_rows
 
-    def append(out_cols, write_pos, slot, n_rows):
-        idx = write_pos + jnp.arange(slot_capacity)
-        in_range = jnp.arange(slot_capacity) < n_rows
-        idx = jnp.where(in_range, idx, out_capacity)  # OOB rows dropped
-        new = {
-            name: out.at[idx].set(slot[name], mode="drop")
-            for name, out in out_cols.items()
-        }
-        return new, write_pos + n_rows
+    def append_round(out_cols, write_pos, slots, rows_list):
+        """Bulk-append one round's received slots: one scatter per column
+        over the stacked [g, slot_capacity] buffer."""
+        g = len(slots)
+        rows_vec = jnp.stack(rows_list)                 # [g]
+        offs = jnp.cumsum(rows_vec) - rows_vec          # exclusive prefix
+        j = jnp.arange(slot_capacity)[None, :]
+        idx = write_pos + offs[:, None] + j             # [g, slot]
+        in_range = j < rows_vec[:, None]
+        idx = jnp.where(in_range, idx, out_capacity)    # OOB rows dropped
+        flat_idx = idx.reshape(-1)
+        new = {}
+        for name, out in out_cols.items():
+            stacked = jnp.stack([s[name] for s in slots])  # [g, slot, ...]
+            flat = stacked.reshape((g * slot_capacity,)
+                                   + stacked.shape[2:])
+            new[name] = out.at[flat_idx].set(flat, mode="drop")
+        return new, write_pos + jnp.sum(rows_vec)
 
-    # Step 0: my own bucket stays local.
+    # Round 0: my own bucket stays local.
     slot, n_rows = take_slot(my_id)
-    out_cols, write_pos = append(out_cols, write_pos, slot, n_rows)
+    out_cols, write_pos = append_round(out_cols, write_pos, [slot],
+                                       [n_rows])
 
-    # Steps 1..n-1: send to peer (i+s) mod n via an s-hop shifted ppermute.
-    # The loop is unrolled (perm must be static); each step's live buffer is
-    # one [slot_capacity] slot per column.
-    for s in range(1, n_shards):
-        perm = [(i, (i + s) % n_shards) for i in range(n_shards)]
-        target = (my_id + s) % n_shards
-        slot, n_rows = take_slot(target)
-        recv = {
-            name: lax.ppermute(c, SHARD_AXIS, perm)
-            for name, c in slot.items()
-        }
-        recv_rows = lax.ppermute(n_rows, SHARD_AXIS, perm)
-        out_cols, write_pos = append(out_cols, write_pos, recv, recv_rows)
+    # Rounds of `group` shifts: send to peer (i+s) mod n via an s-hop
+    # shifted ppermute. The loop is unrolled (perms must be static); each
+    # round's live buffers are the stacked [group, slot] send slots and
+    # their received mirrors.
+    for r0 in range(1, n_shards, group):
+        recv_slots = []
+        recv_rows = []
+        for s in range(r0, min(r0 + group, n_shards)):
+            perm = [(i, (i + s) % n_shards) for i in range(n_shards)]
+            target = (my_id + s) % n_shards
+            slot, n_rows = take_slot(target)
+            recv_slots.append({
+                name: lax.ppermute(c, SHARD_AXIS, perm)
+                for name, c in slot.items()
+            })
+            recv_rows.append(lax.ppermute(n_rows, SHARD_AXIS, perm))
+        out_cols, write_pos = append_round(out_cols, write_pos,
+                                           recv_slots, recv_rows)
 
     total_in = write_pos
     # Rows destined for me but truncated by slot_capacity at any sender are

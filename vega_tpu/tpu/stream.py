@@ -6,8 +6,11 @@ The dense tier materializes whole Blocks; a 1B-row (key, value) source is
 reference never solved memory either: cache.rs:68-76 eviction is todo!()).
 
 A StreamedDenseRDD holds a *recipe* for the data as a sequence of chunk
-DenseRDDs, each small enough (chunk_bytes * _EXCHANGE_FOOTPRINT fits the
-Configuration.dense_hbm_budget) to run the normal fused device pipelines.
+DenseRDDs, each small enough that its planned exchange footprint fits
+Configuration.dense_hbm_budget (the exchange planner's per-chunk peak
+estimate under dense_exchange=auto; the conservative
+chunk_bytes * _EXCHANGE_FOOTPRINT rule otherwise) to run the normal
+fused device pipelines.
 Narrow ops (map/filter/map_values) compose per chunk. Aggregations stream:
 
   reduce_by_key: each chunk runs the full device exchange+segment-reduce,
@@ -42,26 +45,59 @@ from vega_tpu.errors import VegaError
 
 log = logging.getLogger("vega_tpu")
 
-# An exchange holds ~this many transient copies of its operand block
-# (operand + multi-key-sorted copy + send slots + received block), so a
-# chunk is sized such that chunk_bytes * footprint <= budget.
+# Conservative fallback when no exchange plan is available (explicit
+# dense_exchange={all_to_all,ring,staged} runs, or callers without a mesh
+# in hand): a one-shot exchange holds ~this many transient copies of its
+# operand block (operand + multi-key-sorted copy + send slots + received
+# block), so a chunk is sized such that chunk_bytes * footprint <= budget.
+# Under the default dense_exchange=auto, the collective-aware planner
+# (tpu/exchange_plan.planned_stream_rows) replaces this constant with a
+# per-exchange estimate — bounded (staged/ring) plans cap the transients,
+# so chunks grow toward the operand+copy+output floor and the streamed
+# multi-pass fold pays fewer passes.
 _EXCHANGE_FOOTPRINT = 6
+
+
+def _legacy_chunk_rows(n_rows: int, bytes_per_row: int,
+                       budget_bytes: int) -> Optional[int]:
+    if n_rows * bytes_per_row * _EXCHANGE_FOOTPRINT <= budget_bytes:
+        return None
+    return max(int(budget_bytes // (bytes_per_row * _EXCHANGE_FOOTPRINT)), 1)
 
 
 def planned_chunk_rows(n_rows: int, bytes_per_row: int,
                        budget_bytes: int,
-                       chunk_rows: Optional[int] = None) -> Optional[int]:
+                       chunk_rows: Optional[int] = None,
+                       n_shards: Optional[int] = None) -> Optional[int]:
     """None when the whole source fits the budget (no streaming needed),
     else the chunk size, rounded DOWN to a shape-stable bucket (1M-row
     multiples, or a power of two below 1M) so the chunk footprint stays
-    within budget and block capacities repeat across chunks."""
+    within budget and block capacities repeat across chunks.
+
+    With n_shards given and dense_exchange=auto (the default), the chunk
+    is sized by the exchange planner's cost model instead of the fixed
+    footprint constant: the largest chunk whose PLANNED exchange keeps
+    its aggregate estimated peak within the budget. Forced exchange
+    modes and mesh-less callers keep the conservative 6x rule."""
     if chunk_rows is not None:
         if int(chunk_rows) < 1:
             raise VegaError(f"chunk_rows must be >= 1, got {chunk_rows}")
         return int(chunk_rows)
-    if n_rows * bytes_per_row * _EXCHANGE_FOOTPRINT <= budget_bytes:
-        return None
-    rows = max(int(budget_bytes // (bytes_per_row * _EXCHANGE_FOOTPRINT)), 1)
+    rows = None
+    if n_shards is not None:
+        from vega_tpu.env import Env
+
+        if getattr(Env.get().conf, "dense_exchange", "auto") == "auto":
+            from vega_tpu.tpu.exchange_plan import planned_stream_rows
+
+            rows = planned_stream_rows(n_rows, bytes_per_row,
+                                       budget_bytes, n_shards)
+            if rows is None:
+                return None
+    if rows is None:
+        rows = _legacy_chunk_rows(n_rows, bytes_per_row, budget_bytes)
+        if rows is None:
+            return None
     step = 1 << 20
     if rows >= step:
         return (rows // step) * step
