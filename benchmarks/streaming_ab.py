@@ -1,0 +1,244 @@
+"""A/B: streaming batch latency under a concurrent batch tenant (PR 16).
+
+The serving-plane question for the micro-batch engine: each micro-batch
+is just a job on the PR 7 job server, so a greedy sibling tenant can
+starve the stream — unless the weighted fair pools actually insulate it.
+Three legs, fresh Context each (process singleton), interleaved per
+repetition, medians of 3:
+
+  * solo — the stream alone in its weighted pool: the floor.
+  * fair — stream in its weighted pool (stream_pool_weight), a batch
+    tenant hammering a weight-1 sibling pool: the fair scheduler must
+    hold batch latency near the floor.
+  * fifo — SAME tenant load but stream and tenant share the one default
+    pool: what PR 16 users lose without pool isolation (context leg —
+    documents the gap fair scheduling closes; no bound asserted on it).
+
+The stream itself is an unbounded offset generator folding counts into
+exactly-once state (update_state_by_key(op="add")), with block-mode
+backpressure — so the leg also proves the rate controller bounds queue
+depth while the tenant oversubscribes the one-core sandbox.
+
+Measured per leg:
+  * batch_p50_s / batch_p95_s — BatchCompleted wall percentiles (own
+    listener: pool_latency() would mix tenant jobs into the fifo leg)
+  * ingest_records_s — receiver frontier / leg wall
+  * max_queue_depth — rate-controller high-water mark (blocks)
+  * exactly_once — sum(state) == committed offset frontier (every record
+    counted exactly once, straight from the commit record)
+
+Acceptance (ride the output fields):
+  * p50_bounded  — fair batch p50 <= 1.3x solo batch p50
+  * queue_bounded — max depth <= stream_queue_max_blocks in EVERY leg
+  * results_ok   — exactly_once held in every leg, every rep
+
+Prints ONE JSON line. Usage:
+
+  python benchmarks/streaming_ab.py [run_s] [tenant_tasks]
+"""
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Importing vega_tpu must never probe a (possibly wedged) TPU backend:
+# force the CPU mesh first, like every benchmark here.
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
+
+REPS = 3
+QUEUE_MAX = 4
+BLOCK_RECORDS = 200
+INTERVAL_S = 0.1
+NUM_WORKERS = 4          # local task slots: sleep-bound tasks overlap
+TASK_SLEEP_S = 0.06      # per-partition batch work (honest on 1 core)
+# Tenant tasks are SHORT: fair sharing decides who gets the next slot
+# but never preempts a running task, so the floor of the stream's
+# penalty is one in-flight tenant task's drain time.
+TENANT_SLEEP_S = 0.01
+TENANT_POOLS = {"fair": "tenant", "fifo": "default"}
+
+
+def median(xs):
+    return statistics.median(xs)
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    return sorted(xs)[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _one_leg(mode: str, run_s: float, tenant_tasks: int):
+    """Fresh Context; stream for run_s; optional sibling/shared tenant."""
+    import threading
+
+    import vega_tpu as v
+    from vega_tpu.scheduler import events
+
+    kw = dict(stream_batch_interval_s=INTERVAL_S,
+              stream_block_max_records=BLOCK_RECORDS,
+              stream_queue_max_blocks=QUEUE_MAX,
+              stream_backpressure_mode="block")
+    if mode == "fifo":
+        # No isolation: FIFO arbiter, stream batches ride the shared
+        # default pool behind whatever the tenant already queued.
+        kw.update(stream_pool="default", stream_pool_weight=1,
+                  scheduler_mode="fifo")
+    else:
+        # Pool weights only bind under the fair arbiter.
+        kw.update(scheduler_mode="fair")
+    ctx = v.Context("local", num_workers=NUM_WORKERS, **kw)
+    walls = []
+
+    class BatchWalls(events.Listener):
+        def on_event(self, event):
+            if isinstance(event, events.BatchCompleted) and event.succeeded:
+                walls.append(event.wall_s)
+
+    ctx.bus.add_listener(BatchWalls())
+    tmp = tempfile.mkdtemp(prefix="stream_ab_")
+    try:
+        stream = ctx.stream_from_generator(lambda off: off,
+                                           checkpoint_dir=tmp)
+
+        def work(part):
+            # Sleep-bound batch body: parallelizes honestly across the
+            # local slots on this 1-core sandbox (pure-CPU batches would
+            # measure GIL contention, not scheduling policy).
+            time.sleep(TASK_SLEEP_S)
+            return [(x % 8, 1) for x in part]
+
+        handle = stream.map_partitions(work) \
+                       .update_state_by_key(op="add")
+        sctx = ctx.streaming()
+        sctx.start()
+        # First batch off the clock: it pays the dense fast-path compile
+        # for the op="add" fold.
+        deadline = time.monotonic() + 30
+        while not walls and time.monotonic() < deadline:
+            time.sleep(0.01)
+        walls.clear()
+
+        stop = threading.Event()
+
+        def tenant():
+            # Keep several sleep-bound jobs in flight so tenant tasks
+            # genuinely queue against the batch's tasks (slots are
+            # oversubscribed; the POLICY decides who waits).
+            pool = TENANT_POOLS[mode]
+            if pool != "default":
+                ctx.set_pool(pool, weight=1)
+
+            def slow(x):
+                time.sleep(TENANT_SLEEP_S)
+                return x
+
+            def submit():
+                rdd = ctx.parallelize(list(range(tenant_tasks)),
+                                      tenant_tasks).map(slow)
+                return ctx.submit_job(
+                    rdd, lambda tc, it: sum(1 for _ in it),
+                    pool=pool, transform=sum)
+
+            inflight = [submit() for _ in range(4)]
+            while not stop.is_set():
+                future = inflight.pop(0)
+                try:
+                    assert future.result(60.0) == tenant_tasks
+                except Exception:
+                    if not stop.is_set():
+                        raise
+                inflight.append(submit())
+            for future in inflight:
+                future.cancel("tenant leg over")
+
+        threads = []
+        if mode != "solo":
+            threads = [threading.Thread(target=tenant, daemon=True)]
+            threads[0].start()
+        t0 = time.monotonic()
+        time.sleep(run_s)
+        stop.set()
+        sctx.stop()
+        wall = time.monotonic() - t0
+        for t in threads:
+            t.join(timeout=30.0)
+
+        st = sctx.status()
+        records = st["receivers"][0]["next_offset"]
+        committed = handle.store.log.latest() or {}
+        frontier = int(committed.get("offsets", {}).get("0", 0))
+        state_sum = sum(handle.snapshot().values())
+        return {
+            "batch_p50_s": _pct(walls, 0.5),
+            "batch_p95_s": _pct(walls, 0.95),
+            "batches": len(walls),
+            "ingest_records_s": records / wall if wall else 0.0,
+            "max_queue_depth": st["controller"]["max_depth_seen"],
+            "throttled_offers": st["controller"]["throttled_offers"],
+            "exactly_once": state_sum == frontier and frontier > 0,
+            "duplicate_commits": handle.store.duplicate_commits,
+        }
+    finally:
+        ctx.stop()
+
+
+def run_legs(run_s: float = 4.0, tenant_tasks: int = 8):
+    legs = ["solo", "fair", "fifo"]
+    samples = {leg: [] for leg in legs}
+    for _rep in range(REPS):
+        for leg in legs:
+            samples[leg].append(_one_leg(leg, run_s, tenant_tasks))
+
+    def med(leg, key):
+        vals = [s[key] for s in samples[leg] if s[key] is not None]
+        return median(vals) if vals else None
+
+    solo_p50 = med("solo", "batch_p50_s")
+    fair_p50 = med("fair", "batch_p50_s")
+    fifo_p50 = med("fifo", "batch_p50_s")
+    max_depth = max(s["max_queue_depth"] for leg in legs
+                    for s in samples[leg])
+    results_ok = all(s["exactly_once"] and s["duplicate_commits"] == 0
+                     for leg in legs for s in samples[leg])
+    return {
+        "metric": "micro-batch latency under a concurrent batch tenant: "
+                  "stream alone vs weighted fair pool vs shared fifo "
+                  "pool — BatchCompleted wall percentiles, ingest rate, "
+                  "rate-controller queue high-water; fresh Context per "
+                  f"leg, legs interleaved, medians of {REPS}",
+        "run_s": run_s, "tenant_tasks": tenant_tasks,
+        "interval_s": INTERVAL_S, "block_records": BLOCK_RECORDS,
+        "queue_max_blocks": QUEUE_MAX,
+        "batch_p50_s": {"solo": solo_p50, "fair": fair_p50,
+                        "fifo": fifo_p50},
+        "batch_p95_s": {leg: med(leg, "batch_p95_s") for leg in legs},
+        "ingest_records_s": {leg: round(med(leg, "ingest_records_s") or 0)
+                             for leg in legs},
+        "batches": {leg: med(leg, "batches") for leg in legs},
+        "max_queue_depth": max_depth,
+        "fair_p50_vs_solo": round(fair_p50 / solo_p50, 3)
+        if solo_p50 and fair_p50 else None,
+        "fifo_p50_vs_solo": round(fifo_p50 / solo_p50, 3)
+        if solo_p50 and fifo_p50 else None,
+        "results_ok": results_ok,
+        "p50_bounded": bool(solo_p50 and fair_p50
+                            and fair_p50 <= 1.3 * solo_p50),
+        "queue_bounded": bool(max_depth <= QUEUE_MAX),
+    }
+
+
+def main():
+    force_cpu_mesh(8)
+    run_s = float(sys.argv[1]) if len(sys.argv) > 1 else 4.0
+    tenant_tasks = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    print(json.dumps(run_legs(run_s, tenant_tasks)))
+
+
+if __name__ == "__main__":
+    main()

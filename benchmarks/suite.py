@@ -441,6 +441,44 @@ def config12_exchange_planner(ctx, scale=1.0, bank=None):
     return rows, out["warm_s"]["one_shot"], out["warm_s"]["planned"]
 
 
+def config13_streaming(ctx, scale=1.0, bank=None):
+    """PR 16 micro-batch streaming engine: an unbounded generator stream
+    folding exactly-once state while a batch tenant hammers a sibling
+    pool — stream alone vs weighted fair pool vs shared FIFO pool
+    (benchmarks/streaming_ab.py: interleaved legs, medians of 3,
+    exactly-once + bounded queue depth asserted by the A/B itself). Runs
+    in a SUBPROCESS — each leg builds a fresh Context with different
+    scheduler_mode/pool config and the Env is a process singleton.
+    Reported through the standard columns: host_s = solo batch p50,
+    device_s = fair-pool batch p50 under the tenant, so device_vs_host
+    reads as the latency COST of multi-tenancy behind the fair arbiter
+    (accept <= 1.3x; the FIFO contrast rides the emitted A/B line's
+    fifo_p50_vs_solo). Host-plane scheduling work — no device leg,
+    excluded from the TPU-window default config set (tpu_jobs/13 runs
+    the standalone A/B instead)."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_s = max(2.0, 4.0 * scale)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "benchmarks", "streaming_ab.py"), str(run_s)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"streaming_ab failed: {proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["results_ok"], \
+        "streaming legs lost exactly-once (state sum != committed frontier)"
+    assert out["queue_bounded"], (
+        "rate controller let the block queue past its bound: "
+        f"{out['max_queue_depth']} > {out['queue_max_blocks']}")
+    batches = out["batches"]["fair"] or 1
+    if bank:
+        bank(batches, out["batch_p50_s"]["fair"])
+    return (batches, out["batch_p50_s"]["solo"], out["batch_p50_s"]["fair"])
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -460,6 +498,8 @@ CONFIGS = {
          "executor-seconds)", config11_elastic),
     12: ("exchange planner one-shot vs staged under constrained HBM "
          "budget", config12_exchange_planner),
+    13: ("micro-batch streaming solo vs fair-pool under batch tenant "
+         "(batch p50 + exactly-once + bounded queue)", config13_streaming),
 }
 
 

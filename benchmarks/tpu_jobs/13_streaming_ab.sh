@@ -1,0 +1,11 @@
+#!/bin/bash
+# Streaming A/B (PR 16) on the real chip: the CPU proxy proves the fair
+# pool bounds batch p50 under a tenant, but the stateful fold's device
+# leg (update_state_by_key op="add" -> dense segment-reduce) runs on the
+# XLA:CPU fallback there. On the chip the per-batch fold compiles once
+# and replays, so the question is whether batch p50 stays interval-bound
+# when the fold is a real TPU program (dispatch latency per micro-batch,
+# not throughput, is the risk). Exactly-once and queue-depth accepts are
+# asserted by the A/B itself. One JSON line.
+cd /root/repo
+exec python benchmarks/streaming_ab.py 6.0

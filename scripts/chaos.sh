@@ -33,3 +33,11 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/locality_ab.py
 # short-job p50 <= 1.3x, every job's result asserted) ride the
 # "exec_seconds_bounded" / "p50_bounded" / "results_ok" fields.
 timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/elastic_ab.py
+
+# Streaming A/B (PR 16): unbounded generator stream folding exactly-once
+# state, solo vs weighted-fair-pool vs shared-FIFO-pool under a batch
+# tenant. One JSON line; the acceptance bounds (fair batch p50 <= 1.3x
+# solo, rate-controller queue depth <= its bound in every leg, state sum
+# == committed offset frontier) ride the "p50_bounded" /
+# "queue_bounded" / "results_ok" fields.
+timeout -k 10 900 env JAX_PLATFORMS=cpu python benchmarks/streaming_ab.py

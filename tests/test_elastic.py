@@ -213,3 +213,44 @@ def test_fleet_status_shape_distributed():
         assert status["elastic"]["executor_seconds"] >= 0.0
     finally:
         ctx.stop()
+
+
+def test_weighted_scale_host_is_capacity_proportional():
+    """Hosts-file entries `host:N` carry capacity weights; scale-up fills
+    hosts proportionally (fewest live per unit of weight first) instead
+    of round-robin."""
+    from vega_tpu.distributed.backend import _weighted_scale_host
+
+    weights = {"big": 3, "small": 1}
+    live = {}
+    order = []
+    for _ in range(8):
+        h = _weighted_scale_host(weights, live)
+        order.append(h)
+        live[h] = live.get(h, 0) + 1
+    # 3:1 capacity -> 6 placements on big, 2 on small, big preferred at
+    # every tie (higher absolute weight breaks (live+1)/weight ties).
+    assert order == ["big", "big", "big", "small", "big", "big", "big",
+                     "small"]
+    # Degenerate inputs stay safe.
+    assert _weighted_scale_host({}, {}) == "127.0.0.1"
+    assert _weighted_scale_host({"only": 2}, {"only": 7}) == "only"
+
+
+def test_elastic_demand_includes_registered_load_signals():
+    """The streaming rate controller registers a load signal; _decide
+    must count it as queued demand (a backlog of blocks needs executors
+    even while the job queue is momentarily empty)."""
+    _retire_active_context()
+    ctx = v.Context("distributed", num_workers=1, num_executors=1)
+    try:
+        ctx.elastic.add_load_signal(lambda: 3)
+        ctx.elastic.add_load_signal(lambda: (_ for _ in ()).throw(
+            RuntimeError("broken signal must not break scaling")))
+        ctx.elastic._decide(interval=10.0)
+        sig = ctx.elastic._last_signal
+        assert sig["extra"] == 3
+        # Demand-per-slot includes the external backlog.
+        assert sig["load"] >= 3 / sig["slots"]
+    finally:
+        ctx.stop()

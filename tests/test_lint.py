@@ -919,6 +919,59 @@ def test_vg014_silent_on_conforming_and_exempt_shapes(tmp_path):
     assert not out.findings
 
 
+# ---------------------------------------------------------------- VG015
+def test_vg015_fires_on_state_mutation_outside_commit_api(tmp_path):
+    res = _lint(tmp_path, "vega_tpu/streaming/rogue.py", """\
+        from vega_tpu.rdd.checkpoint import CheckpointRDD, CommitLog
+
+        def hack(store, rdd):
+            store._state["k"] = 1
+            store.last_committed_batch = 7
+            log = CommitLog("/tmp/x")
+            CheckpointRDD.write(rdd, "/tmp/y")
+        """, select=["VG015"])
+    assert _rules(res) == ["VG015"] * 4
+    msgs = " ".join(f.message for f in res.findings)
+    assert "StateStore.apply_batch" in msgs
+    assert "CommitLog minted" in msgs
+    assert "CheckpointRDD.write" in msgs
+
+
+def test_vg015_silent_in_state_py_and_outside_streaming(tmp_path):
+    # state.py itself IS the commit API — exempt.
+    exempt = _lint(tmp_path, "vega_tpu/streaming/state.py", """\
+        class StateStore:
+            def __init__(self):
+                self._state = {}
+                self.last_committed_batch = -1
+        """, select=["VG015"])
+    assert not exempt.findings
+    # Reads of state (Load context) and calls into the commit API are fine.
+    clean = _lint(tmp_path, "vega_tpu/streaming/ctx2.py", """\
+        def tick(store, batch_id, offsets, updates):
+            frontier = store.last_committed_batch
+            return store.apply_batch(batch_id, offsets, updates)
+        """, select=["VG015"])
+    assert not clean.findings
+    # Outside streaming/ the rule does not apply.
+    out = _lint(tmp_path, "vega_tpu/other/free.py", """\
+        class Thing:
+            def __init__(self):
+                self._state = {}
+        """, select=["VG015"])
+    assert not out.findings
+
+
+def test_vg012_covers_streaming_receivers(tmp_path):
+    # PR 16 extended VG012's directory index into streaming/: raw socket
+    # reads in a receiver must carry deadlines.
+    res = _lint(tmp_path, "vega_tpu/streaming/badrecv.py", """\
+        def pull(sock):
+            return sock.recv(4096)
+        """, select=["VG012"])
+    assert _rules(res) == ["VG012"]
+
+
 # ---------------------------- mutation self-tests against the real tree
 import os as _os
 import shutil as _shutil

@@ -20,6 +20,12 @@ class KeySpace(enum.Enum):
 
     RDD = 0
     BROADCAST = 1
+    # Streaming receiver blocks (vega_tpu/streaming/source.py): keyed
+    # (stream_id, block_seq); replayable micro-batch inputs, removed by
+    # the streaming context once every window that references them has
+    # committed. No reference-repo counterpart (streaming was never
+    # ported there).
+    STREAM = 2
 
 
 Key = Tuple[KeySpace, int, int]  # (space, datum_id, partition)

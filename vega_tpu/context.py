@@ -143,6 +143,9 @@ class Context:
             # setLocalProperty): "pool" selects the scheduling pool for
             # jobs submitted from this thread.
             self._local_props = threading.local()
+            # Lazily created micro-batch streaming plane
+            # (vega_tpu/streaming/): one per Context, like the job server.
+            self._streaming = None
             # Attach last: a failed backend init must not leak a file
             # handler on the process-global logger.
             from vega_tpu.env import attach_session_logger
@@ -415,6 +418,36 @@ class Context:
         log.debug("approximate job finished in %.3fs", time.time() - start)
         return PartialResult(evaluator.current_result(), is_final=True)
 
+    # ------------------------------------------------------------- streaming
+    def streaming(self, batch_interval_s: Optional[float] = None,
+                  checkpoint_dir: Optional[str] = None):
+        """The Context's micro-batch streaming plane (one per Context,
+        created on first use; vega_tpu/streaming/). Interval/checkpoint
+        overrides apply only to the creating call."""
+        self._check_alive()
+        if self._streaming is None:
+            from vega_tpu.streaming.context import StreamingContext
+
+            self._streaming = StreamingContext(
+                self, batch_interval_s=batch_interval_s,
+                checkpoint_dir=checkpoint_dir)
+        return self._streaming
+
+    def stream_from_generator(self, fn, **kwargs):
+        """DStream over an offset-addressed generator: fn(offset) ->
+        record | None. Deterministic + picklable fn = fully replayable
+        (the exactly-once reference source)."""
+        return self.streaming(**kwargs).generator_stream(fn)
+
+    def stream_from_file_tail(self, path: str, **kwargs):
+        """DStream tailing an append-only line file (byte offsets)."""
+        return self.streaming(**kwargs).file_tail_stream(path)
+
+    def stream_from_socket(self, host: str, port: int, **kwargs):
+        """DStream over line-delimited TCP; every read is bounded by
+        stream_socket_timeout_s."""
+        return self.streaming(**kwargs).socket_stream(host, port)
+
     # ----------------------------------------------------------------- admin
     @property
     def default_parallelism(self) -> int:
@@ -439,6 +472,9 @@ class Context:
             "admission": self.job_server.admission_status(),
             "elastic": self.elastic.status() if self.elastic is not None
             else {"enabled": False},
+            "pool_latency": self.metrics.pool_latency(),
+            "streaming": self._streaming.status()
+            if self._streaming is not None else {"active": False},
         }
 
     def storage_status(self) -> dict:
@@ -457,6 +493,14 @@ class Context:
         if self._stopped:
             return
         self._stopped = True
+        # Streaming stops FIRST: its batch loop submits jobs and its
+        # receivers write the cache — both must quiesce before the job
+        # plane and stores they ride on wind down.
+        if self._streaming is not None:
+            try:
+                self._streaming.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.warning("streaming stop failed", exc_info=True)
         # The autoscaler goes first: a control loop mid-decision must not
         # spawn or decommission against a backend that is tearing down
         # (teardown=True also aborts any mid-ladder decommission).

@@ -67,6 +67,23 @@ from vega_tpu.lint.sync_witness import named_lock
 log = logging.getLogger("vega_tpu")
 
 
+def _weighted_scale_host(weights: Dict[str, int],
+                         live_by_host: Dict[str, int]) -> str:
+    """Capacity-weighted scale-up placement: choose the host whose
+    occupancy-per-capacity ((live + 1) / weight, counting the slot being
+    placed) is lowest, tiebreaking toward the bigger box, then by name
+    for determinism. Starting empty, a weight-3 host receives the first
+    three slots before a weight-1 host receives its first; at equal
+    weights this degrades to the old even rotation."""
+    if not weights:
+        return "127.0.0.1"
+    return min(
+        weights,
+        key=lambda h: ((live_by_host.get(h, 0) + 1) / weights[h],
+                       -weights[h], h),
+    )
+
+
 class _Executor:
     def __init__(self, executor_id: str, task_uri: str, host: str,
                  process: Optional[subprocess.Popen] = None,
@@ -141,11 +158,16 @@ class DistributedBackend(TaskBackend):
         n = num_executors or getattr(conf, "num_executors", None) or 2
         local_hosts = hosts or ["127.0.0.1"] * n
         # Elastic scale-up (scheduler/elastic.py): fresh slots get the
-        # next never-used index and rotate over the configured host set
-        # (local fleets: all 127.0.0.1; ssh fleets: spread like the
-        # initial spawn did).
+        # next never-used index. Placement honors per-host CAPACITY
+        # weights — a hosts-file `host:N` entry appears N times in
+        # local_hosts, so the multiplicity IS the capacity signal: new
+        # slots land where occupancy-per-capacity is lowest (bigger boxes
+        # first), not on a uniform rotation that fills a laptop as fast
+        # as a 64-core box.
         self._slot_ids = itertools.count(len(local_hosts))
-        self._scale_hosts = list(local_hosts)
+        self._host_weights: Dict[str, int] = {}
+        for h in local_hosts:
+            self._host_weights[h] = self._host_weights.get(h, 0) + 1
         self._spawn_workers(local_hosts)
         self._reaper = threading.Thread(
             target=self._reaper_loop, name="executor-reaper", daemon=True
@@ -540,8 +562,12 @@ class DistributedBackend(TaskBackend):
             if self._stopped:
                 raise NetworkError("backend is stopped; cannot scale up")
             idx = next(self._slot_ids)
+            live_by_host: Dict[str, int] = {}
+            for ex in self._executors.values():
+                if ex.alive and not ex.draining:
+                    live_by_host[ex.host] = live_by_host.get(ex.host, 0) + 1
         executor_id = f"exec-{idx}"
-        host = self._scale_hosts[idx % len(self._scale_hosts)]
+        host = _weighted_scale_host(self._host_weights, live_by_host)
         proc = self._launch(executor_id, host)
         line = self._wait_ready(executor_id, proc, time.time() + 30.0)
         _tag, wid, task_uri = line.split()

@@ -300,6 +300,44 @@ class Configuration:
     # headline bench must not gamble on an unmeasured plan. "on"/"off"
     # force it per run (the A/B job sets "on").
     dense_table_plan: str = "auto"
+    # --- micro-batch streaming (vega_tpu/streaming/) ---
+    # Discretization interval: how often the streaming context snapshots
+    # receiver blocks into one micro-batch and submits its output jobs.
+    stream_batch_interval_s: float = 0.5
+    # Receivers cut a block (land it in the tiered store and queue it for
+    # the next batch) at this many records; a batch tick also flushes the
+    # partial block so low-rate streams still make progress.
+    stream_block_max_records: int = 10_000
+    # Backpressure bound: maximum receiver blocks landed but not yet
+    # consumed by a completed batch. At the bound the receiver applies
+    # stream_backpressure_mode instead of queueing without limit.
+    stream_queue_max_blocks: int = 64
+    # What a full block queue does to ingest: "block" parks the receiver
+    # until a batch drains blocks (lossless; the socket source's peer
+    # sees TCP backpressure); "shed" drops the newest block while still
+    # advancing source offsets (lossy by design — counted and surfaced,
+    # mirroring jobserver admission_mode reject/block).
+    stream_backpressure_mode: str = "block"
+    # Fair-scheduler pool streaming batches are submitted into, and its
+    # weight vs the default batch pool (set via ctx.set_pool at streaming
+    # start) — the isolation that keeps a heavy batch tenant from
+    # starving the stream.
+    stream_pool: str = "streaming"
+    stream_pool_weight: int = 4
+    # StorageLevel for receiver blocks in the tiered store. The default
+    # keeps blocks replayable across memory pressure (eviction demotes to
+    # disk instead of dropping — a failed batch must recompute from
+    # stored blocks, never from the wire).
+    stream_storage_level: str = "memory_and_disk"
+    # Socket source read timeout: every recv on the streaming socket
+    # carries this bound (VG012/VG015 — no unbounded waits), so a silent
+    # peer never wedges the receiver thread past it.
+    stream_socket_timeout_s: float = 5.0
+    # Where stateful streams write their (batch_id, offsets, state)
+    # commit records + checkpointed state parts. Empty = under the
+    # session work dir (wiped with the session; set it to survive a
+    # driver restart).
+    stream_checkpoint_dir: str = ""
 
     @staticmethod
     def from_environ(environ=None) -> "Configuration":
@@ -311,7 +349,9 @@ class Configuration:
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
                      "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
                      "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR",
-                     "SCHEDULER_MODE", "SHUFFLE_PLAN", "ADMISSION_MODE"):
+                     "SCHEDULER_MODE", "SHUFFLE_PLAN", "ADMISSION_MODE",
+                     "STREAM_BACKPRESSURE_MODE", "STREAM_POOL",
+                     "STREAM_STORAGE_LEVEL", "STREAM_CHECKPOINT_DIR"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name])
         for name in ("SHUFFLE_SERVICE_PORT", "SLAVE_PORT", "NUM_WORKERS",
@@ -322,7 +362,9 @@ class Configuration:
                      "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
                      "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES",
                      "SHUFFLE_REPLICATION", "ELASTIC_MIN_EXECUTORS",
-                     "ELASTIC_MAX_EXECUTORS", "POOL_MAX_QUEUED"):
+                     "ELASTIC_MAX_EXECUTORS", "POOL_MAX_QUEUED",
+                     "STREAM_BLOCK_MAX_RECORDS", "STREAM_QUEUE_MAX_BLOCKS",
+                     "STREAM_POOL_WEIGHT"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
@@ -339,7 +381,8 @@ class Configuration:
                      "LOCALITY_WAIT_S", "ELASTIC_SCALE_UP_THRESHOLD",
                      "ELASTIC_SCALE_DOWN_THRESHOLD",
                      "ELASTIC_DECISION_INTERVAL_S", "DECOMMISSION_TIMEOUT_S",
-                     "BLACKLIST_DECAY_S"):
+                     "BLACKLIST_DECAY_S", "STREAM_BATCH_INTERVAL_S",
+                     "STREAM_SOCKET_TIMEOUT_S"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), float(env[pref + name]))
         return cfg

@@ -76,6 +76,13 @@ tests:
                                      the drain-timeout escalation to the
                                      executor-lost path; combine with
                                      ..._EXECUTOR to wedge one victim
+  VEGA_TPU_FAULT_RECEIVER_CRASH_AFTER_BLOCKS
+                                     crash a streaming receiver thread
+                                     (streaming/source.py) after it lands
+                                     its Nth block — the mid-ingest kill
+                                     whose restart must resume from the
+                                     tracked offset with no duplicate or
+                                     lost records
   VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
   VEGA_TPU_FAULT_DROP_BINARY_N       drop the cached stage binary for the
                                      first N `binary_cached` task_v2
@@ -146,6 +153,8 @@ class FaultInjector:
         self.push_drop_n = _int("PUSH_DROP_N") if armed else 0
         self.merged_delay_s = _float("MERGED_DELAY_S") if armed else 0.0
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
+        self.receiver_crash_after_blocks = \
+            _int("RECEIVER_CRASH_AFTER_BLOCKS") if armed else 0
         self.drop_binary_n = _int("DROP_BINARY_N") if armed else 0
         self.decommission_hang_s = \
             _float("DECOMMISSION_HANG_S") if armed else 0.0
@@ -164,7 +173,7 @@ class FaultInjector:
             or self.fetch_delay_s or self.corrupt_spill_n
             or self.fetch_stream_drop_n or self.drop_binary_n
             or self.push_drop_n or self.merged_delay_s
-            or self.decommission_hang_s
+            or self.decommission_hang_s or self.receiver_crash_after_blocks
         )
 
     def _targets_me(self) -> bool:
@@ -331,6 +340,28 @@ class FaultInjector:
         log.warning("FAULT: wedging decommission drain of %s for %.1fs",
                     executor_id, self.decommission_hang_s)
         return self.decommission_hang_s
+
+    def maybe_crash_receiver(self, blocks_landed: int) -> None:
+        """streaming/source.py, after a receiver lands a block in the
+        tiered store: crash the receiver THREAD (raise) once it has landed
+        N blocks — mid-ingest loss with the block already durable. The
+        streaming context must restart the receiver resuming from its
+        tracked offset, and the final state must be bit-identical to an
+        uninterrupted run. One-shot: the counter disarms after firing so
+        the restarted receiver is healthy."""
+        if not (self.active and self.receiver_crash_after_blocks
+                and self._targets_me()):
+            return
+        with self._lock:
+            if self.receiver_crash_after_blocks <= 0:
+                return
+            if blocks_landed < self.receiver_crash_after_blocks:
+                return
+            self.receiver_crash_after_blocks = 0
+        self._record("receiver_crash", blocks_landed=blocks_landed)
+        log.warning("FAULT: crashing streaming receiver after %d blocks",
+                    blocks_landed)
+        raise RuntimeError("FAULT: injected receiver crash")
 
     def corrupt_spilled(self, disk_store, key: str) -> None:
         """shuffle/store.py, after a bucket spills: flip payload bytes in

@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG014: the project invariants as AST checks.
+"""vegalint rules VG001–VG015: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -12,7 +12,10 @@ VG001–VG008 are the per-file (and lock-graph) invariants from PRs 3 and
 7; VG013 (PR 11) keeps frame planning pure — no materialization at
 plan-build time; VG014 (PR 13) holds every exchange implementation to
 the (cols, count, overflow) / n_shards==1 contract the collective-aware
-planner relies on. VG009–VG012 are the cross-process CONTRACT rules: a
+planner relies on; VG015 (PR 16) funnels streaming state mutation
+through the exactly-once commit API (streaming/state.py) — and VG012's
+index extends into streaming/ so receiver socket reads stay bounded.
+VG009–VG012 are the cross-process CONTRACT rules: a
 shared per-file
 index pass (``_contract_extract``, cached by the engine) reduces each
 file to its protocol/config/event surfaces, and global combines join
@@ -1261,7 +1264,10 @@ def vg011(records: List[Tuple[str, dict]]) -> Iterator[Finding]:
 # is a wait no deadline bounds — flag them all; the handful of
 # deliberate unbounded waits carry justified pragmas.
 
-_VG012_DIRS = (("vega_tpu", "distributed"), ("vega_tpu", "shuffle"))
+_VG012_DIRS = (("vega_tpu", "distributed"), ("vega_tpu", "shuffle"),
+               # Streaming receivers read sockets too (PR 16): a silent
+               # peer must never wedge an ingest thread unboundedly.
+               ("vega_tpu", "streaming"))
 
 
 @rule("VG012", "unbounded blocking socket op on a cross-process path")
@@ -1446,3 +1452,62 @@ def vg014(ctx: FileCtx) -> Iterator[Finding]:
                     "a (cols, count, overflow) 3-tuple nor a delegation "
                     "to another exchange — the exchange contract's "
                     "return shape (CLAUDE.md; docs/LINTING.md VG014)")
+
+
+# ---------------------------------------------------------------------------
+# VG015 — streaming state mutations flow through the commit API
+# ---------------------------------------------------------------------------
+# The exactly-once guarantee (PR 16) lives in ONE place:
+# streaming/state.py's StateStore.apply_batch, which orders merge ->
+# checkpoint -> atomic commit record and dedups replayed batch ids. Any
+# other streaming code writing state fields, minting CommitLogs, or
+# checkpointing state directly would fork that ordering — a crash between
+# its write and the commit record silently violates exactly-once on
+# exactly the replay path chaos tests exist to protect. (The socket-
+# timeout half of this PR's lint work rides VG012, whose directory index
+# now includes streaming/.)
+
+_VG015_STATE_ATTRS = {"state", "_state", "last_committed_batch"}
+
+
+@rule("VG015", "streaming state mutated outside the commit API")
+def vg015(ctx: FileCtx) -> Iterator[Finding]:
+    if not ctx.in_dir("vega_tpu", "streaming") \
+            or ctx.endswith("streaming/state.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = _last_name(node.func)
+            if name == "CommitLog":
+                yield Finding(
+                    "VG015", ctx.display, node.lineno, node.col_offset + 1,
+                    "CommitLog minted outside streaming/state.py — commit "
+                    "records must only be published by "
+                    "StateStore.apply_batch, the one place that orders "
+                    "merge -> checkpoint -> commit (docs/LINTING.md "
+                    "VG015)")
+            elif name == "write" and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "CheckpointRDD":
+                yield Finding(
+                    "VG015", ctx.display, node.lineno, node.col_offset + 1,
+                    "CheckpointRDD.write of streaming state outside "
+                    "streaming/state.py — state checkpoints must go "
+                    "through StateStore.apply_batch so the atomic commit "
+                    "record stays ordered after them (docs/LINTING.md "
+                    "VG015)")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for target in targets:
+                for sub in ast.walk(target):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr in _VG015_STATE_ATTRS:
+                        yield Finding(
+                            "VG015", ctx.display, sub.lineno,
+                            sub.col_offset + 1,
+                            f"direct write to streaming state "
+                            f"('.{sub.attr}') outside streaming/state.py "
+                            "— mutate state only via "
+                            "StateStore.apply_batch (the exactly-once "
+                            "commit API; docs/LINTING.md VG015)")

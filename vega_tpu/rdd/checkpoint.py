@@ -9,8 +9,9 @@ does not recompute the full lineage.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Iterator, List
+from typing import Any, Dict, Iterator, List, Optional
 
 from vega_tpu import serialization
 from vega_tpu.rdd.base import RDD
@@ -49,3 +50,53 @@ class CheckpointRDD(RDD):
         path = os.path.join(self.directory, f"part-{split.index:05d}.ckpt")
         with open(path, "rb") as f:
             return iter(serialization.loads(f.read()))
+
+
+class CommitLog:
+    """Atomic, monotone commit records over checkpointed artifacts.
+
+    The exactly-once seam for streaming state (streaming/state.py): state
+    parts are first checkpointed via CheckpointRDD.write (tmp + os.replace
+    per part), THEN one commit record naming (batch_id, source offsets,
+    state directory) is published — also tmp + os.replace, so a crash at
+    any point leaves either the previous commit or the new one, never a
+    torn record. Recovery reads the single `latest` record; uncommitted
+    work is invisible and simply replays from the committed offsets.
+    """
+
+    LATEST = "latest.commit"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def commit(self, batch_id: int, payload: Dict[str, Any]) -> str:
+        """Publish `payload` as the committed record for `batch_id`. The
+        per-batch record is kept (audit trail / duplicate detection) and
+        `latest` is atomically repointed. Returns the per-batch path."""
+        record = dict(payload, batch_id=batch_id)
+        data = json.dumps(record, sort_keys=True)
+        path = os.path.join(self.directory, f"commit-{batch_id:010d}.json")
+        for target in (path, os.path.join(self.directory, self.LATEST)):
+            tmp = target + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, target)
+        return path
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        """The most recent committed record, None before any commit. A
+        torn/absent `latest` (crash before the very first commit) reads
+        as no-commit — recovery starts from scratch."""
+        try:
+            with open(os.path.join(self.directory, self.LATEST)) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def committed(self, batch_id: int) -> bool:
+        """Has `batch_id` (or any later batch) already committed? The
+        duplicate-commit gate: monotone batch ids make this a single
+        compare against the latest record."""
+        rec = self.latest()
+        return rec is not None and rec.get("batch_id", -1) >= batch_id

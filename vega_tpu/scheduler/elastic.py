@@ -61,7 +61,7 @@ import logging
 import math
 import threading
 import time
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from vega_tpu import faults
 from vega_tpu.env import Env
@@ -110,6 +110,18 @@ class ElasticController:
         self.counters: Dict[str, int] = {
             "scale_ups": 0, "scale_downs": 0, "scale_up_failures": 0,
         }
+        # External demand feeds (streaming backpressure controller et
+        # al.): zero-arg callables returning extra queued work units,
+        # summed into _decide's demand each sample.
+        self._load_signals: List = []
+
+    def add_load_signal(self, fn) -> None:
+        """Register an extra demand source for the control loop — e.g.
+        the streaming RateController's pending-block count, so sustained
+        stream pressure scales the fleet like a deep batch queue does.
+        A signal that raises reads as 0 for that sample."""
+        with self._lock:
+            self._load_signals.append(fn)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -186,11 +198,20 @@ class ElasticController:
         stats = self.arbiter.stats()
         live = self._live_count()
         slots = max(1, live) * max(1, int(conf.num_workers))
-        demand = stats["running"] + stats["queued"]
+        with self._lock:
+            signals = list(self._load_signals)
+        extra = 0
+        for fn in signals:
+            try:
+                extra += max(0, int(fn()))
+            except Exception:  # noqa: BLE001 — a bad feed must not stop the loop
+                log.debug("elastic load signal failed", exc_info=True)
+        demand = stats["running"] + stats["queued"] + extra
         load = demand / slots
         now = time.monotonic()
         self._last_signal = {
             "running": stats["running"], "queued": stats["queued"],
+            "extra": extra,
             "live": live, "slots": slots, "load": round(load, 4),
         }
         self._note_fleet()

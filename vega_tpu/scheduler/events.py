@@ -325,6 +325,59 @@ class ShufflePushCompleted(Event):
     wall_s: float = 0.0
 
 
+@dataclasses.dataclass
+class ReceiverStarted(Event):
+    """A streaming receiver thread (streaming/source.py) began ingesting —
+    at stream start (attempt=0) or after a crash restart (attempt>0, the
+    replay-from-offsets path: `from_offset` is where ingest resumes)."""
+
+    stream_id: int = -1
+    kind: str = ""  # "generator" | "file_tail" | "socket"
+    attempt: int = 0
+    from_offset: int = 0
+
+
+@dataclasses.dataclass
+class BatchSubmitted(Event):
+    """One micro-batch was formed from receiver blocks and its output
+    jobs entered the job server (streaming/context.py). `attempt` > 0
+    marks a replay of a batch whose jobs failed — same batch_id, same
+    blocks, recomputed from the tiered store, never from the wire."""
+
+    batch_id: int = -1
+    records: int = 0
+    blocks: int = 0
+    pool: str = "streaming"
+    attempt: int = 0
+
+
+@dataclasses.dataclass
+class BatchCompleted(Event):
+    """One micro-batch's output jobs settled. wall_s is form-to-settle
+    wall (the number the backpressure controller compares against the
+    batch interval); succeeded=False means the batch will replay."""
+
+    batch_id: int = -1
+    wall_s: float = 0.0
+    records: int = 0
+    succeeded: bool = True
+    pool: str = "streaming"
+
+
+@dataclasses.dataclass
+class StateCheckpointed(Event):
+    """A stateful stream committed its (batch_id, offsets, state) record
+    through the checkpoint machinery (streaming/state.py). duplicate=True
+    marks a commit attempt for an already-committed batch_id — detected
+    and SKIPPED (the exactly-once dedup; chaos tests assert the counter
+    of real commits, and that duplicates stay zero-effect)."""
+
+    batch_id: int = -1
+    keys: int = 0
+    wall_s: float = 0.0
+    duplicate: bool = False
+
+
 class Listener:
     def on_event(self, event: Event) -> None:
         raise NotImplementedError
@@ -528,6 +581,21 @@ class MetricsListener(Listener):
             "result_bytes": 0,
             "driver_serialized_bytes": 0,
         }
+        # Streaming plane (vega_tpu/streaming/): receiver lifecycle,
+        # micro-batch throughput, and the exactly-once commit ledger.
+        # tests/test_streaming.py keys zero-duplicate-commit proofs on
+        # these; benchmarks/streaming_ab.py surfaces them.
+        self.streaming: Dict[str, Any] = {
+            "receivers_started": 0, "receiver_restarts": 0,
+            "batches_submitted": 0, "batch_replays": 0,
+            "batches_completed": 0, "batch_failures": 0,
+            "records": 0, "blocks": 0, "batch_wall_s": 0.0,
+            "state_checkpoints": 0, "duplicate_commits": 0,
+        }
+        # Per-pool job wall samples (bounded ring, newest-biased): the
+        # source for pool_latency() p50/p95. The streaming backpressure
+        # controller and fleet_status() both read these.
+        self._pool_walls: Dict[str, list] = {}
         self._lock = named_lock("scheduler.events.MetricsListener._lock")
 
     def _job(self, job_id: int) -> Dict[str, Any]:
@@ -553,6 +621,14 @@ class MetricsListener(Listener):
                 if event.cancelled:
                     info["cancelled"] = True
                     self.jobs_cancelled += 1
+                elif event.succeeded:
+                    # Pool latency sample (cancelled/failed walls would
+                    # skew the percentiles the rate controller steers by).
+                    pool = info.get("pool", "default")
+                    walls = self._pool_walls.setdefault(pool, [])
+                    walls.append(event.duration_s)
+                    if len(walls) > 512:
+                        del walls[:256]
             elif isinstance(event, StageSubmitted):
                 self.stages[event.stage_id] = {
                     "tasks": event.num_tasks,
@@ -662,6 +738,28 @@ class MetricsListener(Listener):
                 # Cumulative map-side push wall: the number that explains
                 # a map-stage regression on the push leg of an A/B.
                 sp["wall_s"] += event.wall_s
+            elif isinstance(event, ReceiverStarted):
+                self.streaming["receivers_started"] += 1
+                if event.attempt > 0:
+                    self.streaming["receiver_restarts"] += 1
+            elif isinstance(event, BatchSubmitted):
+                self.streaming["batches_submitted"] += 1
+                if event.attempt > 0:
+                    self.streaming["batch_replays"] += 1
+                else:
+                    # Replays re-run the SAME blocks: count records once.
+                    self.streaming["records"] += event.records
+                    self.streaming["blocks"] += event.blocks
+            elif isinstance(event, BatchCompleted):
+                self.streaming["batches_completed"] += 1
+                self.streaming["batch_wall_s"] += event.wall_s
+                if not event.succeeded:
+                    self.streaming["batch_failures"] += 1
+            elif isinstance(event, StateCheckpointed):
+                if event.duplicate:
+                    self.streaming["duplicate_commits"] += 1
+                else:
+                    self.streaming["state_checkpoints"] += 1
             elif isinstance(event, BlockSpilled):
                 self.spill_count += 1
                 self.spilled_bytes[event.store] = (
@@ -671,11 +769,48 @@ class MetricsListener(Listener):
                 self.promoted_bytes[event.store] = (
                     self.promoted_bytes.get(event.store, 0) + event.nbytes)
 
+    @staticmethod
+    def _percentile(ordered: list, q: float) -> float:
+        """Nearest-rank percentile over an already-sorted sample."""
+        idx = min(len(ordered) - 1, max(0, int(q * len(ordered))))
+        return ordered[idx]
+
+    def _pool_latency_locked(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for pool, walls in self._pool_walls.items():
+            if not walls:
+                continue
+            ordered = sorted(walls)
+            out[pool] = {
+                "count": len(ordered),
+                "p50_s": round(self._percentile(ordered, 0.50), 6),
+                "p95_s": round(self._percentile(ordered, 0.95), 6),
+            }
+        return out
+
+    def pool_latency(self) -> Dict[str, Dict[str, float]]:
+        """Per-pool job-wall percentiles {pool: {count, p50_s, p95_s}}
+        over a bounded recent window. The streaming backpressure
+        controller steers on its pool's p50/p95 vs the batch interval;
+        fleet_status() surfaces the whole map."""
+        with self._lock:
+            return self._pool_latency_locked()
+
     def job_summary(self, job_id: int) -> Dict[str, Any]:
         """One job's aggregate (tasks, failures, task seconds, pool,
-        duration once ended) — the per-tenant view of summary()."""
+        duration once ended) — the per-tenant view of summary(). Includes
+        the job's pool latency percentiles (pool_p50_s/pool_p95_s) so a
+        tenant can see its pool's recent batch walls in one read."""
         with self._lock:
-            return dict(self.jobs.get(job_id, {}))
+            info = dict(self.jobs.get(job_id, {}))
+            walls = self._pool_walls.get(info.get("pool", "default"))
+            if walls:
+                ordered = sorted(walls)
+                info["pool_p50_s"] = round(
+                    self._percentile(ordered, 0.50), 6)
+                info["pool_p95_s"] = round(
+                    self._percentile(ordered, 0.95), 6)
+            return info
 
     def summary(self) -> Dict[str, Any]:
         with self._lock:
@@ -716,4 +851,8 @@ class MetricsListener(Listener):
                                      self.shuffle_push["wall_s"], 6)},
                 "exchange_plans": dict(self.exchange_plans),
                 "dispatch": dict(self.dispatch),
+                "streaming": {**self.streaming,
+                              "batch_wall_s": round(
+                                  self.streaming["batch_wall_s"], 6)},
+                "pool_latency": self._pool_latency_locked(),
             }

@@ -124,6 +124,15 @@ class TieredCache:
         return (self.memory.contains(space, datum_id, partition)
                 or self.disk.contains(_disk_key(space, datum_id, partition)))
 
+    def remove(self, space: KeySpace, datum_id: int, partition: int) -> None:
+        """Drop ONE partition from both tiers (the datum's level registry
+        entry stays — other partitions may still be live). Streaming uses
+        this to retire individual receiver blocks once every window that
+        references them has committed, without tearing down the whole
+        stream's key space."""
+        self.memory.remove(space, datum_id, partition)
+        self.disk.remove(_disk_key(space, datum_id, partition))
+
     def remove_datum(self, space: KeySpace, datum_id: int) -> None:
         self.memory.remove_datum(space, datum_id)
         self.disk.remove_prefix(f"cache-{space.name.lower()}-{datum_id}-")

@@ -1,0 +1,28 @@
+"""Micro-batch streaming engine (discretized streams).
+
+No reference-repo counterpart: rajasekarv/vega never ported Spark
+Streaming (docs/PARITY.md). The subsystem composes planes that already
+exist — receivers land offset-tracked, replayable blocks in the PR 1
+tiered store; every interval those blocks become an ordinary RDD lineage
+submitted through the PR 7 job server into a dedicated fair pool; stateful
+folds commit (batch_id, offsets, state) records atomically through the
+checkpoint machinery (exactly-once); and a rate controller bounds receiver
+ingest from the pool's batch-wall percentiles, feeding the PR 12 elastic
+controller's load signal.
+"""
+
+from vega_tpu.streaming.context import StreamingContext
+from vega_tpu.streaming.dstream import DStream
+from vega_tpu.streaming.source import (
+    FileTailSource,
+    GeneratorSource,
+    SocketSource,
+)
+
+__all__ = [
+    "StreamingContext",
+    "DStream",
+    "GeneratorSource",
+    "FileTailSource",
+    "SocketSource",
+]

@@ -1,0 +1,431 @@
+"""StreamingContext: the micro-batch driver loop.
+
+Every interval the loop (one daemon thread on the driver):
+  1. restarts any crashed receiver from its tracked offset
+     (ReceiverStarted attempt+1 — replay-from-offsets, ingest half);
+  2. forms a batch: flushes partial blocks, drains each receiver's
+     pending queue (an in-flight failed batch is retried FIRST, with the
+     same batch_id and the same blocks — recomputed from the tiered
+     store, never the wire);
+  3. compiles every registered output's recipe over the batch's
+     StreamBlockRDD and runs it with the thread-local pool set to the
+     stream pool, so all resulting jobs are fair-share arbitrated and
+     admission-bounded as streaming work — a batch tenant in a sibling
+     pool cannot starve them;
+  4. folds stateful streams (device segment-reduce for named monoids,
+     host otherwise) and commits (batch_id, offsets, state) atomically
+     through streaming/state.py — the exactly-once seam;
+  5. on success: drains the backpressure queue, retires blocks no window
+     can reach, advances the batch id. On failure: emits
+     BatchCompleted(succeeded=False) and replays next tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import operator
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from vega_tpu.cache import KeySpace
+from vega_tpu.env import Env
+from vega_tpu.scheduler import events
+from vega_tpu.streaming.controller import RateController
+from vega_tpu.streaming.dstream import DStream, StreamBlockRDD
+from vega_tpu.streaming.source import (
+    FileTailSource,
+    GeneratorSource,
+    SocketSource,
+)
+from vega_tpu.streaming.state import StateStore
+
+log = logging.getLogger("vega_tpu")
+
+# How many times one batch may replay before the stream is declared
+# failed (a deterministic bug would otherwise replay forever).
+MAX_BATCH_REPLAYS = 5
+
+_HOST_FOLDS = {
+    "add": operator.add,
+    "min": min,
+    "max": max,
+    "prod": operator.mul,
+}
+
+
+class InputStream(DStream):
+    """Root DStream: one receiver's discretized block sequence."""
+
+    def __init__(self, sctx, receiver):
+        super().__init__(sctx, source=self)
+        self.receiver = receiver
+        self.stream_id = receiver.stream_id
+
+
+class StatefulStream:
+    """Handle returned by update_state_by_key: per-batch fold + commit,
+    and the user's window into committed state."""
+
+    def __init__(self, sctx, dstream: DStream, store: StateStore,
+                 func: Optional[Callable], op: Optional[str]):
+        self.sctx = sctx
+        self.dstream = dstream
+        self.store = store
+        self.func = func
+        self.op = op
+
+    # ------------------------------------------------------------ user api
+    def snapshot(self) -> Dict[Any, Any]:
+        """Committed state as of the last successful batch."""
+        return self.store.snapshot()
+
+    def get(self, key, default=None):
+        return self.store.get(key, default)
+
+    # --------------------------------------------------------- batch logic
+    def process(self, batch_id: int, rdd, offsets: Dict[int, int]) -> None:
+        pairs = self.sctx._collect(rdd)
+        updates = self._fold(pairs)
+        self.store.apply_batch(batch_id, offsets, updates)
+
+    def _fold(self, pairs: List[Tuple[Any, Any]]) -> Dict[Any, Any]:
+        if self.op is not None:
+            folded = None
+            if pairs:
+                from vega_tpu.tpu.state_fold import fold_pairs_device
+
+                folded = fold_pairs_device(self.sctx.ctx, pairs, self.op)
+            if folded is None:  # host fold — identical result, by contract
+                combine = _HOST_FOLDS[self.op]
+                folded = {}
+                for k, v in pairs:
+                    folded[k] = v if k not in folded else combine(
+                        folded[k], v)
+            combine = _HOST_FOLDS[self.op]
+            return {k: v if self.store.get(k) is None
+                    else combine(self.store.get(k), v)
+                    for k, v in folded.items()}
+        grouped: Dict[Any, List[Any]] = {}
+        for k, v in pairs:  # offset order within each key, by construction
+            grouped.setdefault(k, []).append(v)
+        return {k: self.func(values, self.store.get(k))
+                for k, values in grouped.items()}
+
+
+class StreamingContext:
+    def __init__(self, ctx, batch_interval_s: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None):
+        conf = ctx.conf
+        self.ctx = ctx
+        self.interval_s = (batch_interval_s if batch_interval_s is not None
+                           else conf.stream_batch_interval_s)
+        self.pool = conf.stream_pool
+        ctx.set_pool(self.pool, weight=conf.stream_pool_weight)
+        self.controller = RateController(conf, ctx.metrics, self.pool,
+                                         self.interval_s)
+        self.checkpoint_dir = (
+            checkpoint_dir or conf.stream_checkpoint_dir
+            or os.path.join(Env.get().work_dir(), "streaming"))
+        self._conf = conf
+        self._inputs: List[InputStream] = []
+        self._outputs: List[Tuple[DStream, Callable]] = []
+        self._stateful: List[StatefulStream] = []
+        # Per stream: [(batch_id, [Block, ...]), ...] — newest last; depth
+        # bounded by the widest registered window (set at start()).
+        self._history: Dict[int, List[Tuple[int, List]]] = {}
+        self._offsets: Dict[int, int] = {}  # end offset per stream so far
+        self._inflight = None  # (batch_id, {sid: blocks}, offsets, attempt)
+        self._window = 1
+        self._batch_id = 0
+        self._started = False
+        self._stopped = False
+        self.failed: Optional[str] = None
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if ctx.elastic is not None:
+            ctx.elastic.add_load_signal(self.controller.load_signal)
+
+    # ----------------------------------------------------------------- sources
+    def generator_stream(self, fn: Callable[[int], Any]) -> InputStream:
+        """Offset-addressed generator source: fn(offset) -> record | None
+        (None = no data yet). fn must be deterministic and picklable —
+        it IS the replay path."""
+        return self._add_input(
+            lambda sid: GeneratorSource(sid, self.controller, self._conf,
+                                        fn))
+
+    def file_tail_stream(self, path: str) -> InputStream:
+        """tail -f over an append-only line file (byte offsets)."""
+        return self._add_input(
+            lambda sid: FileTailSource(sid, self.controller, self._conf,
+                                       path))
+
+    def socket_stream(self, host: str, port: int) -> InputStream:
+        """Line-delimited TCP source; reads carry
+        stream_socket_timeout_s."""
+        return self._add_input(
+            lambda sid: SocketSource(sid, self.controller, self._conf,
+                                     host, port))
+
+    def _add_input(self, make) -> InputStream:
+        self._check_mutable()
+        receiver = make(len(self._inputs))
+        stream = InputStream(self, receiver)
+        self._inputs.append(stream)
+        return stream
+
+    # ------------------------------------------------------------ registration
+    def _register_output(self, dstream: DStream, fn: Callable) -> None:
+        self._check_mutable()
+        self._outputs.append((dstream, fn))
+
+    def _register_stateful(self, dstream: DStream, func, op,
+                           num_partitions: int) -> StatefulStream:
+        self._check_mutable()
+        if op is not None and op not in _HOST_FOLDS:
+            raise ValueError(f"unknown named op {op!r}; expected one of "
+                             f"{sorted(_HOST_FOLDS)}")
+        store = StateStore(
+            self.ctx,
+            os.path.join(self.checkpoint_dir,
+                         f"stateful-{len(self._stateful)}"),
+            num_partitions=num_partitions)
+        handle = StatefulStream(self, dstream, store, func=func, op=op)
+        self._stateful.append(handle)
+        return handle
+
+    def _check_mutable(self) -> None:
+        if self._started:
+            raise RuntimeError(
+                "streams and outputs must be declared before start()")
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("StreamingContext already started")
+        if not self._outputs and not self._stateful:
+            raise RuntimeError("no output registered: call foreach_rdd "
+                               "or update_state_by_key before start()")
+        self._started = True
+        streams = ([d for d, _ in self._outputs]
+                   + [h.dstream for h in self._stateful])
+        self._window = max([d.window_intervals for d in streams] or [1])
+        # Recovery: resume from the EARLIEST committed frontier across
+        # stateful stores — the batch a lagging store never committed
+        # replays from source offsets; a store already past it detects
+        # the duplicate batch_id and skips (zero-effect), keeping every
+        # store exactly-once.
+        recovered: List[Dict[int, int]] = []
+        last_batches: List[int] = []
+        for handle in self._stateful:
+            offs = handle.store.recover()
+            if offs is not None:
+                recovered.append(offs)
+                last_batches.append(handle.store.last_committed_batch)
+        if recovered:
+            self._batch_id = min(last_batches) + 1
+            for sid in set().union(*recovered):
+                frontier = min(o[sid] for o in recovered if sid in o)
+                self._offsets[sid] = frontier
+        for stream in self._inputs:
+            receiver = stream.receiver
+            self._history[stream.stream_id] = []
+            from_offset = self._offsets.get(stream.stream_id, 0)
+            self._offsets[stream.stream_id] = from_offset
+            receiver.start(from_offset=from_offset)
+            self.ctx.bus.post(events.ReceiverStarted(
+                stream_id=stream.stream_id, kind=receiver.kind,
+                attempt=0, from_offset=from_offset))
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="stream-batches")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if not self._started or self._stopped:
+            self._stopped = True
+            return
+        self._stopped = True
+        self._stop_evt.set()
+        for stream in self._inputs:
+            stream.receiver.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        # Retire every stream's blocks from the tiered store; committed
+        # state survives in the checkpoint dir for the next context.
+        cache = Env.get().cache
+        for stream in self._inputs:
+            cache.remove_datum(KeySpace.STREAM, stream.stream_id)
+
+    def await_batches(self, n: int, timeout_s: float = 30.0) -> bool:
+        """Test/driver helper: block until n batches have completed
+        successfully since start (or the stream fails / times out)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.failed is not None:
+                return False
+            if self._batch_id >= n and self._inflight is None:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "interval_s": self.interval_s,
+            "pool": self.pool,
+            "batches_committed": self._batch_id,
+            "inflight": self._inflight is not None,
+            "failed": self.failed,
+            "controller": self.controller.status(),
+            "receivers": [{
+                "stream_id": s.stream_id,
+                "kind": s.receiver.kind,
+                "attempt": s.receiver.attempt,
+                "crashed": s.receiver.crashed,
+                "next_offset": s.receiver.next_offset,
+                "blocks_landed": s.receiver.blocks_landed,
+                "shed_blocks": s.receiver.shed_blocks,
+                "shed_records": s.receiver.shed_records,
+            } for s in self._inputs],
+            "stateful": [{
+                "last_committed_batch": h.store.last_committed_batch,
+                "commits": h.store.commits,
+                "duplicate_commits": h.store.duplicate_commits,
+                "keys": len(h.store.snapshot()),
+            } for h in self._stateful],
+        }
+
+    # --------------------------------------------------------------- internals
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — loop must survive a bad tick
+                log.warning("streaming tick failed", exc_info=True)
+            if self.failed is not None:
+                return
+
+    def _tick(self) -> None:
+        self._restart_crashed_receivers()
+        if self._inflight is None:
+            formed = self._form_batch()
+            if formed is None:
+                return  # nothing new this interval
+            self._inflight = formed
+        batch_id, batch_blocks, offsets, attempt = self._inflight
+        if attempt > MAX_BATCH_REPLAYS:
+            self.failed = (f"batch {batch_id} failed after "
+                           f"{MAX_BATCH_REPLAYS} replays")
+            log.error("streaming stopped: %s", self.failed)
+            return
+        if self._execute(batch_id, batch_blocks, offsets, attempt):
+            self._settle(batch_id, batch_blocks, offsets)
+        else:
+            self._inflight = (batch_id, batch_blocks, offsets, attempt + 1)
+
+    def _restart_crashed_receivers(self) -> None:
+        for stream in self._inputs:
+            receiver = stream.receiver
+            if receiver.crashed and not self._stop_evt.is_set():
+                receiver.attempt += 1
+                log.warning("restarting receiver %d (attempt %d) from "
+                            "offset %d", stream.stream_id,
+                            receiver.attempt, receiver.next_offset)
+                receiver.start()  # resumes from its tracked offset
+                self.ctx.bus.post(events.ReceiverStarted(
+                    stream_id=stream.stream_id, kind=receiver.kind,
+                    attempt=receiver.attempt,
+                    from_offset=receiver.next_offset))
+
+    def _form_batch(self):
+        """Drain receiver queues into one batch. None if no stream has
+        new blocks (empty intervals are skipped — no jobs, no commits)."""
+        batch_blocks: Dict[int, List] = {}
+        offsets = dict(self._offsets)
+        total = 0
+        for stream in self._inputs:
+            stream.receiver.flush()
+            blocks = stream.receiver.take_pending()
+            batch_blocks[stream.stream_id] = blocks
+            if blocks:
+                offsets[stream.stream_id] = blocks[-1].end_offset
+                total += len(blocks)
+        if total == 0:
+            return None
+        return (self._batch_id, batch_blocks, offsets, 0)
+
+    def _execute(self, batch_id: int, batch_blocks: Dict[int, List],
+                 offsets: Dict[int, int], attempt: int) -> bool:
+        records = sum(b.count for blocks in batch_blocks.values()
+                      for b in blocks)
+        nblocks = sum(len(blocks) for blocks in batch_blocks.values())
+        self.ctx.bus.post(events.BatchSubmitted(
+            batch_id=batch_id, records=records, blocks=nblocks,
+            pool=self.pool, attempt=attempt))
+        start = time.time()
+        # All jobs this thread triggers — including ones inside user
+        # foreach_rdd callbacks — land in the stream pool.
+        self.ctx.set_local_property("pool", self.pool)
+        ok = True
+        try:
+            for dstream, fn in self._outputs:
+                fn(dstream.compile(self._input_rdd(dstream, batch_blocks)),
+                   batch_id)
+            for handle in self._stateful:
+                handle.process(
+                    batch_id,
+                    handle.dstream.compile(
+                        self._input_rdd(handle.dstream, batch_blocks)),
+                    offsets)
+        except Exception:  # noqa: BLE001 — a failed batch replays
+            ok = False
+            log.warning("batch %d attempt %d failed; will replay from "
+                        "stored blocks", batch_id, attempt, exc_info=True)
+        self.ctx.bus.post(events.BatchCompleted(
+            batch_id=batch_id, wall_s=round(time.time() - start, 6),
+            records=records, succeeded=ok, pool=self.pool))
+        return ok
+
+    def _input_rdd(self, dstream: DStream, batch_blocks: Dict[int, List]):
+        sid = dstream.source.stream_id
+        window = dstream.window_intervals
+        blocks: List = []
+        if window > 1:
+            for _, past in self._history[sid][-(window - 1):]:
+                blocks.extend(past)
+        blocks.extend(batch_blocks.get(sid, ()))
+        return StreamBlockRDD(self.ctx, blocks)
+
+    def _settle(self, batch_id: int, batch_blocks: Dict[int, List],
+                offsets: Dict[int, int]) -> None:
+        """Success: advance offsets, drain the backpressure queue, push
+        history, retire blocks no window reaches any more."""
+        self._offsets.update(offsets)
+        cache = Env.get().cache
+        nblocks = 0
+        for sid, blocks in batch_blocks.items():
+            nblocks += len(blocks)
+            history = self._history[sid]
+            history.append((batch_id, blocks))
+            while len(history) > self._window:
+                _, retired = history.pop(0)
+                for block in retired:
+                    cache.remove(KeySpace.STREAM, sid, block.seq)
+        self.controller.blocks_consumed(nblocks)
+        self._inflight = None
+        self._batch_id = batch_id + 1
+
+    def _collect(self, rdd) -> list:
+        """Materialize a per-batch RDD through the job server (stream
+        pool via the loop thread's local property), partition order
+        preserved — i.e. block/offset order."""
+        future = self.ctx.submit_job(rdd, lambda tc, it: list(it))
+        try:
+            parts = future.result(max(30.0, self.interval_s * 120))
+        except BaseException:
+            # Timed-out/interrupted batch job must not keep holding
+            # arbiter slots while its batch replays.
+            future.cancel("streaming batch attempt abandoned")
+            raise
+        return [rec for part in parts for rec in part]

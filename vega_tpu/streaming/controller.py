@@ -1,0 +1,119 @@
+"""Backpressure rate controller for the streaming plane.
+
+Mirrors PR 12 admission control one layer down: the job server bounds
+JOBS at the front door (reject/block); this controller bounds receiver
+BLOCKS at the ingest door (shed/block). The bound is
+stream_queue_max_blocks pending (landed, not yet consumed by a completed
+batch) blocks across all receivers; when the stream's pool is falling
+behind — its recent job-wall p95 (MetricsListener.pool_latency) exceeds
+the batch interval — the effective bound halves, throttling ingest
+*before* the queue hits the hard wall.
+
+The controller is also the streaming plane's load signal for the PR 12
+elastic controller (ElasticController.add_load_signal): pending blocks
+read as queued demand, so sustained stream pressure scales the fleet up
+exactly like a deep batch queue does.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+# The wait/notify handshake lives on a plain Condition, deliberately
+# outside the sync-witness (same stance as jobserver._admit): a parked
+# receiver holds no other lock, and the witness's ordering graph has
+# nothing to learn from a leaf condvar.
+
+
+class RateController:
+    def __init__(self, conf, metrics, pool: str, interval_s: float):
+        self.mode = conf.stream_backpressure_mode  # "block" | "shed"
+        if self.mode not in ("block", "shed"):
+            raise ValueError(
+                f"stream_backpressure_mode must be 'block' or 'shed', "
+                f"got {self.mode!r}")
+        self.max_blocks = max(1, conf.stream_queue_max_blocks)
+        self.metrics = metrics
+        self.pool = pool
+        self.interval_s = interval_s
+        self._cond = threading.Condition()
+        self._pending = 0
+        self.max_depth_seen = 0
+        self.shed_blocks = 0
+        self.throttled_offers = 0
+
+    # ----------------------------------------------------------- receivers
+    def offer_block(self, stop_event) -> str:
+        """Receiver-side gate, called BEFORE landing a block. Returns
+        "land" (go ahead), "shed" (drop it, advance offsets), or "stop"
+        (the receiver is shutting down mid-park)."""
+        bound = self._effective_bound()
+        with self._cond:
+            if self._pending < bound:
+                return "land"
+            self.throttled_offers += 1
+            if self.mode == "shed":
+                self.shed_blocks += 1
+                return "shed"
+            while self._pending >= self._effective_bound():
+                self._cond.wait(0.05)
+                if stop_event.is_set():
+                    return "stop"
+            return "land"
+
+    def block_landed(self) -> None:
+        with self._cond:
+            self._pending += 1
+            if self._pending > self.max_depth_seen:
+                self.max_depth_seen = self._pending
+
+    # ---------------------------------------------------------- batch loop
+    def blocks_consumed(self, n: int) -> None:
+        """A batch containing n blocks completed successfully — the queue
+        drains and parked receivers wake."""
+        if n <= 0:
+            return
+        with self._cond:
+            self._pending = max(0, self._pending - n)
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------- signals
+    def _effective_bound(self) -> int:
+        """The queue bound, halved while the stream pool falls behind
+        (recent p95 job wall above the batch interval)."""
+        if self.behind():
+            return max(1, self.max_blocks // 2)
+        return self.max_blocks
+
+    def behind(self) -> bool:
+        lat = self.metrics.pool_latency().get(self.pool)
+        return bool(lat) and lat["p95_s"] > self.interval_s
+
+    def pending_blocks(self) -> int:
+        with self._cond:
+            return self._pending
+
+    def load_signal(self) -> int:
+        """Extra demand for the elastic controller's _decide: pending
+        blocks read as queued work units."""
+        return self.pending_blocks()
+
+    def status(self) -> Dict[str, Any]:
+        lat = self.metrics.pool_latency().get(self.pool, {})
+        with self._cond:
+            pending = self._pending
+            max_depth = self.max_depth_seen
+            shed = self.shed_blocks
+            throttled = self.throttled_offers
+        return {
+            "mode": self.mode,
+            "interval_s": self.interval_s,
+            "pending_blocks": pending,
+            "queue_max_blocks": self.max_blocks,
+            "max_depth_seen": max_depth,
+            "shed_blocks": shed,
+            "throttled_offers": throttled,
+            "behind": bool(lat) and lat.get("p95_s", 0.0) > self.interval_s,
+            "pool_latency": lat,
+        }

@@ -1,0 +1,133 @@
+"""DStreams: discretized streams compiled to per-batch RDD lineages.
+
+A DStream is a RECIPE, not data: a chain of transformations rooted at one
+input stream. Every batch interval the StreamingContext materializes the
+input's blocks as a StreamBlockRDD (one partition per block) and runs the
+recipe over it — an ordinary lineage on the ordinary engine, so the
+two-tier invariant applies unchanged: traceable closures may lower to the
+device tier downstream, untraceable ones silently stay host-side.
+
+Only OUTPUT operations (foreach_rdd, update_state_by_key) do work; a
+DStream with no registered output compiles to nothing. Window(n) widens
+the input to the last n batches' blocks — blocks are retired from the
+tiered store only once no window can reach them.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterator, List, Optional
+
+from vega_tpu.rdd.base import RDD
+from vega_tpu.split import Split
+
+log = logging.getLogger("vega_tpu")
+
+
+class StreamBlockRDD(RDD):
+    """One micro-batch's input: one partition per receiver block. Each
+    split carries its Block (picklable: store key + offsets + replay
+    handle), so an executor computes it from the driver-landed store copy
+    when visible, else replays the exact offset span — never the wire."""
+
+    def __init__(self, ctx, blocks: List):
+        super().__init__(ctx)
+        self._blocks = list(blocks)
+
+    @property
+    def num_partitions(self) -> int:
+        return max(1, len(self._blocks))
+
+    def splits(self) -> List[Split]:
+        if not self._blocks:
+            return [Split(0, payload=None)]
+        return [Split(i, payload=b) for i, b in enumerate(self._blocks)]
+
+    def compute(self, split: Split, task_context=None) -> Iterator:
+        block = split.payload
+        if block is None:
+            return iter(())
+        return iter(block.records())
+
+
+class DStream:
+    """A transformation recipe over one input stream. `source` is the
+    root InputStream (streaming/context.py); `window` is how many recent
+    batches of blocks feed one compilation (1 = just this batch)."""
+
+    def __init__(self, sctx, source, transform: Optional[Callable] = None,
+                 window: int = 1):
+        self.sctx = sctx
+        self.source = source
+        self._transform = transform if transform is not None else (
+            lambda rdd: rdd)
+        self.window_intervals = window
+
+    # -------------------------------------------------------- transformations
+    def _derive(self, f: Callable[[RDD], RDD]) -> "DStream":
+        inner = self._transform
+        return DStream(self.sctx, self.source,
+                       lambda rdd: f(inner(rdd)), self.window_intervals)
+
+    def map(self, f: Callable) -> "DStream":
+        return self._derive(lambda rdd: rdd.map(f))
+
+    def filter(self, f: Callable) -> "DStream":
+        return self._derive(lambda rdd: rdd.filter(f))
+
+    def flat_map(self, f: Callable) -> "DStream":
+        return self._derive(lambda rdd: rdd.flat_map(f))
+
+    def map_partitions(self, f: Callable) -> "DStream":
+        return self._derive(lambda rdd: rdd.map_partitions(f))
+
+    def reduce_by_key(self, func: Callable,
+                      partitioner_or_num: Any = None) -> "DStream":
+        return self._derive(
+            lambda rdd: rdd.reduce_by_key(func, partitioner_or_num))
+
+    def window(self, length_intervals: int) -> "DStream":
+        """Widen the input to the last `length_intervals` batches — the
+        windowed-aggregate primitive (e.g. .window(6).reduce_by_key(add)
+        over a 0.5s interval = sliding 3s sums, recomputed per batch from
+        retained blocks)."""
+        if length_intervals < 1:
+            raise ValueError("window length must be >= 1 interval")
+        return DStream(self.sctx, self.source, self._transform,
+                       max(self.window_intervals, length_intervals))
+
+    # -------------------------------------------------------------- outputs
+    def foreach_rdd(self, fn: Callable[[RDD, int], Any]) -> "DStream":
+        """Register `fn(rdd, batch_id)` to run per batch on the batch
+        loop thread — with the thread-local pool set to the stream pool,
+        so any action `fn` triggers is arbitrated and admission-bounded
+        as streaming work."""
+        self.sctx._register_output(self, fn)
+        return self
+
+    def update_state_by_key(self, func: Optional[Callable] = None, *,
+                            op: Optional[str] = None,
+                            num_partitions: int = 2):
+        """Register a stateful fold over (key, value) records; returns
+        the StatefulStream handle (snapshot/store access).
+
+        Exactly one of:
+          op    — named monoid ('add'/'min'/'max'/'prod'): the batch is
+                  segment-reduced on the device tier when representable
+                  (tpu/state_fold), host otherwise — same result either
+                  way — and the old state combines with the batch fold
+                  by the same op.
+          func  — arbitrary `func(values, old_state) -> new_state`
+                  (host tier; `values` is the batch's list for the key,
+                  in offset order). Returning None deletes the key.
+        """
+        if (func is None) == (op is None):
+            raise ValueError(
+                "update_state_by_key takes exactly one of func= or op=")
+        return self.sctx._register_stateful(self, func=func, op=op,
+                                            num_partitions=num_partitions)
+
+    # -------------------------------------------------------------- compile
+    def compile(self, batch_rdd: RDD) -> RDD:
+        """One interval: recipe applied to this batch's input RDD."""
+        return self._transform(batch_rdd)

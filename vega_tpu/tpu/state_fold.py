@@ -1,0 +1,76 @@
+"""Device lowering for streaming stateful folds.
+
+A micro-batch's update_state_by_key with a NAMED monoid op ('add'/'min'/
+'max'/'prod') is a segment-reduce over (key, value) pairs — exactly the
+dense tier's reduce_by_key fast path (kernels.segment_reduce via the
+2-sort exchange). This module is the bridge: given the batch's host-side
+pairs, it builds a dense pair block, runs the named reduce on the mesh,
+and hands back a plain {key: value} dict for the state commit.
+
+Contract (the two-tier invariant applied to streaming):
+  - ONLY sound named ops take this path — never value probing, never
+    arbitrary closures (those fold on the host, silently).
+  - Any representability failure (non-numeric keys/values, int64 beyond
+    device range, no usable mesh) returns None and the caller folds on
+    the host — silent fallback, never an error, never a wrong result.
+  - Results must be bit-identical to the host fold for integer data; the
+    exactly-once chaos proofs run integer payloads through BOTH paths.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+log = logging.getLogger("vega_tpu")
+
+_NAMED_OPS = ("add", "min", "max", "prod")
+
+
+def fold_pairs_device(ctx, pairs, op: str) -> Optional[Dict]:
+    """Segment-reduce `pairs` ([(k, v), ...]) by key with named op `op` on
+    the device tier. Returns {key: folded} or None to signal the caller
+    to take the host path. `pairs` must be non-empty."""
+    if op not in _NAMED_OPS:
+        return None
+    try:
+        import numpy as np
+    except Exception:  # noqa: BLE001 — no numpy, host fold
+        return None
+    try:
+        keys = np.asarray([k for k, _ in pairs])
+        vals = np.asarray([v for _, v in pairs])
+    except (TypeError, ValueError):
+        return None
+    if keys.dtype.kind not in "iu" or vals.dtype.kind not in "iuf":
+        # Non-integer keys or non-numeric values have no dense encoding.
+        return None
+    try:
+        from vega_tpu.errors import VegaError
+        from vega_tpu.tpu.dense_rdd import DenseRDD, dense_from_numpy
+
+        rdd = dense_from_numpy(ctx, (keys, vals))
+        if not isinstance(rdd, DenseRDD):
+            # dtype degrade already fell back to the host tier; folding
+            # there via the generic path is the caller's job.
+            return None
+        reduced = rdd.reduce_by_key(op=op)
+        out = dict(reduced.collect())
+    except VegaError as e:
+        log.info("streaming state fold fell back to host tier: %s", e)
+        return None
+    except Exception:  # noqa: BLE001 — device trouble must not kill a batch
+        log.info("streaming state fold fell back to host tier",
+                 exc_info=True)
+        return None
+    # Hand back host-native scalars so committed state round-trips
+    # bit-identically through the checkpoint serializer regardless of
+    # which tier folded it.
+    return {_pyval(k): _pyval(v) for k, v in out.items()}
+
+
+def _pyval(x):
+    try:
+        return x.item()
+    except AttributeError:
+        return x
