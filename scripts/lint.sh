@@ -5,7 +5,12 @@
 # gates on a clean lint. Rule catalog: docs/LINTING.md. The machine-
 # readable finding report (stable JSON schema) lands in
 # /tmp/vegalint.json for CI artifact pickup; repeat runs ride the
-# mtime-keyed result cache so the gate stays well under its 10s budget.
+# mtime-keyed result cache so the warm gate stays under its 2s budget.
+# Extra flags pass through: `scripts/lint.sh --changed` is the fast
+# pre-commit mode (per-file rules on files newer than the last clean
+# full sweep; any vega_tpu/ change falls back to the full sweep because
+# the project call graph's inputs moved). scripts/t1.sh always runs the
+# FULL sweep — --changed never gates tier-1.
 set -o pipefail
 cd "$(dirname "$0")/.."
 exec python -m vega_tpu.lint vega_tpu tests bench.py \

@@ -74,9 +74,13 @@ def pytest_terminal_summary(terminalreporter):
 
     if sync_witness.enabled():
         st = sync_witness.witness().stats()
+        roles = ", ".join(f"{r}({len(t)})"
+                          for r, t in sorted(st["roles"].items())) or "none"
         terminalreporter.write_line(
             f"sync-witness: {st['locks']} named locks, {st['edges']} "
-            f"order edges, {len(st['inversions'])} inversion(s)")
+            f"order edges, {len(st['inversions'])} inversion(s); roles "
+            f"observed: {roles}; "
+            f"{len(st['role_violations'])} role violation(s)")
 
 
 @pytest.fixture()

@@ -767,7 +767,10 @@ def test_push_plan_mapper_sigkilled_mid_push_bit_identical(
         kills = [s for s in faults.read_stats(stats_dir)
                  if s["fault"] == "kill_worker"]
         assert kills, "the injected SIGKILL never fired"
-        assert ctx.metrics_summary()["executors_lost"] >= 1
+        # Async-reaper race: fast dispatch-level re-dispatch can finish
+        # the job before ExecutorLost is emitted — wait, don't sample.
+        assert _wait_metric(ctx, "executors_lost", 1), \
+            "reaper never recorded the SIGKILLed executor"
         totals = _premerge_totals(ctx)
         # The pre-merge tier engaged (the kill cannot have silently forced
         # the whole job onto the pull plan). Replayed pushes from the

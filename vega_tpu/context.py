@@ -31,7 +31,7 @@ log = logging.getLogger("vega_tpu")
 
 
 import contextlib
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import assert_role, named_lock
 
 
 @contextlib.contextmanager
@@ -489,6 +489,7 @@ class Context:
 
     def stop(self) -> None:
         """Reference: context.rs:131-144 (drop/cleanup)."""
+        assert_role()  # driver teardown — never from a confined thread
         global _active_context
         if self._stopped:
             return

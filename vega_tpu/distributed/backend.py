@@ -62,7 +62,11 @@ from vega_tpu.errors import NetworkError, TaskError
 from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.dag import TaskBackend
 from vega_tpu.scheduler.task import Task, TaskEndEvent
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import (
+    assert_role,
+    named_lock,
+    note_thread_role,
+)
 
 log = logging.getLogger("vega_tpu")
 
@@ -390,6 +394,7 @@ class DistributedBackend(TaskBackend):
         """Driver-side liveness sweep: workers heartbeat into
         DriverService.workers; this thread is the thing that finally READS
         last_seen (the reference stored it and never looked)."""
+        note_thread_role("reaper")
         while not self._stop_event.wait(self.conf.executor_reap_interval_s):
             try:
                 self._sweep()
@@ -558,6 +563,7 @@ class DistributedBackend(TaskBackend):
         in `_executors`. Raises NetworkError if the worker never becomes
         ready — the caller (the elastic control loop) logs and retries on
         a later decision tick."""
+        assert_role("elastic")  # fleet mutation: driver-side control only
         with self._lock:
             if self._stopped:
                 raise NetworkError("backend is stopped; cannot scale up")
@@ -657,6 +663,7 @@ class DistributedBackend(TaskBackend):
         kill after a forced escalation. Also clears the slot's advisory
         state (known-hash set, blacklist count dies with the _Executor
         object) so a future slot under a fresh id starts clean."""
+        assert_role("elastic")  # fleet mutation: driver-side control only
         with self._lock:
             ex = self._executors.pop(executor_id, None)
             self._known_hashes.pop(executor_id, None)

@@ -36,7 +36,7 @@ from vega_tpu.distributed.driver_service import RemoteTrackerClient
 from vega_tpu.distributed.shuffle_server import ShuffleServer
 from vega_tpu.env import Configuration, DeploymentMode, Env
 from vega_tpu.errors import NetworkError
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import named_lock, note_thread_role
 from vega_tpu.scheduler.task import TaskBinaryCache, run_from_header
 
 log = logging.getLogger("vega_tpu")
@@ -56,6 +56,7 @@ def _pre_run_cancel_gate(cancel_event) -> None:
 
 class _TaskHandler(socketserver.BaseRequestHandler):
     def handle(self):
+        note_thread_role("worker-task")
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         worker: Worker = self.server.worker  # type: ignore[attr-defined]

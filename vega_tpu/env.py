@@ -18,7 +18,7 @@ import tempfile
 import uuid
 from typing import Optional
 
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import assert_role, named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -489,6 +489,10 @@ class Env:
     @classmethod
     def reset(cls, conf: Optional[Configuration] = None, is_driver: bool = True) -> "Env":
         """Replace the singleton (tests / worker bootstrap)."""
+        # Worker bootstrap calls this on the worker process's MAIN thread
+        # (un-noted -> passes); a task-handler or receiver thread doing it
+        # would corrupt every concurrent task's view of the Env.
+        assert_role()
         with cls._lock:
             cls._instance = Env(conf, is_driver)
         return cls._instance

@@ -1,20 +1,37 @@
 """CLI: python -m vega_tpu.lint [paths...] [--output text|json]
 [--json-out PATH] [--select VG001,VG003] [--list-rules] [--no-cache]
+[--changed] [--explain-role module.fn]
 
 Exit status: 0 clean, 1 unsuppressed findings (or unparseable files),
 2 usage error. The tier-1 entrypoint (scripts/t1.sh) gates on this via
 scripts/lint.sh, which also writes the machine-readable finding JSON
 (stable schema: engine.JSON_SCHEMA) to /tmp/vegalint.json via
 --json-out for CI artifact pickup.
+
+--changed lints only files modified since the last CLEAN full sweep
+(the stamp rides next to the result cache): nothing changed is an
+instant pass; a change under vega_tpu/ falls back to the full sweep
+(the project call graph's inputs changed); otherwise only the per-file
+rules run on the changed files (project rules and the VG000
+orphan-pragma check need full-tree context, so pre-commit speed trades
+them away — scripts/t1.sh keeps the full sweep).
+
+--explain-role prints the thread role(s) a function resolves to in the
+project call graph plus one witness call path per role — the debugging
+lens for VG016/VG019 findings.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from vega_tpu.lint.engine import (
+    JSON_SCHEMA,
     all_rules,
+    changed_since_stamp,
+    gather_extracts,
     render_json,
     render_text,
     run_lint,
@@ -41,6 +58,15 @@ def main(argv=None) -> int:
                              "all)")
     parser.add_argument("--no-cache", action="store_true",
                         help="bypass the mtime-keyed result cache")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files changed since the last "
+                             "clean full sweep (falls back to full when "
+                             "vega_tpu/ itself changed or no stamp "
+                             "exists)")
+    parser.add_argument("--explain-role", default=None, metavar="FN",
+                        help="print the role(s) a function (module.fn "
+                             "or Class.method suffix) resolves to, with "
+                             "one witness call path per role")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -52,8 +78,44 @@ def main(argv=None) -> int:
                 print(f"       {doc}")
         return 0
 
+    if args.explain_role:
+        from vega_tpu.lint import callgraph
+
+        records = gather_extracts(args.paths, "callgraph",
+                                  cache=not args.no_cache)
+        matches = callgraph.explain(records, args.explain_role)
+        if args.format == "json":
+            print(json.dumps({"schema": JSON_SCHEMA,
+                              "query": args.explain_role,
+                              "matches": matches},
+                             indent=1, sort_keys=True))
+        else:
+            for m in matches:
+                print(f"{m['function']}  ({m['file']}:{m['line']})")
+                if not m["roles"]:
+                    print("    roles: none (driver-api by default)")
+                for role, path in m["roles"].items():
+                    print(f"    {role}: {' -> '.join(path)}")
+            if not matches:
+                print(f"no function matching {args.explain_role!r} in "
+                      "the call graph", file=sys.stderr)
+        return 0 if matches else 2
+
     select = [s.strip() for s in args.select.split(",")] \
         if args.select else None
+    if args.changed and select is None:
+        changed = changed_since_stamp(args.paths)
+        if changed is not None:
+            if any("/vega_tpu/" in "/" + p.replace("\\", "/").lstrip("./")
+                   for p in changed):
+                pass  # graph inputs changed: keep the full sweep
+            else:
+                # Narrow run: per-file rules on just the changed files.
+                # A clean narrow run does NOT move the stamp (only a
+                # full sweep proves the tree clean).
+                args.paths = changed
+                select = [rid for rid, r in all_rules().items()
+                          if not r.project]
     try:
         result = run_lint(args.paths, select=select,
                           cache=not args.no_cache)

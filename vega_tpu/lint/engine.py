@@ -68,7 +68,11 @@ _JUSTIFY_STRIP = " \t—–:-"
 
 # Stable schema version of the JSON reporter output (finding dicts carry
 # rule / path / line / col / message / suppressed / justification).
-JSON_SCHEMA = 1
+# Schema 2 (vegalint v3): same finding shape as schema 1 — the bump marks
+# the addition of the `--explain-role` document ({schema, query, matches})
+# sharing the version number; consumers of the sweep document need no
+# changes beyond accepting schema == 2.
+JSON_SCHEMA = 2
 
 
 @dataclasses.dataclass
@@ -290,11 +294,13 @@ def _cache_path() -> Optional[str]:
 def _cache_fingerprint() -> str:
     """Any change to the engine or the rules invalidates every cached
     record — rule logic is part of the result."""
-    parts = ["schema=1", f"py={sys.version_info[:2]}"]
+    parts = ["schema=2", f"py={sys.version_info[:2]}"]
+    from vega_tpu.lint import callgraph as cg_mod
     from vega_tpu.lint import rules as rules_mod
 
     for mod_file in (os.path.abspath(__file__),
-                     os.path.abspath(rules_mod.__file__)):
+                     os.path.abspath(rules_mod.__file__),
+                     os.path.abspath(cg_mod.__file__)):
         try:
             st = os.stat(mod_file)
             parts.append(f"{mod_file}:{st.st_mtime_ns}:{st.st_size}")
@@ -327,6 +333,54 @@ def _save_cache(cache_file: str, fingerprint: str, records: Dict) -> None:
         os.replace(tmp, cache_file)
     except OSError:
         pass  # caching is best-effort; the sweep result is unaffected
+
+
+# ------------------------------------------------------------- clean stamp
+# `scripts/lint.sh --changed` lints only files modified since the last
+# CLEAN full sweep. The stamp rides next to the result cache (same
+# private-dir guarantees); no stamp (or cache disabled) means --changed
+# degrades to the full sweep, never to a vacuous pass.
+def clean_stamp_path() -> Optional[str]:
+    cp = _cache_path()
+    return cp + ".stamp" if cp else None
+
+
+def write_clean_stamp() -> None:
+    sp = clean_stamp_path()
+    if sp is None:
+        return
+    try:
+        with open(sp, "w") as f:
+            f.write("clean full sweep marker (mtime is the stamp)\n")
+    except OSError:
+        pass
+
+
+def read_clean_stamp() -> Optional[int]:
+    """mtime_ns of the last clean full sweep, or None."""
+    sp = clean_stamp_path()
+    if sp is None:
+        return None
+    try:
+        return os.stat(sp).st_mtime_ns
+    except OSError:
+        return None
+
+
+def changed_since_stamp(paths: Iterable[str]) -> Optional[List[str]]:
+    """Files under `paths` modified after the last clean full sweep, or
+    None when no stamp exists (caller must fall back to a full sweep)."""
+    stamp = read_clean_stamp()
+    if stamp is None:
+        return None
+    out: List[str] = []
+    for path in discover(paths):
+        try:
+            if os.stat(path).st_mtime_ns > stamp:
+                out.append(path)
+        except OSError:
+            out.append(path)  # vanished/ephemeral: let the sweep report it
+    return out
 
 
 @dataclasses.dataclass
@@ -372,6 +426,51 @@ def discover(paths: Iterable[str]) -> List[str]:
     return out
 
 
+def _collect_records(paths: List[str], build_rules: Dict[str, Rule],
+                     cache: bool, errors: List[str]
+                     ) -> Tuple[List[FileRecord], int]:
+    """The discovery + mtime-cache loop shared by run_lint and the
+    --explain-role record gatherer."""
+    cache_file = _cache_path() if cache else None
+    fingerprint = _cache_fingerprint() if cache_file else ""
+    store: Dict = _load_cache(cache_file, fingerprint) if cache_file else {}
+    dirty = False
+    cache_hits = 0
+    records: List[FileRecord] = []
+    for path in discover(paths):
+        display = os.path.normpath(path).replace(os.sep, "/")
+        try:
+            st = os.stat(path)
+        except OSError as exc:
+            errors.append(f"{display}: OSError: {exc}")
+            continue
+        stat = (st.st_mtime_ns, st.st_size)
+        key = (os.path.abspath(path), display)
+        rec = store.get(key)
+        if rec is not None and rec.stat == stat:
+            cache_hits += 1
+        else:
+            rec = _build_record(path, display, stat, build_rules)
+            store[key] = rec
+            dirty = True
+        records.append(rec)
+    if cache_file and dirty:
+        _save_cache(cache_file, fingerprint, store)
+    return records, cache_hits
+
+
+def gather_extracts(paths: Iterable[str], extract_key: str,
+                    cache: bool = True) -> List[Tuple[str, Any]]:
+    """The (display, data) pairs a project rule's global combine would
+    see — the record source for `--explain-role` (and tests that poke the
+    call graph directly)."""
+    errors: List[str] = []
+    records, _hits = _collect_records(list(paths), all_rules(), cache,
+                                      errors)
+    return [(rec.display, rec.extracts[extract_key]) for rec in records
+            if not rec.error and extract_key in rec.extracts]
+
+
 def run_lint(paths: Iterable[str],
              select: Optional[Iterable[str]] = None,
              cache: bool = True) -> LintResult:
@@ -394,39 +493,14 @@ def run_lint(paths: Iterable[str],
         elif not os.path.isdir(p) and not p.endswith(".py"):
             errors.append(f"{p}: not a directory or .py file")
 
-    cache_file = _cache_path() if cache else None
-    fingerprint = _cache_fingerprint() if cache_file else ""
-    store: Dict = _load_cache(cache_file, fingerprint) if cache_file else {}
-    dirty = False
-    cache_hits = 0
-
     active = rules if not select else \
         {rid: r for rid, r in rules.items() if rid in set(select)}
     # Records built for the cache run EVERY rule (one cache serves every
     # --select subset); with no cache to fill, building unselected rules'
     # results would be pure waste — narrow to the active set.
-    build_rules = rules if cache_file else active
-
-    records: List[FileRecord] = []
-    for path in discover(paths):
-        display = os.path.normpath(path).replace(os.sep, "/")
-        try:
-            st = os.stat(path)
-        except OSError as exc:
-            errors.append(f"{display}: OSError: {exc}")
-            continue
-        stat = (st.st_mtime_ns, st.st_size)
-        key = (os.path.abspath(path), display)
-        rec = store.get(key)
-        if rec is not None and rec.stat == stat:
-            cache_hits += 1
-        else:
-            rec = _build_record(path, display, stat, build_rules)
-            store[key] = rec
-            dirty = True
-        records.append(rec)
-    if cache_file and dirty:
-        _save_cache(cache_file, fingerprint, store)
+    build_rules = rules if cache else active
+    records, cache_hits = _collect_records(paths, build_rules, cache,
+                                           errors)
 
     raw: List[Finding] = []
     for rec in records:
@@ -489,9 +563,14 @@ def run_lint(paths: Iterable[str],
                     "not fire here) — delete it or re-anchor it; orphaned "
                     f"justification: {just!r}"))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return LintResult(findings, suppressed,
-                      len([r for r in records if not r.error]), errors,
-                      cache_hits=cache_hits)
+    result = LintResult(findings, suppressed,
+                        len([r for r in records if not r.error]), errors,
+                        cache_hits=cache_hits)
+    # A clean FULL sweep (every rule, cache on) arms `--changed`: only a
+    # run that proved the whole tree clean may move the stamp.
+    if select is None and cache and result.ok:
+        write_clean_stamp()
+    return result
 
 
 def _pragma_for(rec: FileRecord, f: Finding):

@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG015: the project invariants as AST checks.
+"""vegalint rules VG001–VG019: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -25,6 +25,17 @@ ssh workers and every VEGA_TPU_* literal resolves (VG010), every
 listener field read exists on the event schema and every emitted event
 is aggregated (VG011), and no cross-process socket op waits unbounded
 (VG012).
+
+VG016–VG019 (PR 18) are the thread-role dataflow rules: a per-file
+call-graph extraction (vega_tpu/lint/callgraph.py, cached under
+extract_key="callgraph" like the contract index) combines into a
+project-wide call graph with roles propagated from the declared role map
+— no blocking op reachable from a latency-critical role (VG016), no
+driver-only state captured into executor-shipped closures (VG017), no
+leaked socket/file handles on cross-process paths (VG018), and no
+driver-only function reachable from a confined worker/receiver role
+(VG019). Implementations live in callgraph.py; registration is here so
+one import populates the whole registry.
 """
 
 from __future__ import annotations
@@ -1511,3 +1522,62 @@ def vg015(ctx: FileCtx) -> Iterator[Finding]:
                             "— mutate state only via "
                             "StateStore.apply_batch (the exactly-once "
                             "commit API; docs/LINTING.md VG015)")
+
+
+# ---------------------------------------------------------------------------
+# VG016–VG019 — thread-role dataflow rules over the project call graph
+# ---------------------------------------------------------------------------
+# Implementations (extraction, graph build, role propagation, checks)
+# live in vega_tpu/lint/callgraph.py — this block only registers them so
+# importing `rules` populates the registry. VG016/VG019 are project
+# rules sharing one cached per-file extraction (extract_key="callgraph",
+# the VG009–VG012 contract-index shape); VG017/VG018 are self-contained
+# per-file checks (capture and ship site, or acquire and release, are
+# always in one function scope).
+
+from vega_tpu.lint import callgraph as _cg  # noqa: E402
+
+
+@rule("VG016", "blocking op reachable from a latency-critical role",
+      doc="Blocking operations (device_get/host_get round trips, "
+          "Future.result()/queue.get()/join()/subprocess waits without "
+          "timeout, settimeout(None)) reachable — through the project "
+          "call graph — from the latency-critical roles (dag-loop, "
+          "arbiter, elastic, reaper). A stall there parks scheduling or "
+          "liveness detection for every tenant. Spawning a thread ends "
+          "the role: offloading to Thread(target=...) is the sanctioned "
+          "escape hatch.",
+      project=True, extract=_cg.extract_callgraph, extract_key="callgraph")
+def vg016(records) -> Iterator[Finding]:
+    yield from _cg.check_vg016(records)
+
+
+@rule("VG017", "driver-only state captured into executor-shipped closure")
+def vg017(ctx: FileCtx) -> Iterator[Finding]:
+    """Closures passed to RDD ship methods (map/filter/reduce_by_key/...)
+    must not capture driver-resident control-plane state — Context/
+    scheduler/backend handles, Env, locks, sockets, jax device values.
+    Shipping one fails at pickle time at best and runs against a stale
+    stub at worst."""
+    yield from _cg.check_vg017(ctx)
+
+
+@rule("VG018", "socket/file acquired without release on every path")
+def vg018(ctx: FileCtx) -> Iterator[Finding]:
+    """In distributed//shuffle//streaming/, a socket or file bound to a
+    local name must be released on EVERY path: `with`, contextlib.closing,
+    or close in a finally. Returning/storing/passing the handle transfers
+    ownership and is fine."""
+    yield from _cg.check_vg018(ctx)
+
+
+@rule("VG019", "driver-only function reachable from a confined role",
+      doc="Functions in the driver-only seed set (Env mutation, context "
+          "teardown, fleet mutation) or annotated "
+          "`# vegalint: role[driver-only]` must not be reachable from "
+          "the confined roles (worker-task, stream-receiver) in the "
+          "project call graph — executor/ingest threads must never "
+          "mutate driver state.",
+      project=True, extract=_cg.extract_callgraph, extract_key="callgraph")
+def vg019(records) -> Iterator[Finding]:
+    yield from _cg.check_vg019(records)

@@ -22,9 +22,22 @@ overhead, zero behavior change. The wrapper intentionally does NOT support
 ``threading.Condition`` (Condition pokes lock internals); condition locks
 (map_output_tracker) stay plain.
 
+Role witnesses (vegalint v3) ride the same flag: the long-lived threads
+call :func:`note_thread_role` at their entry point, which cross-checks
+the OBSERVED thread identity against the declared role map
+(vega_tpu/lint/callgraph.ROLES — the same table the static VG016/VG019
+rules propagate from), and driver-only functions call
+:func:`assert_role` so a confined thread (worker task handler, streaming
+receiver) reaching one fails the run with the offending call path. Both
+record into the witness even when the raise is swallowed, so
+``check_clean()`` still fails the session. With the flag unset every
+role function is a no-op.
+
 This module must import nothing beyond the stdlib: core modules construct
 locks at import time, long before jax or the rest of vega_tpu is safe to
-touch.
+touch. (callgraph is imported lazily, only under the debug flag — it is
+stdlib-pure too, but keeping the import-time surface minimal is the
+contract.)
 """
 
 from __future__ import annotations
@@ -32,11 +45,17 @@ from __future__ import annotations
 import os
 import sys
 import threading
-from typing import Dict, List, Optional, Tuple
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
 
 
 class LockOrderError(AssertionError):
     """Two locks acquired in opposite orders (or a self-deadlock)."""
+
+
+class RoleError(AssertionError):
+    """Observed thread identity disagrees with the declared role map, or
+    a confined thread reached a driver-only function."""
 
 
 def enabled() -> bool:
@@ -54,6 +73,9 @@ class _Witness:
         self._edges: Dict[Tuple[str, str], str] = {}
         self._tls = threading.local()
         self.inversions: List[str] = []
+        # role -> thread names observed carrying it (role witnesses)
+        self.roles_observed: Dict[str, Set[str]] = {}
+        self.role_violations: List[str] = []
 
     # ------------------------------------------------------------ per thread
     def _held(self) -> List[str]:
@@ -140,6 +162,59 @@ class _Witness:
                 del held[i]
                 return
 
+    # --------------------------------------------------------------- roles
+    def _call_path(self, skip: int = 2) -> str:
+        """Compact caller chain for violation messages (innermost last)."""
+        frames = traceback.extract_stack()[:-skip]
+        tail = [f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+                for f in frames[-6:]]
+        return " -> ".join(tail)
+
+    def note_role(self, role: str) -> None:
+        from vega_tpu.lint import callgraph  # lazy: debug-flag-only path
+
+        spec = callgraph.ROLES.get(role)
+        tname = threading.current_thread().name
+        if spec is None:
+            msg = (f"role witness: '{role}' noted on thread '{tname}' is "
+                   f"not in the declared role map "
+                   f"(callgraph.ROLES) — add it there first; at "
+                   f"{self._call_path()}")
+            with self._mu:
+                self.role_violations.append(msg)
+            raise RoleError(msg)
+        prefixes = spec["thread_prefixes"]
+        if prefixes and not any(tname.startswith(p) for p in prefixes):
+            msg = (f"role witness: thread '{tname}' noted role '{role}' "
+                   f"but the declared map expects a name starting with "
+                   f"{prefixes} — the static role map and the runtime "
+                   f"disagree; fix whichever is wrong; at "
+                   f"{self._call_path()}")
+            with self._mu:
+                self.role_violations.append(msg)
+            raise RoleError(msg)
+        self._tls.role = role
+        with self._mu:
+            self.roles_observed.setdefault(role, set()).add(tname)
+
+    def current_role(self) -> Optional[str]:
+        return getattr(self._tls, "role", None)
+
+    def check_role(self, allowed: Tuple[str, ...]) -> None:
+        from vega_tpu.lint import callgraph  # lazy: debug-flag-only path
+
+        role = self.current_role()
+        if role is None or role not in callgraph.CONFINED_ROLES \
+                or role in allowed:
+            return  # un-noted threads and unconfined roles always pass
+        msg = (f"role confinement violated: driver-only function reached "
+               f"from confined role '{role}' on thread "
+               f"'{threading.current_thread().name}' via "
+               f"{self._call_path(skip=3)}")
+        with self._mu:
+            self.role_violations.append(msg)
+        raise RoleError(msg)
+
     # ------------------------------------------------------------ reporting
     def stats(self) -> dict:
         with self._mu:
@@ -147,6 +222,9 @@ class _Witness:
                 "locks": len({n for e in self._edges for n in e}),
                 "edges": len(self._edges),
                 "inversions": list(self.inversions),
+                "roles": {r: sorted(t)
+                          for r, t in self.roles_observed.items()},
+                "role_violations": list(self.role_violations),
             }
 
 
@@ -158,14 +236,44 @@ def witness() -> _Witness:
 
 
 def check_clean() -> None:
-    """Raise if any inversion was recorded this process — even one whose
-    in-place LockOrderError was swallowed by a broad handler (exactly the
-    blindness VG005 exists for). Wired into conftest at session finish."""
-    inv = witness().stats()["inversions"]
+    """Raise if any inversion OR role violation was recorded this process
+    — even one whose in-place error was swallowed by a broad handler
+    (exactly the blindness VG005 exists for). Wired into conftest at
+    session finish."""
+    st = witness().stats()
+    inv = st["inversions"]
     if inv:
         raise LockOrderError(
             f"{len(inv)} lock-order inversion(s) recorded:\n"
             + "\n".join(inv))
+    rv = st["role_violations"]
+    if rv:
+        raise RoleError(
+            f"{len(rv)} role violation(s) recorded:\n" + "\n".join(rv))
+
+
+def note_thread_role(role: str) -> None:
+    """Record the calling thread's declared role (no-op unless
+    VEGA_TPU_DEBUG_SYNC=1). Placed at the entry point of each long-lived
+    role thread; cross-checks the observed thread name against
+    callgraph.ROLES and fails the run on disagreement."""
+    if enabled():
+        _WITNESS.note_role(role)
+
+
+def current_role() -> Optional[str]:
+    return _WITNESS.current_role() if enabled() else None
+
+
+def assert_role(*allowed: str) -> None:
+    """Guard for driver-only functions (no-op unless
+    VEGA_TPU_DEBUG_SYNC=1): raises RoleError when called from a thread
+    noted with a CONFINED role (worker task handler, streaming receiver)
+    not in `allowed`. Un-noted threads — the driver main thread, test
+    threads — always pass; this is the runtime mirror of VG019, not a
+    general ACL."""
+    if enabled():
+        _WITNESS.check_role(allowed)
 
 
 class WitnessLock:

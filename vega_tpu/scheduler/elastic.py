@@ -66,7 +66,11 @@ from typing import Dict, List, Optional, Set, Tuple
 from vega_tpu import faults
 from vega_tpu.env import Env
 from vega_tpu.errors import FetchFailedError, NetworkError, VegaError
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import (
+    assert_role,
+    named_lock,
+    note_thread_role,
+)
 from vega_tpu.scheduler import events as ev
 
 log = logging.getLogger("vega_tpu")
@@ -185,6 +189,7 @@ class ElasticController:
 
     # ---------------------------------------------------------- decisions
     def _loop(self) -> None:
+        note_thread_role("elastic")
         interval = max(0.05,
                        float(self.conf.elastic_decision_interval_s))
         while not self._stop_event.wait(max(0.05, interval / 4.0)):
@@ -329,6 +334,7 @@ class ElasticController:
         would ever add capacity back (lower the bound first to retire the
         last executors on purpose). An unexpected error mid-ladder
         releases the drain claim so the slot is not stranded draining."""
+        assert_role("elastic")  # fleet mutation: driver-side control only
         backend = self.backend
         conf = self.conf
         t0 = time.time()

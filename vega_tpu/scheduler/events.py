@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import named_lock, note_thread_role
 
 log = logging.getLogger("vega_tpu")
 
@@ -462,6 +462,7 @@ class LiveListenerBus:
             self._thread.join(timeout=5)
 
     def _dispatch_loop(self) -> None:
+        note_thread_role("listener-bus")
         while True:
             event = self._queue.get()
             try:

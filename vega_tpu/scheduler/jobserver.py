@@ -44,7 +44,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from vega_tpu.errors import CancelledError, JobRejectedError, VegaError
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import named_lock, note_thread_role
 from vega_tpu.scheduler import events as ev
 from vega_tpu.scheduler.dag import _WAKE, DAGScheduler, _Job
 from vega_tpu.scheduler.task import Task, TaskEndEvent
@@ -560,6 +560,7 @@ class JobServer:
         return future
 
     def _drive(self, job: _Job, future: JobFuture) -> None:
+        note_thread_role("dag-loop")
         try:
             results = self.scheduler._run_job_inner(
                 job.final_rdd, job.func, job.partitions,

@@ -39,7 +39,7 @@ from typing import Callable, Iterator, List, Tuple
 from vega_tpu import serialization
 from vega_tpu.env import Env
 from vega_tpu.errors import FetchFailedError, ShuffleError, VegaError
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import named_lock, note_thread_role
 
 log = logging.getLogger("vega_tpu")
 
@@ -323,6 +323,7 @@ class ShuffleFetcher:
                 def produce(assignments, failures=failures):
                     # One worker thread serving one or more servers
                     # sequentially (fan-out is capped; see below).
+                    note_thread_role("fetch-producer")
                     from vega_tpu.distributed.shuffle_server import (
                         fetch_many_remote, fetch_remote)
 

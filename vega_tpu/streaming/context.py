@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from vega_tpu.cache import KeySpace
 from vega_tpu.env import Env
+from vega_tpu.lint.sync_witness import note_thread_role
 from vega_tpu.scheduler import events
 from vega_tpu.streaming.controller import RateController
 from vega_tpu.streaming.dstream import DStream, StreamBlockRDD
@@ -298,6 +299,7 @@ class StreamingContext:
 
     # --------------------------------------------------------------- internals
     def _loop(self) -> None:
+        note_thread_role("batch-driver")
         while not self._stop_evt.wait(self.interval_s):
             try:
                 self._tick()

@@ -36,7 +36,7 @@ from typing import Any, Callable, List, Optional
 from vega_tpu import faults
 from vega_tpu.cache import KeySpace
 from vega_tpu.env import Env
-from vega_tpu.lint.sync_witness import named_lock
+from vega_tpu.lint.sync_witness import named_lock, note_thread_role
 
 log = logging.getLogger("vega_tpu")
 
@@ -169,6 +169,7 @@ class Receiver:
             self._thread.join(timeout=5.0)
 
     def _run(self) -> None:
+        note_thread_role("stream-receiver")
         try:
             self._open()
             while not self._stop.is_set():
