@@ -25,9 +25,21 @@ leak across legs. Legs are interleaved per repetition so host-level drift
 on this shared 1-core sandbox hits all three equally. Prints ONE JSON
 line (medians of 3).
 
+`--coded` runs the PR 19 equal-redundancy A/B instead: the SAME job on
+the SAME 5-worker fleet with one server SIGKILLed mid-reduce, once under
+`shuffle_replication=2` (k full copies) and once under
+`shuffle_coding=xor` (one compressed parity push per map into an
+origin-exclusive group on a peer). Both legs must survive the kill with
+bit-identical results and ZERO map recompute; the coded leg's acceptance
+is wall <= 1.25x the replica leg while spending <= 0.6x its
+(storage + push) bytes — per-leg `storage_bytes` (server mem+disk tiers,
+parity included) and `push_bytes` (the workers' redundancy-plane
+counters) land in the one JSON line.
+
 Usage:
 
   python benchmarks/straggler_ab.py [n_map_tasks] [task_work_s]
+  python benchmarks/straggler_ab.py --coded [n_map_tasks] [rows_per_map]
 """
 
 import json
@@ -64,7 +76,132 @@ def _clear_fault_env():
         os.environ.pop(name, None)
 
 
+def _coded_main(argv):
+    """Equal-redundancy A/B (PR 19): replication=2 vs xor parity under a
+    real mid-reduce SIGKILL of one server, on a 5-worker fleet."""
+    n_tasks = int(argv[0]) if argv else 16
+    rows_per_map = int(argv[1]) if len(argv) > 1 else 2000
+    n_red = 4
+    n_workers = 5
+    victim = "exec-0"
+
+    import vega_tpu as v
+    from vega_tpu import faults
+    from vega_tpu.distributed.shuffle_server import check_status
+    from vega_tpu.env import Env
+
+    expected = None
+
+    def one_rep(leg: str):
+        nonlocal expected
+        _clear_fault_env()
+        # The victim serves every bucket slowly so the kill reliably
+        # lands while reducers are mid-stream against it.
+        os.environ["VEGA_TPU_FAULT_FETCH_DELAY_S"] = str(FETCH_DELAY_S)
+        os.environ["VEGA_TPU_FAULT_EXECUTOR"] = victim
+        faults.reset()
+        kw = dict(shuffle_replication=2) if leg == "replica2" \
+            else dict(shuffle_coding="xor", coding_group_k=4)
+        ctx = v.Context("distributed", num_executors=n_workers,
+                        heartbeat_interval_s=0.2,
+                        executor_liveness_timeout_s=1.5,
+                        executor_reap_interval_s=0.3,
+                        executor_restart_backoff_s=0.1,
+                        fetch_retries=4, fetch_retry_interval_s=0.05, **kw)
+        try:
+            n = n_tasks * rows_per_map
+            pairs = ctx.parallelize(
+                [(i, i * 3) for i in range(n)], n_tasks)
+            t0 = time.time()
+            future = pairs.reduce_by_key(lambda a, b: a + b, n_red) \
+                .collect_async()
+            # Redundancy is published with the map outputs: wait until
+            # every map registered, then snapshot bytes BEFORE the kill
+            # (the victim's counters die with it).
+            tracker = Env.get().map_output_tracker
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                sids = list(getattr(tracker, "_outputs", {}))
+                if sids and any(tracker.has_outputs(s) for s in sids):
+                    break
+                time.sleep(0.05)
+            else:
+                raise RuntimeError("map outputs never registered")
+            storage = 0
+            for uri in set(ctx._backend.shuffle_peer_uris()):
+                st = check_status(uri) or {}
+                storage += st.get("mem_bytes", 0) + st.get("disk_bytes", 0)
+            red = [s.get("redundancy", {})
+                   for s in ctx._backend.worker_stats().values()]
+            push = sum(r.get("replica_push_bytes", 0)
+                       + r.get("parity_push_bytes", 0) for r in red)
+            time.sleep(0.3)  # reducers are parked on the victim's serves
+            ctx._backend._executors[victim].process.kill()
+            got = dict(future.result(120.0))
+            wall = time.time() - t0
+            if expected is None:
+                expected = got
+            assert got == expected, "legs disagree on results"
+            summary = ctx.metrics_summary()
+            assert summary["stages_resubmitted"] == 0, \
+                f"{leg}: the kill escalated to a map recompute"
+            fetch = summary["fetch"]
+            workers = ctx._backend.worker_stats().values()
+            coded = fetch.get("coded_failovers", 0) + sum(
+                s["fetch"].get("coded_failovers", 0) for s in workers)
+            replica = fetch.get("failovers", 0) + sum(
+                s["fetch"].get("failovers", 0) for s in workers)
+            return wall, storage, push, coded, replica
+        finally:
+            ctx.stop()
+            _clear_fault_env()
+            faults.reset()
+
+    one_rep("replica2")  # warm the worker-spawn/import path once
+    legs = {"replica2": [], "coded": []}
+    failovers = {"coded_failovers": 0, "replica_failovers": 0}
+    for _ in range(REPS):
+        for leg in legs:  # interleaved per rep (sandbox drift)
+            legs[leg].append(one_rep(leg))
+        failovers["replica_failovers"] += legs["replica2"][-1][4]
+        failovers["coded_failovers"] += legs["coded"][-1][3]
+
+    def med(leg, i):
+        return median([r[i] for r in legs[leg]])
+
+    rep_wall, rep_bytes = med("replica2", 0), \
+        med("replica2", 1) + med("replica2", 2)
+    cod_wall, cod_bytes = med("coded", 0), med("coded", 1) + med("coded", 2)
+    print(json.dumps({
+        "metric": "shuffle-job wall + redundancy bytes with one server "
+                  "SIGKILLed mid-reduce: shuffle_replication=2 vs "
+                  "shuffle_coding=xor(k=4) on a real 5-worker fleet "
+                  "(medians of 3, legs interleaved per rep)",
+        "map_tasks": n_tasks,
+        "rows_per_map": rows_per_map,
+        "replica2_wall_s": round(rep_wall, 3),
+        "coded_wall_s": round(cod_wall, 3),
+        "replica2_storage_bytes": int(med("replica2", 1)),
+        "coded_storage_bytes": int(med("coded", 1)),
+        "replica2_push_bytes": int(med("replica2", 2)),
+        "coded_push_bytes": int(med("coded", 2)),
+        "wall_ratio": round(cod_wall / rep_wall, 2) if rep_wall else None,
+        "bytes_ratio": round(cod_bytes / rep_bytes, 3) if rep_bytes
+        else None,
+        "bounded_wall_1_25x": bool(rep_wall
+                                   and cod_wall <= 1.25 * rep_wall),
+        "bounded_bytes_0_6x": bool(rep_bytes
+                                   and cod_bytes <= 0.6 * rep_bytes),
+        **failovers,
+        "map_recomputes": 0,  # stages_resubmitted==0 asserted every rep
+        "results_identical": True,  # asserted every rep
+    }))
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--coded":
+        _coded_main(sys.argv[2:])
+        return
     n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     work_s = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
 

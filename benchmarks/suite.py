@@ -479,6 +479,49 @@ def config13_streaming(ctx, scale=1.0, bank=None):
     return (batches, out["batch_p50_s"]["solo"], out["batch_p50_s"]["fair"])
 
 
+def config14_coded(ctx, scale=1.0, bank=None):
+    """PR 19 coded shuffle: equal-redundancy A/B — shuffle_replication=2
+    (k full copies) vs shuffle_coding=xor k=4 (one compressed parity push
+    into an origin-exclusive peer group) with one server SIGKILLed
+    mid-reduce on a real 5-worker fleet (benchmarks/straggler_ab.py
+    --coded: interleaved legs, medians of 3, bit-identical + zero map
+    recompute asserted by the A/B itself). Runs in a SUBPROCESS — each
+    (leg, rep) builds a fresh distributed Context and the Env is a
+    process singleton. Reported through the standard columns: host_s =
+    replica2 wall, device_s = coded wall, so device_vs_host reads as the
+    wall COST of parity decode at failure time (accept: coded <= 1.25x
+    replica AND <= 0.6x its storage+push bytes — both gates land in the
+    emitted A/B line). Host-plane redundancy work — no device leg,
+    excluded from the TPU-window default config set (tpu_jobs/14 runs
+    the standalone A/B instead)."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    n_tasks = max(8, int(16 * scale))
+    rows = max(500, int(2000 * scale))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "benchmarks", "straggler_ab.py"), "--coded",
+         str(n_tasks), str(rows)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"coded A/B failed: {proc.stderr[-2000:]}"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["results_identical"], "coded legs diverged"
+    assert out["map_recomputes"] == 0, \
+        "a mid-reduce kill escalated to map recompute"
+    assert out["bounded_wall_1_25x"], (
+        f"coded wall {out['coded_wall_s']} > 1.25x replica "
+        f"{out['replica2_wall_s']}")
+    assert out["bounded_bytes_0_6x"], (
+        f"coded bytes ratio {out['bytes_ratio']} > 0.6x replication=2")
+    n = out["map_tasks"] * out["rows_per_map"]
+    if bank:
+        bank(n, out["coded_wall_s"])
+    return (n, out["replica2_wall_s"], out["coded_wall_s"])
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -500,6 +543,8 @@ CONFIGS = {
          "budget", config12_exchange_planner),
     13: ("micro-batch streaming solo vs fair-pool under batch tenant "
          "(batch p50 + exactly-once + bounded queue)", config13_streaming),
+    14: ("coded shuffle equal-redundancy A/B, replication=2 vs xor "
+         "parity under mid-reduce server kill", config14_coded),
 }
 
 

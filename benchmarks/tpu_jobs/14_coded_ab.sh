@@ -1,0 +1,11 @@
+#!/bin/bash
+# Coded-shuffle A/B (PR 19) on the real chip: the CPU proxy proves the
+# parity rung beats replication=2 on bytes (~0.54x storage+push) at ~1.0x
+# wall under a mid-reduce server SIGKILL, but the GF(256)/XOR fold and
+# decode run on the numpy twin there. On the chip kernels.gf256_accumulate
+# is a real device program, so the question is whether decode-at-failure
+# stays inside the 1.25x wall bound when the fold is TPU-resident (the
+# bytes gate is placement math and should not move). Bit-identical + zero
+# map recompute asserted by the A/B itself. One JSON line.
+cd /root/repo
+exec python benchmarks/straggler_ab.py --coded 16 2000

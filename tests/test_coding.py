@@ -1,0 +1,429 @@
+"""Coded shuffle (parity buckets, arXiv:1802.03049): unit/integration
+layer under the chaos suite.
+
+Covers the pure GF(256)/frame algebra (shuffle/coding.py), the store's
+locked parity fold, the tracker's parity registry + pseudo-location
+sweep, the server's origin-exclusive group assignment, the put_parity/
+get_parity socket round trip (real ShuffleServer, no worker processes),
+and the fetcher's `_reconstruct` rung end-to-end — deterministically on
+the 1-core sandbox. Process-level loss (SIGKILL a parity-group server
+mid-stream) lives in test_chaos.py.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from vega_tpu import faults
+from vega_tpu.distributed.shuffle_server import (
+    ShuffleServer, fetch_parity_remote, put_parity_remote)
+from vega_tpu.env import Env
+from vega_tpu.map_output_tracker import MapOutputTracker
+from vega_tpu.shuffle import coding
+from vega_tpu.shuffle import fetcher as fetcher_mod
+from vega_tpu.shuffle.store import ShuffleStore
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------------ pure
+# algebra: GF(256) tables, frames, fold/decode round trips.
+
+
+def test_gf256_algebra_sanity():
+    # Multiplicative group: a * inv(a) == 1 for every nonzero byte.
+    for a in (1, 2, 3, 7, 91, 128, 200, 255):
+        assert coding.gf_mul(a, coding.gf_inv(a)) == 1
+    assert coding.gf_mul(0, 77) == 0
+    with pytest.raises(ZeroDivisionError):
+        coding.gf_inv(0)
+    # Vectorized accumulate matches the scalar definition.
+    rng = np.random.RandomState(7)
+    blocks = rng.randint(0, 256, size=(3, 64)).astype(np.uint8)
+    coeffs = np.array([5, 1, 250], dtype=np.uint8)
+    got = coding._accumulate_np(blocks, coeffs)
+    want = np.zeros(64, dtype=np.uint8)
+    for i in range(3):
+        for j in range(64):
+            want[j] ^= coding.gf_mul(int(coeffs[i]), int(blocks[i, j]))
+    assert np.array_equal(got, want)
+
+
+def test_parity_map_id_reserved_and_collision_free():
+    """The negative namespace never collides with real map ids and is
+    injective over (group, unit) at the FIXED stride."""
+    seen = set()
+    for gid in range(64):
+        for unit in range(coding.MAX_PARITY_UNITS):
+            key = coding.parity_map_id(gid, unit)
+            assert key < 0
+            seen.add(key)
+    assert len(seen) == 64 * coding.MAX_PARITY_UNITS
+
+
+def test_xor_fold_decode_round_trip():
+    members = {7: b"alpha-bucket", 9: b"bz", 12: b"gamma!"}
+    frame = None
+    meta = {}
+    for idx, (mid, raw) in enumerate(sorted(members.items())):
+        frame = coding.fold_frame(frame, "xor", 4, 0, mid, idx, raw)
+        meta[mid] = idx
+    header, payload = coding.parse_frame(frame)
+    assert header["scheme"] == "xor" and header["k"] == 4
+    assert set(header["members"]) == set(members)
+    # Any single loss decodes from the other two + parity.
+    for lost in members:
+        survivors = {m: d for m, d in members.items() if m != lost}
+        out = coding.decode_group("xor", 4, [(0, header, payload)],
+                                  header["members"], survivors, [lost])
+        assert out == {lost: members[lost]}
+
+
+def test_rs_two_losses_decode_with_two_units():
+    members = {1: b"x" * 40, 3: b"yyyy", 5: b"zzzzzzzz" * 3, 8: b"w" * 17}
+    frames = []
+    for unit in range(2):
+        fr = None
+        for idx, (mid, raw) in enumerate(sorted(members.items())):
+            fr = coding.fold_frame(fr, "rs", 4, unit, mid, idx, raw)
+        frames.append((unit,) + coding.parse_frame(fr))
+    hdr = frames[0][1]
+    for lost in ((1, 5), (3, 8), (1, 8)):
+        survivors = {m: d for m, d in members.items() if m not in lost}
+        out = coding.decode_group("rs", 4, frames, hdr["members"],
+                                  survivors, sorted(lost))
+        assert out == {m: members[m] for m in lost}
+    # Three losses exceed the two-unit budget: unsolvable, not wrong.
+    with pytest.raises(ValueError):
+        coding.decode_group("rs", 4, frames, hdr["members"],
+                            {8: members[8]}, [1, 3, 5])
+
+
+def test_corrupt_frame_reads_as_missing_and_fold_rejects():
+    frame = coding.fold_frame(None, "xor", 4, 0, 2, 0, b"payload-bytes")
+    assert coding.parse_frame(frame) is not None
+    flipped = bytearray(frame)
+    flipped[len(flipped) // 2] ^= 0xFF
+    assert coding.parse_frame(bytes(flipped)) is None  # CRC catches it
+    assert coding.parse_frame(b"") is None
+    assert coding.parse_frame(b"NOPE" + frame[4:]) is None  # magic
+    # Folding onto a corrupt frame must refuse, not silently re-CRC it.
+    with pytest.raises(ValueError):
+        coding.fold_frame(bytes(flipped), "xor", 4, 0, 3, 1, b"more")
+    # Duplicate member (task retry reaching the same frame twice) refuses:
+    # a double XOR fold would silently cancel the contribution.
+    with pytest.raises(ValueError):
+        coding.fold_frame(frame, "xor", 4, 0, 2, 0, b"payload-bytes")
+    # Scheme/shape mismatch refuses.
+    with pytest.raises(ValueError):
+        coding.fold_frame(frame, "rs", 4, 0, 3, 1, b"more")
+
+
+def test_spec_from_conf_parsing():
+    class C:
+        def __init__(self, coding_s, k=4, m=1):
+            self.shuffle_coding = coding_s
+            self.coding_group_k = k
+            self.coding_parity_m = m
+
+    assert coding.spec_from_conf(C("none")) is None
+    assert coding.spec_from_conf(C("")) is None
+    assert coding.spec_from_conf(C("off")) is None
+    assert coding.spec_from_conf(C("xor")) == ("xor", 4, 1)
+    assert coding.spec_from_conf(C("xor", k=6, m=3)) == ("xor", 6, 1)
+    assert coding.spec_from_conf(C("rs", k=5, m=2)) == ("rs", 5, 2)
+    assert coding.spec_from_conf(C("rs(6,2)")) == ("rs", 6, 2)
+    assert coding.spec_from_conf(C("RS(6, 2)")) == ("rs", 6, 2)
+    # Malformed specs degrade to OFF — never fail map tasks.
+    assert coding.spec_from_conf(C("rsx")) is None
+    assert coding.spec_from_conf(C("rs(a,b)")) is None
+    assert coding.spec_from_conf(C("lrc")) is None
+    # Clamps: k in [2,128], m in [1, MAX_PARITY_UNITS].
+    assert coding.spec_from_conf(C("rs(1,99)")) == ("rs", 2, 8)
+    assert coding.spec_from_conf(C("rs(999,0)")) == ("rs", 128, 1)
+
+
+def test_wire_pack_round_trip_and_compression():
+    rows = pickle.dumps([(i % 10, i) for i in range(500)])
+    packed = coding.wire_pack(rows)
+    assert coding.wire_unpack(packed) == rows
+    assert len(packed) < len(rows)  # the sub-k× push-bytes lever
+
+
+def test_accumulate_numpy_fallback_matches_device_path():
+    """prefer_device=False forces the numpy twin; with jax imported (the
+    test process has it via conftest) the device kernel must agree
+    byte-for-byte — host-vs-device parity for the decode hot loop."""
+    rng = np.random.RandomState(3)
+    blocks = rng.randint(0, 256, size=(4, 257)).astype(np.uint8)
+    coeffs = np.array([1, 9, 0, 143], dtype=np.uint8)
+    host = coding.accumulate(blocks, coeffs, prefer_device=False)
+    dev = coding.accumulate(blocks, coeffs, prefer_device=True)
+    assert np.array_equal(host, dev)
+    assert np.array_equal(host, coding._accumulate_np(blocks, coeffs))
+
+
+# ------------------------------------------------------------------ store
+# fold: locked read-modify-write under the reserved negative map_id.
+
+
+def test_store_fold_parity_accumulates_under_reserved_key(tmp_path):
+    store = ShuffleStore(spill_dir=str(tmp_path / "spill"))
+    try:
+        store.fold_parity(0, group_id=2, unit=0, reduce_id=1, map_id=4,
+                          idx=0, scheme="xor", k=4, raw=b"aaaa")
+        store.fold_parity(0, group_id=2, unit=0, reduce_id=1, map_id=6,
+                          idx=1, scheme="xor", k=4, raw=b"bbbbbb")
+        blob = store.get(0, coding.parity_map_id(2, 0), 1)
+        header, payload = coding.parse_frame(blob)
+        assert header["members"] == {4: (0, 4), 6: (1, 6)}
+        out = coding.decode_group("xor", 4, [(0, header, payload)],
+                                  header["members"], {4: b"aaaa"}, [6])
+        assert out == {6: b"bbbbbb"}
+        status = store.status()
+        assert status["parity_folds"] == 2
+        assert status["parity_bytes"] > 0
+        # Parity rides the ordinary keying: remove_shuffle covers it.
+        store.remove_shuffle(0)
+        assert store.get(0, coding.parity_map_id(2, 0), 1) is None
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------- tracker
+# parity registry, pseudo-location sweep, decommission planning views.
+
+
+def _tracked(n_buckets=3, uris=("a:1", "b:1", "a:1")):
+    t = MapOutputTracker()
+    t.register_shuffle(0, len(uris))
+    t.register_map_outputs(0, list(uris))
+    return t
+
+
+def test_tracker_parity_registry_round_trip():
+    t = _tracked()
+    t.register_parity(0, "b:1", 0, map_id=0, idx=0, scheme="xor", k=4, m=1)
+    t.register_parity(0, "b:1", 0, map_id=2, idx=1, scheme="xor", k=4, m=1)
+    t.register_parity(0, "b:1", 0, map_id=2, idx=1, scheme="xor", k=4, m=1)
+    pmap = t.get_parity_map(0)
+    assert pmap == {("b:1", 0): {"scheme": "xor", "k": 4, "m": 1,
+                                 "members": {0: 0, 2: 1}}}
+    t.unregister_shuffle(0)
+    assert t.get_parity_map(0) == {}
+
+
+def test_tracker_decodable_without_and_pseudo_install():
+    """Losing a:1 (sole copy of maps 0 and 2, both folded into b:1's
+    group 0) is COVERED: decodable_without plans it, and the sweep
+    installs the coded: pseudo-location instead of emptying the lists."""
+    t = _tracked()
+    t.register_parity(0, "b:1", 0, map_id=0, idx=0, scheme="xor", k=4, m=1)
+    t.register_parity(0, "b:1", 0, map_id=2, idx=1, scheme="xor", k=4, m=1)
+    # m=1 covers a single missing member per group — but BOTH of a:1's
+    # maps are in one group, so losing a:1 leaves 2 missing > m=1 ...
+    assert t.decodable_without("a:1") == {}
+    # ... whereas with each map in its OWN group the loss is decodable.
+    t2 = _tracked()
+    t2.register_parity(0, "b:1", 0, map_id=0, idx=0, scheme="xor", k=4, m=1)
+    t2.register_parity(0, "b:1", 1, map_id=2, idx=0, scheme="xor", k=4, m=1)
+    covered = t2.decodable_without("a:1")
+    assert covered == {(0, 0): "coded:b:1/0", (0, 2): "coded:b:1/1"}
+    # Parity hosted ON the dying server never counts.
+    assert t2.decodable_without("b:1") == {}
+
+    gen = t2.generation
+    removed = t2.unregister_server_outputs("a:1")
+    assert removed == 2
+    assert t2.generation == gen + 1  # one bump for the whole sweep
+    assert t2._outputs[0][0] == ["coded:b:1/0"]
+    assert t2._outputs[0][1] == ["b:1"]  # survivor untouched
+    assert t2._outputs[0][2] == ["coded:b:1/1"]
+    assert t2.has_outputs(0)  # coverage keeps the shuffle whole
+    assert t2.coded_locations(0) == {0: "coded:b:1/0", 2: "coded:b:1/1"}
+
+
+def test_tracker_losing_parity_server_strips_pseudo_locations():
+    """When the PARITY server dies, its coded: claims die with it — the
+    sweep drops pseudo-locations prefixed by the dead uri and the groups
+    it hosted, so nothing routes reconstruction at a corpse."""
+    t = _tracked()
+    t.register_parity(0, "b:1", 0, map_id=0, idx=0, scheme="xor", k=4, m=1)
+    t.unregister_server_outputs("a:1")
+    assert t._outputs[0][0] == ["coded:b:1/0"]
+    t.unregister_server_outputs("b:1")
+    assert t._outputs[0][0] == []  # claim died with its server
+    assert t.get_parity_map(0) == {}
+    assert not t.has_outputs(0)
+
+
+# ----------------------------------------------------------------- server
+# group assignment + the put_parity / get_parity socket round trip.
+
+
+def test_assign_parity_member_origin_exclusive_and_memoized(tmp_path):
+    store = ShuffleStore(spill_dir=str(tmp_path / "s"))
+    server = ShuffleServer(store)
+    try:
+        a = server.assign_parity_member(0, 1, "w1:1", "xor", 4, 1)
+        b = server.assign_parity_member(0, 2, "w2:1", "xor", 4, 1)
+        assert a == (0, 0, True)
+        assert b == (0, 1, True)  # different origin joins the open group
+        # Same origin must NOT share a group: one server loss would take
+        # two members and exceed the parity budget.
+        c = server.assign_parity_member(0, 3, "w1:1", "xor", 4, 1)
+        assert c[0] != a[0] and c[2]
+        # Task retry gets its memoized slot back, first_time=False — the
+        # caller must never double-fold.
+        again = server.assign_parity_member(0, 1, "w1:1", "xor", 4, 1)
+        assert again == (a[0], a[1], False)
+        # Rollback burns the slot but frees the mapper to land again.
+        server.drop_parity_member(0, 3)
+        d = server.assign_parity_member(0, 3, "w1:1", "xor", 4, 1)
+        assert d[2] and d[:2] != c[:2]
+        # A different scheme/shape opens its own group.
+        e = server.assign_parity_member(0, 9, "w3:1", "rs", 4, 2)
+        assert e[0] not in (a[0], c[0], d[0])
+    finally:
+        server.stop()
+        store.close()
+
+
+def test_put_get_parity_socket_round_trip(tmp_path):
+    """Real sockets: two mappers from different origins push their bucket
+    rows once (compressed), the server folds them into one group, and
+    the parity frames fetched back decode either member."""
+    store = ShuffleStore(spill_dir=str(tmp_path / "s"))
+    server = ShuffleServer(store)
+    try:
+        rows = {
+            3: [b"m3-r0" * 10, b"m3-r1"],
+            5: [b"m5-r0", b"m5-r1" * 7],
+        }
+        assigned = {}
+        for mid, origin in ((3, "w1:1"), (5, "w2:1")):
+            payloads = [coding.wire_pack(b) for b in rows[mid]]
+            assigned[mid] = put_parity_remote(
+                server.uri, 0, mid, origin, "xor", 4, 1, payloads)
+        (g3, i3), (g5, i5) = assigned[3], assigned[5]
+        assert g3 == g5 and {i3, i5} == {0, 1}
+        assert store.parity_folds == 4  # 2 members x 2 reduce buckets
+        for rid in range(2):
+            fr = fetch_parity_remote(server.uri, 0, g3, 0, rid)
+            assert fr is not None
+            unit, header, payload = fr
+            assert unit == 0
+            assert header["members"] == {3: (i3, len(rows[3][rid])),
+                                         5: (i5, len(rows[5][rid]))}
+            out = coding.decode_group("xor", 4, [fr], header["members"],
+                                      {3: rows[3][rid]}, [5])
+            assert out == {5: rows[5][rid]}
+        # Unfolded (group, unit, reduce) coordinates answer missing.
+        assert fetch_parity_remote(server.uri, 0, g3, 1, 0) is None
+        assert fetch_parity_remote(server.uri, 0, 99, 0, 0) is None
+    finally:
+        server.stop()
+        store.close()
+
+
+def test_parity_corrupt_fault_reads_as_missing(tmp_path):
+    """VEGA_TPU_FAULT_PARITY_CORRUPT_N: the served frame's CRC fails
+    CLIENT-side and the fetch answers None (missing) — the deterministic
+    trigger for the degradation-ladder regression in test_chaos.py."""
+    store = ShuffleStore(spill_dir=str(tmp_path / "s"))
+    server = ShuffleServer(store)
+    try:
+        put_parity_remote(server.uri, 0, 1, "w1:1", "xor", 4, 1,
+                          [coding.wire_pack(b"bucket-bytes")])
+        stats_dir = str(tmp_path / "stats")
+        faults.configure(parity_corrupt_n=1, stats_dir=stats_dir)
+        assert fetch_parity_remote(server.uri, 0, 0, 0, 0) is None
+        stats = [s for s in faults.read_stats(stats_dir)
+                 if s["fault"] == "parity_corrupt"]
+        assert stats, "the corruption hook never fired"
+        # Budget spent: the next read serves the intact frame.
+        fr = fetch_parity_remote(server.uri, 0, 0, 0, 0)
+        assert fr is not None
+        out = coding.decode_group("xor", 4, [fr], fr[1]["members"], {}, [1])
+        assert out == {1: b"bucket-bytes"}
+    finally:
+        server.stop()
+        store.close()
+
+
+# ---------------------------------------------------------------- fetcher
+# reconstruction rung end-to-end: dead data server, live parity server.
+
+
+def test_reconstruct_recovers_lost_server_buckets(ctx, tmp_path):
+    """Two servers, maps 0/2 on A (from origin A) and map 1 on B; A's
+    rows parity-folded on B in per-map groups. With A in failed_uris,
+    `_reconstruct` must recover A's buckets bit-identically from B's
+    parity + B's surviving member — zero map recompute."""
+    env = Env.get()
+    store_a = ShuffleStore(spill_dir=str(tmp_path / "a"))
+    store_b = ShuffleStore(spill_dir=str(tmp_path / "b"))
+    server_a = ShuffleServer(store_a)
+    server_b = ShuffleServer(store_b)
+    old = env.map_output_tracker, env.shuffle_server
+    try:
+        n_red = 2
+        buckets = {m: [f"m{m}-r{r}".encode() * (m + 1) for r in range(n_red)]
+                   for m in range(3)}
+        for m in (0, 2):
+            for r in range(n_red):
+                store_a.put(0, m, r, buckets[m][r])
+        for r in range(n_red):
+            store_b.put(0, 1, r, buckets[1][r])
+        tracker = MapOutputTracker()
+        tracker.register_shuffle(0, 3)
+        tracker.register_map_outputs(
+            0, [server_a.uri, server_b.uri, server_a.uri])
+        # Each of A's maps lands in its own group on B (same origin never
+        # shares), B's map joins group 0 as the second member.
+        for mid, origin in ((0, server_a.uri), (2, server_a.uri),
+                            (1, server_b.uri)):
+            gid, idx = put_parity_remote(
+                server_b.uri, 0, mid, origin, "xor", 4, 1,
+                [coding.wire_pack(b) for b in buckets[mid]])
+            tracker.register_parity(0, server_b.uri, gid, mid, idx,
+                                    "xor", 4, 1)
+        env.map_output_tracker = tracker
+        env.shuffle_server = None
+
+        failed = {server_a.uri}
+        tracker.unregister_server_outputs(server_a.uri)
+        lists = tracker.get_server_uri_lists(0)
+        assert all(u.startswith("coded:") for u in lists[0])
+        for rid in range(n_red):
+            stats = {"round_trips": 0, "parity_decodes": 0,
+                     "decode_bytes": 0}
+            recovered, failed_now = fetcher_mod._reconstruct(
+                env, tracker, lists, 0, rid, [0, 2], failed, stats)
+            assert failed_now == set()
+            assert recovered[0] == buckets[0][rid]
+            assert recovered[2] == buckets[2][rid]
+            # Group 0's survivor (map 1) was fetched for the decode and
+            # delivered for free.
+            assert recovered[1] == buckets[1][rid]
+            assert stats["parity_decodes"] == 2
+            assert stats["decode_bytes"] == len(buckets[0][rid]) + \
+                len(buckets[2][rid])
+        # A dead PARITY server degrades (failed, never raises).
+        recovered, failed_now = fetcher_mod._reconstruct(
+            env, tracker, lists, 0, 0, [0, 2],
+            failed | {server_b.uri},
+            {"round_trips": 0, "parity_decodes": 0, "decode_bytes": 0})
+        assert recovered == {} and failed_now == {0, 2}
+    finally:
+        env.map_output_tracker, env.shuffle_server = old
+        server_a.stop()
+        server_b.stop()
+        store_a.close()
+        store_b.close()

@@ -2322,3 +2322,37 @@ def test_exchange_planner_events_aggregated(dctx):
     assert xp["staged_rounds"] >= 2
     assert 0 < xp["max_est_peak_bytes"] <= 1_100_000
     assert xp["over_budget"] == 0
+
+
+def test_gf256_accumulate_host_device_parity():
+    """Coded shuffle's decode hot loop: the device kernel
+    (kernels.gf256_accumulate) must be bit-identical to the numpy twin
+    (coding._accumulate_np) — a divergence would decode shuffled buckets
+    into silently-wrong bytes. Exercises XOR (all-ones coefficients),
+    RS Cauchy coefficients, zero coefficients (masked members), and the
+    explicit numpy-fallback path of coding.accumulate."""
+    from vega_tpu.shuffle import coding
+    from vega_tpu.tpu.kernels import gf256_accumulate
+
+    rng = np.random.RandomState(11)
+    for n, width in ((1, 17), (4, 256), (7, 1023)):
+        blocks = rng.randint(0, 256, size=(n, width)).astype(np.uint8)
+        for coeffs in (
+                np.ones(n, dtype=np.uint8),  # xor scheme
+                np.array([coding.coeff("rs", 0, i) for i in range(n)],
+                         dtype=np.uint8),
+                np.array([(0 if i % 2 else 143) for i in range(n)],
+                         dtype=np.uint8),  # masked members
+        ):
+            host = coding._accumulate_np(blocks, coeffs)
+            dev = np.asarray(gf256_accumulate(blocks, coeffs),
+                             dtype=np.uint8)
+            assert np.array_equal(host, dev)
+            # The public entry agrees on both routes (device preferred
+            # vs forced numpy fallback).
+            assert np.array_equal(
+                coding.accumulate(blocks, coeffs, prefer_device=True),
+                host)
+            assert np.array_equal(
+                coding.accumulate(blocks, coeffs, prefer_device=False),
+                host)

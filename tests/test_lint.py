@@ -1597,3 +1597,26 @@ def test_vg019_mutation_env_reset_from_task_handler(tmp_path):
     msg = res.findings[0].message
     assert "Env.reset" in msg and "'worker-task'" in msg
     assert "_TaskHandler.handle" in msg
+
+
+def test_vg010_mutation_dropped_coding_knob(tmp_path):
+    """PR 19 (coded shuffle): dropping the coding_group_k propagation
+    entry from the real worker knob dict must produce exactly one VG010
+    finding — workers would otherwise group parity members under the
+    DEFAULT k while the driver plans recovery under the configured one."""
+    files = ("vega_tpu/env.py", "vega_tpu/faults.py",
+             "vega_tpu/distributed/backend.py",
+             "vega_tpu/distributed/worker.py",
+             "vega_tpu/distributed/shuffle_server.py",
+             "vega_tpu/shuffle/fetcher.py",
+             "vega_tpu/shuffle/coding.py")
+    _copy_real(tmp_path, *files)
+    base = run_lint([str(tmp_path)], select=["VG010"])
+    assert not base.findings, [f.render() for f in base.findings]
+    _mutate(tmp_path, "vega_tpu/distributed/backend.py",
+            '"VEGA_TPU_CODING_GROUP_K": str(conf.coding_group_k),', "")
+    res = run_lint([str(tmp_path)], select=["VG010"])
+    assert len(res.findings) == 1
+    assert "coding_group_k" in res.findings[0].message
+    assert "not in backend.py's worker propagation list" \
+        in res.findings[0].message

@@ -128,10 +128,41 @@ def push_stats_snapshot() -> dict:
         return dict(_PUSH_TOTALS)
 
 
+# Redundancy-plane byte accounting: what each ladder leg actually costs on
+# the wire. `replica_push_bytes` is the full-copy spend of
+# shuffle_replication>1; the parity_* counters are the coded leg's spend —
+# push_bytes is the zlib wire traffic, raw_bytes the pre-compression bucket
+# bytes folded (their ratio is the compression evidence the equal-storage
+# A/B in benchmarks/straggler_ab.py reads via worker_stats).
+_REDUNDANCY = {
+    "replica_push_bytes": 0,
+    "parity_pushes": 0,
+    "parity_push_bytes": 0,
+    "parity_raw_bytes": 0,
+    "parity_failed": 0,
+}
+
+# Per-shuffle parity-target cursor: THIS origin's pushes walk its candidate
+# list round-robin. Keyed per process (each executor is one process), so an
+# origin's share of parity frames lands evenly on every peer no matter which
+# map_ids the dynamic scheduler happened to hand it — map_id-derived strides
+# go lumpy under work stealing, and a peer that receives two same-origin
+# pushes while another receives none is forced to open singleton groups
+# (full-copy parity frames) by origin-exclusivity.
+_PARITY_CURSOR: dict = {}
+
+
+def redundancy_stats_snapshot() -> dict:
+    with _push_lock:
+        return dict(_REDUNDANCY)
+
+
 def reset_push_stats() -> None:
     with _push_lock:
         for k in _PUSH_TOTALS:
             _PUSH_TOTALS[k] = 0 if isinstance(_PUSH_TOTALS[k], int) else 0.0
+        for k in _REDUNDANCY:
+            _REDUNDANCY[k] = 0
 
 
 _SENTINEL = object()
@@ -351,6 +382,11 @@ class ShuffleDependency(Dependency):
         primary = env.shuffle_server.uri if env.shuffle_server else "local"
         if env.shuffle_server is not None and is_push_plan(env.conf):
             self._push_row(env, map_id, row, task_context)
+        if env.shuffle_server is not None:
+            # Coded leg (shuffle_coding != none): ONE compressed
+            # put_parity round trip to a peer instead of k-1 full-copy
+            # pushes. Composes with replication below — both may run.
+            self._publish_parity(env, map_id, row, primary)
         k = int(getattr(env.conf, "shuffle_replication", 1) or 1)
         if k <= 1 or env.shuffle_server is None:
             return primary
@@ -372,6 +408,7 @@ class ShuffleDependency(Dependency):
                         "primary-only map output", e)
             return primary
         locs = [primary]
+        row_bytes = sum(len(b) for b in row)
         for i in range(len(peers)):
             if len(locs) >= k:
                 break
@@ -380,6 +417,8 @@ class ShuffleDependency(Dependency):
                 continue
             try:
                 push_buckets_remote(uri, self.shuffle_id, map_id, row)
+                with _push_lock:
+                    _REDUNDANCY["replica_push_bytes"] += row_bytes
             except NetworkError as e:
                 log.warning("replica push of shuffle %d map %d to %s "
                             "failed (%s); continuing with %d cop%s",
@@ -392,6 +431,97 @@ class ShuffleDependency(Dependency):
                 continue
             locs.append(uri)
         return locs if len(locs) > 1 else primary
+
+    def _publish_parity(self, env, map_id: int, row: List[bytes],
+                        primary: str) -> None:
+        """Coded leg of the redundancy ladder (`shuffle_coding != none`,
+        shuffle/coding.py): ship this row ONCE, zlib-compressed, to a peer
+        parity server that folds it into an origin-exclusive group of up
+        to `k` map outputs — XOR or GF(256) Reed-Solomon accumulation, m
+        parity units per (group, reduce) — then report the assignment to
+        the tracker. Net cost per map output is ~1/k of a parity frame
+        per reduce bucket plus one compressed push, versus k-1 full
+        copies under replication: the sub-k× overhead the coded rung
+        trades against decode work at failure time.
+
+        Target choice: NEVER the origin itself (a group member folded on
+        its own server decodes nothing when that server dies), walking
+        the sorted live peers from a per-process round-robin cursor —
+        each origin's pushes FAN OUT evenly across servers. Groups are
+        origin-exclusive, so clustering one origin's maps on one server
+        (the obvious `map_id // k` stride) degenerates every group to a
+        singleton — a full-copy parity frame, replication in disguise —
+        and even `map_id % n_peers` goes lumpy under dynamic task
+        placement (an origin's map_ids need not be uniform mod n_peers).
+        The cursor guarantees the even spread that lets each server pack
+        members from DISTINCT origins into shared groups, which is where
+        the sub-k× storage comes from (measured 2.0x -> 1.3x total
+        storage on a 4-origin fleet). Any failure
+        degrades to no parity coverage for this output — never a failed
+        map task (the primary copy is already durable) — and the ladder
+        below (replica failover, FetchFailed, resubmit) stays total."""
+        from vega_tpu.shuffle import coding
+
+        spec = coding.spec_from_conf(env.conf)
+        if spec is None or env.shuffle_server is None or not row:
+            return
+        peers_fn = getattr(env.map_output_tracker, "list_shuffle_peers", None)
+        if peers_fn is None:
+            return
+        scheme, k, m = spec
+        from vega_tpu.errors import NetworkError
+
+        try:
+            candidates = sorted(
+                u for u in _live_shuffle_peers(env.map_output_tracker)
+                if u != primary)
+        except NetworkError as e:
+            log.warning("parity peer discovery failed (%s); shuffle %d map "
+                        "%d ships without parity coverage", e,
+                        self.shuffle_id, map_id)
+            return
+        if not candidates:
+            return  # single-server fleet: nothing to code against
+        payloads = [coding.wire_pack(b) for b in row]
+        from vega_tpu.distributed.shuffle_server import put_parity_remote
+
+        with _push_lock:
+            start = _PARITY_CURSOR.get(self.shuffle_id, 0)
+            _PARITY_CURSOR[self.shuffle_id] = \
+                (start + 1) % len(candidates)
+        for i in range(len(candidates)):
+            uri = candidates[(start + i) % len(candidates)]
+            try:
+                gid, idx = put_parity_remote(
+                    uri, self.shuffle_id, map_id, primary, scheme, k, m,
+                    payloads)
+            except NetworkError as e:
+                log.warning("parity push of shuffle %d map %d to %s failed "
+                            "(%s); trying next peer", self.shuffle_id,
+                            map_id, uri, e)
+                _invalidate_peer_cache()
+                continue
+            reg = getattr(env.map_output_tracker, "register_parity", None)
+            if reg is not None:
+                try:
+                    reg(self.shuffle_id, uri, gid, map_id, idx, scheme, k, m)
+                except Exception as e:  # noqa: BLE001 — registration is
+                    # advisory coverage: losing it degrades the ladder to
+                    # FetchFailed/resubmit, never wrong data.
+                    log.warning("parity registration of shuffle %d map %d "
+                                "failed (%s); coverage unusable",
+                                self.shuffle_id, map_id, e)
+            with _push_lock:
+                _REDUNDANCY["parity_pushes"] += 1
+                _REDUNDANCY["parity_push_bytes"] += sum(
+                    len(p) for p in payloads)
+                _REDUNDANCY["parity_raw_bytes"] += sum(len(b) for b in row)
+            return
+        log.warning("no live peer accepted parity for shuffle %d map %d; "
+                    "output ships without parity coverage", self.shuffle_id,
+                    map_id)
+        with _push_lock:
+            _REDUNDANCY["parity_failed"] += 1
 
     def _push_row(self, env, map_id: int, row: List[bytes],
                   task_context) -> None:

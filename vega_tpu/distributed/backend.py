@@ -210,6 +210,13 @@ class DistributedBackend(TaskBackend):
             # tasks fail slow/dead servers over to the replicas.
             "VEGA_TPU_SHUFFLE_REPLICATION": str(conf.shuffle_replication),
             "VEGA_TPU_FETCH_SLOW_SERVER_S": str(conf.fetch_slow_server_s),
+            # Coded shuffle: map tasks fold bucket rows into peer-held
+            # parity groups; reducers reconstruct lost buckets from the
+            # survivors + parity (shuffle/coding.py).
+            "VEGA_TPU_SHUFFLE_CODING": str(
+                getattr(conf, "shuffle_coding", "none")),
+            "VEGA_TPU_CODING_GROUP_K": str(conf.coding_group_k),
+            "VEGA_TPU_CODING_PARITY_M": str(conf.coding_parity_m),
             # Push plan: map tasks push buckets to their reducer's
             # owning server; reducers read the pre-merged blob first.
             "VEGA_TPU_SHUFFLE_PLAN": str(

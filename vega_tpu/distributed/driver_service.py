@@ -113,6 +113,18 @@ class DriverService:
                 for wid, info in self.live_workers().items()
                 if info.get("shuffle_uri") and wid not in draining
             }
+        if msg_type == "register_parity":
+            # Coded shuffle: a map task reports its parity-group
+            # assignment (which server folded it, into which group, at
+            # which member index) right after a successful put_parity.
+            (shuffle_id, parity_uri, group_id, map_id, idx,
+             scheme, k, m) = payload
+            self.map_output_tracker.register_parity(
+                shuffle_id, parity_uri, group_id, map_id, idx,
+                scheme, k, m)
+            return True
+        if msg_type == "get_parity_map":
+            return self.map_output_tracker.get_parity_map(payload)
         if msg_type == "has_outputs":
             return self.map_output_tracker.has_outputs(payload)
         if msg_type == "generation":
@@ -205,6 +217,17 @@ class RemoteTrackerClient:
     def list_shuffle_peers(self) -> dict:
         """Live executors' shuffle-server URIs (replica targets)."""
         return self._call("list_shuffle_peers")
+
+    def register_parity(self, shuffle_id: int, parity_uri: str,
+                        group_id: int, map_id: int, idx: int,
+                        scheme: str, k: int, m: int) -> None:
+        """Coded shuffle: report a successful parity fold (idempotent)."""
+        self._call("register_parity", (shuffle_id, parity_uri, group_id,
+                                       map_id, idx, scheme, k, m))
+
+    def get_parity_map(self, shuffle_id: int) -> dict:
+        """Coded shuffle: the shuffle's parity groups for reconstruction."""
+        return self._call("get_parity_map", shuffle_id)
 
     def has_outputs(self, shuffle_id: int) -> bool:
         return self._call("has_outputs", shuffle_id)

@@ -113,6 +113,34 @@ metadata the server-side pre-merge tier needs (attempt tag, combiner op):
 A reducer that cannot complete this exchange (connection drop, owner
 dead, nothing was pushed) treats the merged set as EMPTY and silently
 degrades to the pull plan for every map_id — no new failure modes.
+
+Coded-shuffle messages (`shuffle_coding != none` — the sub-k× redundancy
+leg, shuffle/coding.py). `put_parity` is the `put_many` wire shape with
+the coding spec riding along; frames are zlib-compressed (stored parity
+is raw — the server decompresses before folding):
+
+    -> ("put_parity", (shuffle_id, map_id, origin_uri, scheme,
+                       group_k, units, n_buckets))
+       + n_buckets zlib-compressed bucket frames in reduce_id order
+                                                    (map task -> its
+                                                     PARITY server: the
+                                                     server assigns an
+                                                     origin-exclusive
+                                                     group and folds all
+                                                     `units` parity
+                                                     frames; repeats by
+                                                     map_id are deduped
+                                                     first-wins)
+    <- ("ok", (group_id, member_index))
+     | ("error", reason)                            (fold refused: the
+                                                     mapper degrades to
+                                                     no parity coverage)
+
+    -> ("get_parity", (shuffle_id, group_id, unit, reduce_id))
+    <- ("ok", None) + one raw parity-frame bytes frame (VP01 format,
+       CRC-checked CLIENT-side: a corrupt frame reads as missing)
+     | ("missing", payload)                         (unknown group/unit
+                                                     or dropped frame)
 """
 
 from __future__ import annotations

@@ -31,10 +31,12 @@ import socketserver
 import threading
 import time
 from typing import Optional
+from zlib import error as zlib_error
 
 from vega_tpu import faults
 from vega_tpu.distributed import protocol
 from vega_tpu.errors import FetchFailedError, NetworkError
+from vega_tpu.lint.sync_witness import named_lock
 
 log = logging.getLogger("vega_tpu")
 
@@ -136,6 +138,67 @@ class _Handler(socketserver.BaseRequestHandler):
                         data = protocol.recv_bytes(sock)
                         store.put(shuffle_id, map_id, reduce_id, data)
                     protocol.send_msg(sock, "ok", n_buckets)
+                elif msg_type == "put_parity":
+                    # Coded shuffle (shuffle_coding != none): a peer map
+                    # task ships its full bucket row ONCE (compressed)
+                    # and this server folds it into a parity group —
+                    # dynamic, origin-exclusive membership (at most one
+                    # member per origin server per group), so losing any
+                    # single server never costs a group more members
+                    # than its parity units can decode. First-wins dedup
+                    # by map_id: a speculative duplicate or retry gets
+                    # the memoized (group, index) without double-folding
+                    # (XOR would cancel). Frames arrive zlib-compressed
+                    # in reduce_id order (protocol.py grammar).
+                    from vega_tpu.shuffle import coding
+
+                    (shuffle_id, map_id, origin, scheme,
+                     group_k, units, n_buckets) = payload
+                    frames = [protocol.recv_bytes(sock)
+                              for _ in range(n_buckets)]
+                    gid, idx, first = \
+                        self.server.owner.assign_parity_member(  # type: ignore[attr-defined]
+                            shuffle_id, map_id, origin, scheme, group_k,
+                            units)
+                    if first:
+                        try:
+                            bufs = [coding.wire_unpack(f) for f in frames]
+                            for unit in range(units):
+                                for reduce_id, raw in enumerate(bufs):
+                                    store.fold_parity(
+                                        shuffle_id, gid, unit, reduce_id,
+                                        map_id, idx, scheme, group_k, raw)
+                        except (ValueError, zlib_error) as e:
+                            # Refuse rather than store half-folded
+                            # parity: the mapper degrades to no coverage
+                            # for this row; already-folded units of this
+                            # member stay consistent only if none folded,
+                            # so roll the membership back.
+                            self.server.owner.drop_parity_member(  # type: ignore[attr-defined]
+                                shuffle_id, map_id)
+                            protocol.send_msg(sock, "error",
+                                              f"parity fold failed: {e}")
+                            return
+                    protocol.send_msg(sock, "ok", (gid, idx))
+                elif msg_type == "get_parity":
+                    # Serve one parity frame (group, unit, reduce). The
+                    # PARITY_CORRUPT_N chaos hook flips a byte here: the
+                    # client's CRC must reject the frame as missing.
+                    from vega_tpu.shuffle import coding
+
+                    shuffle_id, gid, unit, reduce_id = payload
+                    pkey = coding.parity_map_id(gid, unit)
+                    data = store.get(shuffle_id, pkey, reduce_id)
+                    if data is None:
+                        protocol.send_msg(sock, "missing", payload)
+                    else:
+                        if faults.get().corrupt_parity():
+                            flip = len(data) // 2
+                            data = (data[:flip]
+                                    + bytes([data[flip] ^ 0xFF])
+                                    + data[flip + 1:])
+                        protocol.send_msg(sock, "ok", None)
+                        protocol.send_bytes(sock, data)
                 elif msg_type == "status":
                     # Tier occupancy + spill counters (store.status());
                     # "entries" keeps the original healthcheck contract.
@@ -180,6 +243,17 @@ class ShuffleServer:
             budget_bytes=((1 << 28) if premerge_budget is None
                           else int(premerge_budget)))
         self._server.premerge = self.premerge  # type: ignore[attr-defined]
+        self._server.owner = self  # type: ignore[attr-defined]
+        # Coded-shuffle parity groups formed AT this server (it is the
+        # parity holder; members are peer mappers' outputs). Group
+        # assignment is dynamic and origin-exclusive: an open group never
+        # takes two members pushed from the same origin server, so any
+        # single server loss leaves every group at most one member short
+        # — always decodable while the parity holder survives. State is
+        # process-local like the store itself: parity dies with the
+        # server, exactly like the frames it indexes.
+        self._parity_lock = named_lock("shuffle_server.parity_groups")
+        self._parity_groups: dict = {}  # shuffle_id -> registry
         self.host = host
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -190,6 +264,48 @@ class ShuffleServer:
     @property
     def uri(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def assign_parity_member(self, shuffle_id: int, map_id: int,
+                             origin: str, scheme: str, group_k: int,
+                             units: int):
+        """Place one mapper contribution into a parity group: the first
+        open group (same shuffle/scheme/shape, fewer than group_k
+        members, no member from `origin` yet) — else a new one. Returns
+        (group_id, member_index, first_time); a repeat for the same
+        map_id (task retry, speculative duplicate) gets its memoized
+        assignment with first_time=False so the caller never
+        double-folds."""
+        with self._parity_lock:
+            st = self._parity_groups.setdefault(
+                shuffle_id, {"next_gid": 0, "by_map": {}, "groups": {}})
+            prior = st["by_map"].get(map_id)
+            if prior is not None:
+                return prior[0], prior[1], False
+            for g in st["groups"].values():
+                if (g["scheme"] == scheme and g["k"] == group_k
+                        and g["m"] == units and g["count"] < g["k"]
+                        and origin not in g["origins"]):
+                    idx = g["count"]
+                    g["count"] += 1
+                    g["origins"].add(origin)
+                    st["by_map"][map_id] = (g["gid"], idx)
+                    return g["gid"], idx, True
+            gid = st["next_gid"]
+            st["next_gid"] += 1
+            st["groups"][gid] = {"gid": gid, "scheme": scheme,
+                                 "k": group_k, "m": units, "count": 1,
+                                 "origins": {origin}}
+            st["by_map"][map_id] = (gid, 0)
+            return gid, 0, True
+
+    def drop_parity_member(self, shuffle_id: int, map_id: int) -> None:
+        """Roll back a membership whose fold failed (the member's slot
+        index is burned — indices are never reused — but the mapper can
+        land in another group on retry)."""
+        with self._parity_lock:
+            st = self._parity_groups.get(shuffle_id)
+            if st is not None:
+                st["by_map"].pop(map_id, None)
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -296,6 +412,76 @@ def push_buckets_remote(uri: str, shuffle_id: int, map_id: int,
     finally:
         if not clean:
             _drop_connection(uri)
+
+
+def put_parity_remote(uri: str, shuffle_id: int, map_id: int, origin: str,
+                      scheme: str, group_k: int, units: int,
+                      payloads) -> tuple:
+    """Ship one map task's full bucket row (zlib-compressed frames,
+    reduce order) to the parity server in ONE `put_parity` round trip;
+    the server assigns the group and folds. Returns the assigned
+    (group_id, member_index). Raises NetworkError on failure — the
+    caller tries the next candidate peer or degrades to no parity
+    coverage, never fails the map task (`deadline_s`-bounded IO like the
+    push plan: parity is an optimization, a hung peer must not gate the
+    map task on the 120s socket timeout)."""
+    clean = False
+    try:
+        sock = _pooled_connection(uri, connect_timeout=PUSH_IO_DEADLINE_S)
+        sock.settimeout(PUSH_IO_DEADLINE_S)
+        protocol.send_msg(sock, "put_parity",
+                          (shuffle_id, map_id, origin, scheme, group_k,
+                           units, len(payloads)))
+        for blob in payloads:
+            protocol.send_bytes(sock, blob)
+        reply_type, assigned = protocol.recv_msg(sock)
+        if reply_type != "ok":
+            raise NetworkError(f"parity push refused: {assigned!r}")
+        clean = True
+        sock.settimeout(protocol.IO_TIMEOUT)
+        return assigned
+    finally:
+        if not clean:
+            _drop_connection(uri)
+
+
+def fetch_parity_remote(uri: str, shuffle_id: int, group_id: int,
+                        unit: int, reduce_id: int):
+    """Fetch one parity frame and verify it client-side: returns
+    (unit, header, payload_uint8) — or None when the server answers
+    missing OR the frame fails the CRC/magic checks (corrupt parity must
+    read as missing so recovery degrades down the ladder instead of
+    decoding garbage). Raises NetworkError on transport failure."""
+    from vega_tpu.shuffle import coding
+
+    clean = False
+    try:
+        sock = _pooled_connection(uri, connect_timeout=PUSH_IO_DEADLINE_S)
+        sock.settimeout(PUSH_IO_DEADLINE_S)
+        protocol.send_msg(sock, "get_parity",
+                          (shuffle_id, group_id, unit, reduce_id))
+        reply_type, _ = protocol.recv_msg(sock)
+        if reply_type == "missing":
+            clean = True
+            sock.settimeout(protocol.IO_TIMEOUT)
+            return None
+        if reply_type != "ok":
+            raise NetworkError(f"unexpected get_parity reply "
+                               f"{reply_type!r}")
+        blob = protocol.recv_bytes(sock)
+        clean = True
+        sock.settimeout(protocol.IO_TIMEOUT)
+    finally:
+        if not clean:
+            _drop_connection(uri)
+    parsed = coding.parse_frame(blob)
+    if parsed is None:
+        log.warning("parity frame (shuffle %d group %d unit %d reduce %d)"
+                    " from %s failed validation; treating as missing",
+                    shuffle_id, group_id, unit, reduce_id, uri)
+        return None
+    header, payload = parsed
+    return unit, header, payload
 
 
 def push_merged_remote(uri: str, shuffle_id: int, map_id: int, attempt: int,

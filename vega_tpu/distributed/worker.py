@@ -84,6 +84,10 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 "executor_id": worker.executor_id,
                 "fetch": fetcher_mod.stats_snapshot(),
                 "push": dependency_mod.push_stats_snapshot(),
+                # Redundancy-plane byte spend: replica full copies vs the
+                # coded leg's compressed parity pushes (the equal-storage
+                # A/B evidence, benchmarks/straggler_ab.py).
+                "redundancy": dependency_mod.redundancy_stats_snapshot(),
             })
             return
         if msg_type == "cancel_task":

@@ -208,6 +208,22 @@ class Configuration:
     # fails over to the replicas instead of gating the reduce task on the
     # slowest source. 0 keeps the normal fetch_retries behavior.
     fetch_slow_server_s: float = 0.0
+    # Coded shuffle (third redundancy-ladder leg, arXiv:1802.03049 via
+    # shuffle/coding.py): "none" (default) | "xor" | "rs" | "rs(k,m)".
+    # Map tasks ship each bucket row ONCE (compressed) to a parity
+    # server, which folds rotation groups of up to `coding_group_k`
+    # same-shuffle rows — at most one per origin server, so any single
+    # server loss is decodable — into parity buckets: one XOR unit, or
+    # `coding_parity_m` Reed–Solomon units (any ≤m losses decode). On a
+    # dead server the fetch path RECONSTRUCTS missing buckets from the
+    # surviving members plus parity instead of resubmitting the map
+    # stage: replica-grade recovery at ~(1/group)× storage instead of
+    # (k-1)×. Composes with shuffle_replication (replica failover is
+    # tried first) and shuffle_plan=push; degradation ladder stays total
+    # (coded -> replica -> FetchFailed -> resubmit).
+    shuffle_coding: str = "none"
+    coding_group_k: int = 4
+    coding_parity_m: int = 1
     # Dense-tier HBM budget in bytes (per chip). Sources stream through
     # the mesh in chunks (tpu/stream.py) when estimated block bytes times
     # the exchange footprint factor (~6: operand + sorted copy + send
@@ -349,7 +365,8 @@ class Configuration:
         for name in ("LOCAL_IP", "LOCAL_DIR", "LOG_LEVEL", "DENSE_EXCHANGE",
                      "DENSE_RBK_PLAN", "DENSE_SORT_IMPL",
                      "DENSE_TABLE_PLAN", "HOSTS_FILE", "SPILL_DIR",
-                     "SCHEDULER_MODE", "SHUFFLE_PLAN", "ADMISSION_MODE",
+                     "SCHEDULER_MODE", "SHUFFLE_PLAN", "SHUFFLE_CODING",
+                     "ADMISSION_MODE",
                      "STREAM_BACKPRESSURE_MODE", "STREAM_POOL",
                      "STREAM_STORAGE_LEVEL", "STREAM_CHECKPOINT_DIR"):
             if env.get(pref + name):
@@ -361,7 +378,8 @@ class Configuration:
                      "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
                      "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
                      "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES",
-                     "SHUFFLE_REPLICATION", "ELASTIC_MIN_EXECUTORS",
+                     "SHUFFLE_REPLICATION", "CODING_GROUP_K",
+                     "CODING_PARITY_M", "ELASTIC_MIN_EXECUTORS",
                      "ELASTIC_MAX_EXECUTORS", "POOL_MAX_QUEUED",
                      "STREAM_BLOCK_MAX_RECORDS", "STREAM_QUEUE_MAX_BLOCKS",
                      "STREAM_POOL_WEIGHT"):

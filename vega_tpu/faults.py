@@ -84,6 +84,14 @@ tests:
                                      tracked offset with no duplicate or
                                      lost records
   VEGA_TPU_FAULT_CORRUPT_SPILL_N     corrupt the first N spilled buckets
+  VEGA_TPU_FAULT_PARITY_CORRUPT_N    flip a byte in the first N served
+                                     parity frames (get_parity replies,
+                                     shuffle_coding != none) — the
+                                     client-side CRC must reject the
+                                     frame as MISSING so the fetch
+                                     degrades down the ladder (coded ->
+                                     replica -> FetchFailed -> resubmit)
+                                     instead of decoding garbage
   VEGA_TPU_FAULT_DROP_BINARY_N       drop the cached stage binary for the
                                      first N `binary_cached` task_v2
                                      dispatches (simulated LRU eviction /
@@ -153,6 +161,7 @@ class FaultInjector:
         self.push_drop_n = _int("PUSH_DROP_N") if armed else 0
         self.merged_delay_s = _float("MERGED_DELAY_S") if armed else 0.0
         self.corrupt_spill_n = _int("CORRUPT_SPILL_N") if armed else 0
+        self.parity_corrupt_n = _int("PARITY_CORRUPT_N") if armed else 0
         self.receiver_crash_after_blocks = \
             _int("RECEIVER_CRASH_AFTER_BLOCKS") if armed else 0
         self.drop_binary_n = _int("DROP_BINARY_N") if armed else 0
@@ -171,6 +180,7 @@ class FaultInjector:
             self.kill_after_tasks or self.hang_tasks or self.slow_tasks
             or self.suppress_heartbeats or self.fetch_drop_n
             or self.fetch_delay_s or self.corrupt_spill_n
+            or self.parity_corrupt_n
             or self.fetch_stream_drop_n or self.drop_binary_n
             or self.push_drop_n or self.merged_delay_s
             or self.decommission_hang_s or self.receiver_crash_after_blocks
@@ -362,6 +372,24 @@ class FaultInjector:
         log.warning("FAULT: crashing streaming receiver after %d blocks",
                     blocks_landed)
         raise RuntimeError("FAULT: injected receiver crash")
+
+    def corrupt_parity(self) -> bool:
+        """shuffle_server.py, serving a get_parity frame: True -> the
+        server must flip a byte in the frame it serves. The fetcher's
+        CRC check then rejects the frame as MISSING and the recovery
+        degrades down the ladder (coded -> replica failover ->
+        FetchFailed -> stage resubmit) — corrupt parity must never be
+        decoded into wrong data."""
+        if not (self.active and self.parity_corrupt_n
+                and self._targets_me()):
+            return False
+        with self._lock:
+            if self.parity_corrupt_n <= 0:
+                return False
+            self.parity_corrupt_n -= 1
+        self._record("parity_corrupt")
+        log.warning("FAULT: corrupting served parity frame")
+        return True
 
     def corrupt_spilled(self, disk_store, key: str) -> None:
         """shuffle/store.py, after a bucket spills: flip payload bytes in

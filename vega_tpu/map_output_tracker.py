@@ -16,6 +16,19 @@ primary-per-map contract; `get_server_uri_lists` exposes the full lists to
 the failover-aware fetch path. An output is "available" while ANY location
 remains, so losing one replica neither blocks reducers nor forces a map
 recompute.
+
+Coded shuffle (`shuffle_coding != none`, shuffle/coding.py) adds a THIRD
+redundancy form next to the location lists: per-shuffle parity-group
+membership (`register_parity` — which parity server folded which map_id
+into which origin-exclusive group, at what member index). When a lost
+server would EMPTY a map output's location list but a surviving group can
+still decode it (≤ m members missing), `unregister_server_outputs`
+installs a `coded:{parity_uri}/{group_id}` PSEUDO-location instead of
+leaving the list empty: reducers stay unblocked (`_wait_complete` sees a
+location), and the fetch path recognizes the `coded:` prefix as "decode
+from k-1 survivors + parity" rather than "connect to a server". Pseudo-
+locations are bookkeeping only — they never serve bytes themselves, and
+they die with the parity server that backs them.
 """
 
 from __future__ import annotations
@@ -48,6 +61,12 @@ class MapOutputTracker:
         # schedule reduce task r where most of r's input bytes already
         # sit. Purely advisory — never consulted for correctness.
         self._sizes: Dict[int, Dict[int, List[int]]] = {}
+        # Coded shuffle: shuffle_id -> (parity_uri, group_id) -> group
+        # record {"scheme", "k", "m", "members": {map_id: member_index}}.
+        # Written by register_parity at publish time (may PRECEDE the map
+        # output's own registration — parity is pushed worker-side before
+        # the stage completes driver-side).
+        self._parity: Dict[int, Dict[tuple, dict]] = {}
         self._generation = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -88,14 +107,39 @@ class MapOutputTracker:
         across all shuffles in one sweep, bumping the generation ONCE so
         reducers refetch (the reaper's bulk edition of
         unregister_map_output). Returns the number of entries the server
-        was dropped from; outputs with surviving replicas stay available."""
+        was dropped from; outputs with surviving replicas stay available.
+
+        Coded shuffle: parity groups HOSTED on `uri` die with it (their
+        `coded:` pseudo-locations are stripped in the same sweep), and any
+        entry the sweep would EMPTY that a surviving group can still
+        decode gets that group's pseudo-location installed instead — the
+        coded rung of the degradation ladder, keeping reducers unparked
+        and the stage available with zero map recompute."""
         removed = 0
+        dead_prefix = f"coded:{uri}/"
         with self._cond:
-            for locs in self._outputs.values():
+            # (1) Parity folded on the dead server is gone.
+            for groups in self._parity.values():
+                for key in [k for k in groups if k[0] == uri]:
+                    del groups[key]
+            # (2) BEFORE dropping, work out which about-to-be-emptied
+            # entries a surviving parity group can still decode.
+            covered = self._covered_if_lost(uri)
+            # (3) The sweep: drop `uri` and dead pseudo-locations;
+            # install a pseudo-location wherever reconstruction keeps an
+            # otherwise-emptied entry available.
+            for shuffle_id, locs in self._outputs.items():
                 for i, lst in enumerate(locs):
                     if uri in lst:
-                        locs[i] = [u for u in lst if u != uri]
                         removed += 1
+                    kept = [u for u in lst
+                            if u != uri and not u.startswith(dead_prefix)]
+                    if not kept and lst:
+                        pseudo = covered.get((shuffle_id, i))
+                        if pseudo is not None:
+                            kept = [pseudo]
+                    if kept != lst:
+                        locs[i] = kept
             if removed:
                 self._generation += 1
                 self._cond.notify_all()
@@ -105,6 +149,7 @@ class MapOutputTracker:
         with self._lock:
             self._outputs.pop(shuffle_id, None)
             self._sizes.pop(shuffle_id, None)
+            self._parity.pop(shuffle_id, None)
 
     # --- graceful decommission (scheduler/elastic.py) ----------------------
     def outputs_on_server(self, uri: str):
@@ -152,6 +197,85 @@ class MapOutputTracker:
             replaced = [new_uri if u == old_uri else u for u in locs[map_id]]
             locs[map_id] = list(dict.fromkeys(replaced))  # order-preserving
             self._cond.notify_all()
+
+    # --- coded shuffle (shuffle/coding.py) ---------------------------------
+    def register_parity(self, shuffle_id: int, parity_uri: str,
+                        group_id: int, map_id: int, idx: int,
+                        scheme: str, k: int, m: int) -> None:
+        """Record that `parity_uri` folded map_id's buckets into
+        origin-exclusive group `group_id` at member index `idx`.
+        Idempotent per (group, map_id) — push retries re-report the same
+        memoized assignment (the server dedupes folds first-wins)."""
+        with self._lock:
+            groups = self._parity.setdefault(shuffle_id, {})
+            g = groups.setdefault((parity_uri, group_id),
+                                  {"scheme": scheme, "k": k, "m": m,
+                                   "members": {}})
+            g["members"][map_id] = idx
+
+    def get_parity_map(self, shuffle_id: int) -> Dict:
+        """Snapshot of the shuffle's parity groups for the reconstruction
+        fetch path: {(parity_uri, group_id): {"scheme", "k", "m",
+        "members": {map_id: member_index}}}. Non-blocking — empty when
+        coding is off or nothing was folded."""
+        with self._lock:
+            groups = self._parity.get(shuffle_id, {})
+            return {key: {"scheme": g["scheme"], "k": g["k"], "m": g["m"],
+                          "members": dict(g["members"])}
+                    for key, g in groups.items()}
+
+    def decodable_without(self, uri: str) -> Dict:
+        """What the coded rung would save if `uri` vanished right now:
+        {(shuffle_id, map_id): pseudo_location} for every entry whose ONLY
+        real location is `uri` but whose parity group (hosted elsewhere)
+        can still decode it. The elastic controller's decommission planner
+        counts these next to replica-covered outputs."""
+        with self._lock:
+            return self._covered_if_lost(uri)
+
+    def coded_locations(self, shuffle_id: int) -> Dict[int, str]:
+        """Map outputs currently available ONLY via reconstruction:
+        {map_id: pseudo_location} for entries whose location list is all
+        `coded:` pseudo-locations. Non-blocking; the DAG scheduler uses
+        this to re-adopt coded coverage into stage bookkeeping after an
+        executor loss."""
+        with self._lock:
+            locs = self._outputs.get(shuffle_id)
+            if locs is None:
+                return {}
+            return {i: lst[0] for i, lst in enumerate(locs)
+                    if lst and all(u.startswith("coded:") for u in lst)}
+
+    def _covered_if_lost(self, uri: str) -> Dict:
+        """Caller holds self._lock. For every parity group NOT hosted on
+        `uri`: count members with no real location besides `uri` (pseudo-
+        locations don't count — they are claims on parity, not bytes). If
+        at least one member is missing and no more than m are, the group
+        decodes them all — report each as covered by the group's pseudo-
+        location."""
+        covered: Dict = {}
+        for shuffle_id, groups in self._parity.items():
+            locs = self._outputs.get(shuffle_id)
+            if locs is None:
+                continue
+            for (puri, gid), g in groups.items():
+                if puri == uri:
+                    continue  # the parity itself dies with the server
+                missing = []
+                in_range = True
+                for mid in g["members"]:
+                    if not (0 <= mid < len(locs)):
+                        in_range = False
+                        break
+                    real = [u for u in locs[mid]
+                            if u != uri and not u.startswith("coded:")]
+                    if not real and locs[mid]:
+                        missing.append(mid)
+                if in_range and missing and len(missing) <= g["m"]:
+                    pseudo = f"coded:{puri}/{gid}"
+                    for mid in missing:
+                        covered[(shuffle_id, mid)] = pseudo
+        return covered
 
     # --- per-bucket size accounting (locality plane) -----------------------
     def register_map_sizes(self, shuffle_id: int,

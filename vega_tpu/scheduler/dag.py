@@ -961,10 +961,39 @@ class DAGScheduler:
         with self._stages_lock:
             stages = list(self._shuffle_to_map_stage.values())
             jobs = list(self._running_jobs.values())
+        # Coded rung (shuffle_coding != none): the reaper's tracker sweep
+        # ran BEFORE this callback, installing `coded:` pseudo-locations
+        # for entries a surviving parity group still decodes. Re-adopt
+        # them into stage bookkeeping so covered stages stay AVAILABLE
+        # (zero recompute) — exactly like a replica-covered output. Dead
+        # pseudo-locations (parity hosted on the lost server) are
+        # stripped alongside the server itself.
+        tracker = Env.get().map_output_tracker
+        coded_fn = getattr(tracker, "coded_locations", None) \
+            if tracker is not None else None
+        dead_prefix = f"coded:{shuffle_uri}/"
         lost_stages = []
         for stage in stages:
             before = stage.num_available_outputs
             stage.remove_outputs_on_server(shuffle_uri)
+            for p in range(stage.num_partitions):
+                if any(u.startswith(dead_prefix)
+                       for u in stage.output_locs[p]):
+                    stage.output_locs[p] = [
+                        u for u in stage.output_locs[p]
+                        if not u.startswith(dead_prefix)]
+            if coded_fn is not None:
+                try:
+                    coded = coded_fn(stage.shuffle_dep.shuffle_id)
+                except Exception as e:  # noqa: BLE001 — coverage is best-effort
+                    log.warning("coded-location lookup for shuffle %d "
+                                "failed (%s); stage recomputes instead",
+                                stage.shuffle_dep.shuffle_id, e)
+                    coded = {}
+                for p, pseudo in coded.items():
+                    if 0 <= p < stage.num_partitions \
+                            and not stage.output_locs[p]:
+                        stage.output_locs[p] = [pseudo]
             if stage.num_available_outputs < before:
                 lost_stages.append(stage)
         if not lost_stages:

@@ -417,11 +417,23 @@ class ElasticController:
             log.warning("decommission drain of %s timed out; escalating "
                         "to the executor-lost path", executor_id)
             tracker = Env.get().map_output_tracker
+            covered: Dict = {}
+            if uri and tracker is not None \
+                    and hasattr(tracker, "decodable_without"):
+                try:
+                    covered = tracker.decodable_without(uri)
+                except Exception as e:  # noqa: BLE001 — accounting only
+                    log.warning("parity-coverage lookup for %s failed "
+                                "(%s); counting as recompute", uri, e)
+                    covered = {}
             if uri and tracker is not None \
                     and hasattr(tracker, "outputs_on_server"):
                 for _sid, _mid, locs, _sizes in \
                         tracker.outputs_on_server(uri):
-                    if len(locs) > 1:
+                    # Parity-covered sole copies (shuffle_coding != none)
+                    # count as covered: the lost-path sweep installs their
+                    # coded: pseudo-locations and reducers reconstruct.
+                    if len(locs) > 1 or (_sid, _mid) in covered:
                         counts["replica_covered"] += 1
                     else:
                         counts["recomputed_outputs"] += 1
@@ -466,6 +478,19 @@ class ElasticController:
         manifest = tracker.outputs_on_server(uri)
         survivors = [u for u in self.backend.shuffle_peer_uris()
                      if u != uri]
+        # Coded shuffle: outputs whose ONLY copy sits on the victim but
+        # whose parity group (hosted on a survivor) can still decode them.
+        # Treated like replica-covered — no bytes move; the sweep below
+        # installs their coded: pseudo-locations and the rebind points
+        # cached stages at them, so reducers reconstruct on demand.
+        parity_covered: Dict = {}
+        if hasattr(tracker, "decodable_without"):
+            try:
+                parity_covered = tracker.decodable_without(uri)
+            except Exception as e:  # noqa: BLE001 — coverage is best-effort
+                log.warning("parity-coverage lookup for %s failed (%s); "
+                            "sole copies migrate or recompute", uri, e)
+                parity_covered = {}
         rebind: Dict[Tuple[int, int], str] = {}
         lost: Set[Tuple[int, int]] = set()
         rotation = 0
@@ -481,8 +506,13 @@ class ElasticController:
                 # path — recompute-on-demand, which is moot for a
                 # stopping context and never wrong for a surviving one.
                 break
-            if any(u != uri for u in locs):
+            if any(u != uri and not u.startswith("coded:") for u in locs):
                 counts["replica_covered"] += 1
+                continue
+            pseudo = parity_covered.get((shuffle_id, map_id))
+            if pseudo is not None:
+                counts["replica_covered"] += 1
+                rebind[(shuffle_id, map_id)] = pseudo
                 continue
             if victim_up is None and survivors and sizes is not None:
                 victim_up = check_status(uri, timeout=5.0) is not None

@@ -280,6 +280,14 @@ class ShuffleFetchCompleted(Event):
     # reduce-side win is local_blob_reads up, merged_rtts down.
     local_blob_reads: int = 0
     merged_rtts: int = 0
+    # shuffle_coding != none: reconstruction incidents this stream rode
+    # out (coded_failovers), buckets decoded from k-1 survivors + parity
+    # (parity_decodes) and the decoded byte volume — all zero on a
+    # healthy fleet; non-zero is the coded rung's zero-recompute
+    # recovery evidence.
+    coded_failovers: int = 0
+    parity_decodes: int = 0
+    decode_bytes: int = 0
 
 
 @dataclasses.dataclass
@@ -541,6 +549,13 @@ class MetricsListener(Listener):
         self.fetch_premerged_buckets = 0
         self.fetch_local_blob_reads = 0
         self.fetch_merged_rtts = 0
+        # Coded-shuffle reconstruction (shuffle_coding != none): incidents
+        # ridden out, buckets decoded from survivors + parity, decoded
+        # bytes. The chaos suite asserts coded_failovers >= 1 with zero
+        # StageResubmitted when a parity-covered server is killed.
+        self.coded_failovers = 0
+        self.parity_decodes = 0
+        self.decode_bytes = 0
         # Locality-plane histogram (TaskEnd.locality): how many dispatches
         # achieved each tier against their preferred locations. Per-stage
         # copies live in self.stages[stage_id]["locality"]. bench.py and
@@ -717,6 +732,9 @@ class MetricsListener(Listener):
                 self.fetch_premerged_buckets += event.premerged_buckets
                 self.fetch_local_blob_reads += event.local_blob_reads
                 self.fetch_merged_rtts += event.merged_rtts
+                self.coded_failovers += event.coded_failovers
+                self.parity_decodes += event.parity_decodes
+                self.decode_bytes += event.decode_bytes
             elif isinstance(event, DenseExchangePlanned):
                 xp = self.exchange_plans
                 xp[event.program] = xp.get(event.program, 0) + 1
@@ -845,6 +863,9 @@ class MetricsListener(Listener):
                     "premerged_buckets": self.fetch_premerged_buckets,
                     "local_blob_reads": self.fetch_local_blob_reads,
                     "merged_rtts": self.fetch_merged_rtts,
+                    "coded_failovers": self.coded_failovers,
+                    "parity_decodes": self.parity_decodes,
+                    "decode_bytes": self.decode_bytes,
                 },
                 "locality": dict(self.locality),
                 "shuffle_push": {**self.shuffle_push,

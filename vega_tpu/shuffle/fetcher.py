@@ -69,6 +69,11 @@ _TOTALS = {
     # IN-PROCESS tier (the reducer ran on its owning executor — zero
     # round trips) vs remote `get_merged` round trips actually paid.
     "local_blob_reads": 0, "merged_rtts": 0,
+    # Coded rung (shuffle_coding != none): reconstruction incidents,
+    # buckets decoded from k-1 survivors + parity, and decoded bytes —
+    # the evidence that a lost server was ridden out with zero map
+    # recompute AND zero full-copy replicas.
+    "coded_failovers": 0, "parity_decodes": 0, "decode_bytes": 0,
 }
 
 
@@ -89,7 +94,8 @@ def _bank_totals(stats: dict) -> None:
         for k in ("buckets", "bytes", "round_trips", "net_s", "wait_s",
                   "overlap_s", "wall_s", "duplicates", "failovers",
                   "failover_buckets", "premerged", "local_blob_reads",
-                  "merged_rtts"):
+                  "merged_rtts", "coded_failovers", "parity_decodes",
+                  "decode_bytes"):
             _TOTALS[k] += stats[k]
         if stats["peak_queued"] > _TOTALS["peak_queued"]:
             _TOTALS["peak_queued"] = stats["peak_queued"]
@@ -114,7 +120,11 @@ class ShuffleFetcher:
         recompute (FetchFailedOver). With `fetch_slow_server_s` set, a
         fully-replicated server that stays unresponsive past that
         deadline escalates the same way instead of gating the reduce task
-        on the slowest source. Only when no replica remains are the
+        on the slowest source. Under `shuffle_coding != none` a bucket
+        with no surviving copy — or one parked on a `coded:` pseudo-
+        location by the tracker — is RECONSTRUCTED from its parity
+        group's k-1 surviving buckets plus parity (_reconstruct), still
+        with zero map recompute. Only when no replica remains are the
         locations treated as stale (the liveness reaper unregistered a
         lost executor's outputs and a survivor — or a respawn —
         re-registered them elsewhere): re-resolve them ONCE and refetch
@@ -155,7 +165,9 @@ class ShuffleFetcher:
         stats = {"buckets": 0, "bytes": 0, "round_trips": 0, "net_s": 0.0,
                  "wait_s": 0.0, "peak_queued": 0, "duplicates": 0,
                  "failovers": 0, "failover_buckets": 0, "batched": batched,
-                 "premerged": 0, "local_blob_reads": 0, "merged_rtts": 0}
+                 "premerged": 0, "local_blob_reads": 0, "merged_rtts": 0,
+                 "coded_failovers": 0, "parity_decodes": 0,
+                 "decode_bytes": 0}
         t_start = time.monotonic()
         delivered = set()
         total = len(uri_lists)
@@ -166,6 +178,10 @@ class ShuffleFetcher:
         abandoned = {"flag": False}
         counter_lock = named_lock("shuffle.fetcher.stream_counters")
         resolved_once = False
+        # Coded rung: buckets whose reconstruction attempt already failed
+        # this resolution epoch — never re-attempted until a re-resolve
+        # refreshes the registry (bounds the recovery loop).
+        coded_failed: set = set()
         local_store = env.shuffle_store
 
         def current_uri(map_id: int):
@@ -266,9 +282,14 @@ class ShuffleFetcher:
                         yield data
 
             while True:
-                # -- split undelivered buckets into local vs per-server
+                # -- split undelivered buckets into local vs per-server;
+                # `coded:` pseudo-locations (installed by the tracker when
+                # a lost server's outputs stayed decodable) get no
+                # producer — they are claims on parity, served by the
+                # reconstruction rung after the fetch rounds.
                 local_ids: List[int] = []
                 by_server: dict = {}
+                coded_pending: List[int] = []
                 for map_id in range(total):
                     if map_id in delivered:
                         continue
@@ -277,7 +298,9 @@ class ShuffleFetcher:
                         raise FetchFailedError(
                             None, shuffle_id, map_id, reduce_id,
                             "missing map output location")
-                    if uri == "local" or (
+                    if uri.startswith("coded:"):
+                        coded_pending.append(map_id)
+                    elif uri == "local" or (
                             env.shuffle_server is not None
                             and uri == env.shuffle_server.uri):
                         local_ids.append(map_id)
@@ -452,7 +475,7 @@ class ShuffleFetcher:
                 for t in threads:
                     t.join(timeout=5.0)
 
-                if not failures:
+                if not failures and not coded_pending:
                     break
                 # -- replica failover first (shuffle_replication > 1):
                 # every undelivered bucket whose current location just
@@ -493,7 +516,60 @@ class ShuffleFetcher:
                             except Exception:  # noqa: BLE001 — observability must not break IO
                                 log.debug("failover event emit failed",
                                           exc_info=True)
+                # -- coded reconstruction rung (shuffle_coding != none):
+                # every bucket parked on a `coded:` pseudo-location, plus
+                # every bucket whose last real location just failed with
+                # NO replica behind it, is a reconstruction candidate —
+                # decode it from its parity group's k-1 survivors + parity
+                # instead of burning a stage resubmit. Runs synchronously
+                # on the consumer thread (producers have already joined),
+                # so per-stream stats writes here are race-free.
+                recover = [m for m in coded_pending
+                           if m not in coded_failed and m not in delivered]
+                for map_id in range(total):
+                    if (map_id in delivered or map_id in recover
+                            or map_id in coded_failed):
+                        continue
+                    uri = current_uri(map_id)
+                    if (uri and not uri.startswith("coded:")
+                            and uri in failed_uris
+                            and not replicas_behind(map_id)):
+                        recover.append(map_id)
+                recovered_n = 0
+                if recover:
+                    t_rec = time.monotonic()
+                    recovered, failed_now = _reconstruct(
+                        env, tracker, uri_lists, shuffle_id, reduce_id,
+                        recover, failed_uris, stats)
+                    dt = time.monotonic() - t_rec
+                    # Consumer-blocked like the pre-merged read: lands in
+                    # net_s AND wait_s so it never inflates overlap_s.
+                    stats["net_s"] += dt
+                    stats["wait_s"] += dt
+                    coded_failed.update(failed_now)
+                    if recovered:
+                        stats["coded_failovers"] += 1
+                    for map_id, data in sorted(recovered.items()):
+                        if map_id in delivered:
+                            stats["duplicates"] += 1
+                            continue
+                        delivered.add(map_id)
+                        stats["buckets"] += 1
+                        stats["bytes"] += len(data)
+                        recovered_n += 1
+                        yield data
+                if moved or recovered_n:
                     continue
+                if not failures:
+                    # Only unreconstructable coded buckets remain: the
+                    # ladder's next rung is the typed failure that makes
+                    # the scheduler recompute the producing map outputs.
+                    bad = next(m for m in coded_pending
+                               if m not in delivered)
+                    raise FetchFailedError(
+                        None, shuffle_id, bad, reduce_id,
+                        "coded reconstruction failed and no location "
+                        "serves the bucket")
                 failure = failures[0]
                 if resolved_once:
                     raise failure  # fresher and no less actionable
@@ -553,6 +629,9 @@ class ShuffleFetcher:
                     premerged_buckets=stats["premerged"],
                     local_blob_reads=stats["local_blob_reads"],
                     merged_rtts=stats["merged_rtts"],
+                    coded_failovers=stats["coded_failovers"],
+                    parity_decodes=stats["parity_decodes"],
+                    decode_bytes=stats["decode_bytes"],
                 ))
             except Exception:  # noqa: BLE001 — observability must not break IO
                 log.debug("fetch event emit failed", exc_info=True)
@@ -600,3 +679,165 @@ class ShuffleFetcher:
         for kv in ShuffleFetcher.fetch(shuffle_id, reduce_id):
             merge(out, kv)
         return out
+
+
+def _fetch_survivor(env, uri_lists, shuffle_id: int, map_id: int,
+                    reduce_id: int, failed_uris):
+    """One surviving data bucket for reconstruction: walk the map output's
+    real locations (pseudo-locations and already-failed servers skipped),
+    local tiers in-process, remote over the ordinary `get` path. Returns
+    None when no live copy answers — the bucket then joins the missing set
+    (decodable as long as the group's parity budget covers it)."""
+    from vega_tpu.distributed.shuffle_server import fetch_remote
+
+    own = env.shuffle_server.uri if env.shuffle_server is not None else None
+    for uri in uri_lists[map_id]:
+        if not uri or uri.startswith("coded:") or uri in failed_uris:
+            continue
+        if uri == "local" or uri == own:
+            data = env.shuffle_store.get(shuffle_id, map_id, reduce_id)
+            if data is not None:
+                return data
+            continue
+        try:
+            return fetch_remote(uri, shuffle_id, map_id, reduce_id)
+        except (FetchFailedError, VegaError) as e:
+            log.warning("survivor fetch of shuffle %d map %d from %s "
+                        "failed during reconstruction (%s)", shuffle_id,
+                        map_id, uri, e)
+    return None
+
+
+def _reconstruct(env, tracker, uri_lists, shuffle_id: int, reduce_id: int,
+                 wanted, failed_uris, stats):
+    """The decode half of the coded rung: recover the `wanted` buckets of
+    `reduce_id` from their parity groups — fetch the group's parity units
+    from the parity server and every surviving member's data bucket from
+    its live locations, then solve for the missing ones
+    (coding.decode_group). Frame headers are AUTHORITATIVE for group
+    membership and bucket lengths (the tracker's registry may be stale
+    across failures); the tracker only routes us to (parity_uri, group).
+
+    Returns (recovered: {map_id: bucket_bytes}, failed: set of map_ids
+    that could not be reconstructed this epoch). Recovered buckets may
+    include survivors that had to be fetched anyway and members the
+    caller did not ask for — delivering them is free and rides the same
+    exactly-once dedup. Never raises: every failure mode (no registry, a
+    dead parity server, corrupt/missing frames, an unsolvable system)
+    lands the affected buckets in `failed` so the caller's ladder keeps
+    degrading."""
+    from vega_tpu.shuffle import coding
+
+    wanted = set(wanted)
+    get_map = getattr(tracker, "get_parity_map", None)
+    if get_map is None:
+        return {}, set(wanted)
+    try:
+        pmap = get_map(shuffle_id)
+    except Exception as e:  # noqa: BLE001 — reconstruction must degrade, not raise
+        log.warning("parity map lookup for shuffle %d failed (%s)",
+                    shuffle_id, e)
+        return {}, set(wanted)
+    member_of = {}
+    for key, g in pmap.items():
+        for mid in g["members"]:
+            member_of[mid] = key
+    by_group: dict = {}
+    failed: set = set()
+    for mid in wanted:
+        key = member_of.get(mid)
+        if key is None:
+            # Fall back to the pseudo-location's own routing — it names
+            # the parity server and group directly.
+            for u in uri_lists[mid]:
+                if u and u.startswith("coded:"):
+                    puri, _, gid_s = u[len("coded:"):].rpartition("/")
+                    try:
+                        cand = (puri, int(gid_s))
+                    except ValueError:
+                        continue
+                    if cand in pmap:
+                        key = cand
+                        break
+        if key is None:
+            failed.add(mid)
+        else:
+            by_group.setdefault(key, set()).add(mid)
+
+    from vega_tpu.distributed.shuffle_server import fetch_parity_remote
+    from vega_tpu.errors import NetworkError
+
+    recovered: dict = {}
+    for (puri, gid), missing in by_group.items():
+        g = pmap[(puri, gid)]
+        if puri in failed_uris:
+            failed |= missing  # the parity died with its server
+            continue
+        # All m parity units of this (group, reduce): each is one
+        # independent equation; a corrupt/missing unit just shrinks the
+        # decodable budget.
+        frames = []
+        try:
+            for unit in range(int(g.get("m", 1))):
+                fr = fetch_parity_remote(puri, shuffle_id, gid, unit,
+                                         reduce_id)
+                stats["round_trips"] += 1
+                if fr is not None:
+                    frames.append(fr)
+        except NetworkError as e:
+            log.warning("parity fetch of shuffle %d group %d from %s "
+                        "failed (%s)", shuffle_id, gid, puri, e)
+            failed |= missing
+            continue
+        if not frames:
+            failed |= missing
+            continue
+        # The frame headers are the authoritative membership record — and
+        # joint equations are only sound over IDENTICAL membership. A
+        # rolled-back partial fold can leave one unit lagging the others;
+        # keep the largest consistent subset and let the rest shrink the
+        # decodable budget instead of poisoning the system.
+        by_members: dict = {}
+        for fr in frames:
+            key = tuple(sorted(fr[1]["members"].items()))
+            by_members.setdefault(key, []).append(fr)
+        frames = max(by_members.values(), key=len)
+        fmembers = dict(frames[0][1]["members"])  # {map_id: (idx, length)}
+        scheme = frames[0][1].get("scheme", g.get("scheme", "xor"))
+        k = int(frames[0][1].get("k", g.get("k", 2)))
+        unknown = {m for m in missing if m not in fmembers}
+        failed |= unknown  # never folded: parity knows nothing about them
+        need = missing - unknown
+        if not need:
+            continue
+        survivors: dict = {}
+        for mid in fmembers:
+            if mid in need:
+                continue
+            data = _fetch_survivor(env, uri_lists, shuffle_id, mid,
+                                   reduce_id, failed_uris)
+            stats["round_trips"] += 1
+            if data is None:
+                need.add(mid)  # a lost survivor is one more unknown
+            else:
+                survivors[mid] = data
+        if len(need) > len(frames):
+            failed |= (need & missing)
+            continue
+        try:
+            decoded = coding.decode_group(scheme, k, frames, fmembers,
+                                          survivors, sorted(need))
+        except Exception as e:  # noqa: BLE001 — an unsolvable/corrupt group degrades
+            log.warning("decode of shuffle %d group %d failed (%s)",
+                        shuffle_id, gid, e)
+            failed |= (need & missing)
+            continue
+        stats["parity_decodes"] += len(decoded)
+        stats["decode_bytes"] += sum(len(d) for d in decoded.values())
+        log.info("coded reconstruction: shuffle %d reduce %d group %d "
+                 "decoded %d bucket(s) from %d survivor(s) + %d parity "
+                 "unit(s)", shuffle_id, reduce_id, gid, len(decoded),
+                 len(survivors), len(frames))
+        recovered.update(decoded)
+        recovered.update(survivors)  # fetched anyway; same dedup applies
+    return recovered, failed

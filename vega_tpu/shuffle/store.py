@@ -58,6 +58,17 @@ class ShuffleStore:
         self._memory_budget = memory_budget
         self.spill_count = 0
         self.spilled_bytes = 0
+        # Coded-shuffle parity accounting (fold_parity): frames live in
+        # the ordinary tiers under reserved negative map_ids, so these
+        # counters are pure observability — the equal-storage evidence
+        # benchmarks/straggler_ab.py reads via the `status` healthcheck.
+        self.parity_folds = 0
+        self.parity_bytes = 0
+        # Serializes the read-modify-write parity accumulation per store
+        # (put_parity arrivals from several mappers race on one frame).
+        # Ordering: this lock is taken BEFORE self._lock (via get/put),
+        # never after — keep it that way.
+        self._parity_lock = named_lock("shuffle.store.parity_fold")
         # Set by the Context to LiveListenerBus.post (driver-side store);
         # executor stores keep counters only (visible via `status`).
         self.event_sink = None
@@ -107,6 +118,28 @@ class ShuffleStore:
         than one bucket beyond what the socket buffers hold."""
         for map_id in map_ids:
             yield map_id, self.get(shuffle_id, map_id, reduce_id)
+
+    def fold_parity(self, shuffle_id: int, group_id: int, unit: int,
+                    reduce_id: int, map_id: int, idx: int, scheme: str,
+                    k: int, raw: bytes) -> None:
+        """Accumulate one member bucket into the (group, unit, reduce)
+        parity frame — a locked read-modify-write over the ordinary
+        put/get tiers, keyed under the reserved negative map_id namespace
+        (coding.parity_map_id) so remove_shuffle/spill/status cover
+        parity automatically. Raises ValueError when the stored frame
+        fails validation (the server then refuses the push; the mapper
+        degrades to no parity coverage — never silently-wrong parity)."""
+        from vega_tpu.shuffle import coding
+
+        pkey = coding.parity_map_id(group_id, unit)
+        with self._parity_lock:
+            old = self.get(shuffle_id, pkey, reduce_id)
+            frame = coding.fold_frame(old, scheme, k, unit, map_id, idx,
+                                      raw)
+            self.put(shuffle_id, pkey, reduce_id, frame)
+            with self._lock:
+                self.parity_folds += 1
+                self.parity_bytes += len(frame) - (len(old) if old else 0)
 
     def contains(self, shuffle_id: int, map_id: int, reduce_id: int) -> bool:
         key = (shuffle_id, map_id, reduce_id)
@@ -163,6 +196,10 @@ class ShuffleStore:
             "disk_bytes": disk.used_bytes if disk else 0,
             "spill_count": self.spill_count,
             "spilled_bytes": self.spilled_bytes,
+            # Coded shuffle: resident parity frame bytes/folds (the
+            # sub-k× storage evidence the equal-storage A/B reads).
+            "parity_folds": self.parity_folds,
+            "parity_bytes": self.parity_bytes,
             # Checksum/format failures surfaced as misses: a non-zero count
             # here is disk corruption that was caught, not served.
             "read_errors": disk.read_errors if disk else 0,

@@ -939,3 +939,40 @@ def masked_reduce(col: jax.Array, count: jax.Array, op: str) -> jax.Array:
             lo = jnp.array(jnp.iinfo(col.dtype).min, col.dtype)
         return jnp.max(jnp.where(m, col, lo), axis=0)
     raise ValueError(f"unknown reduction {op}")
+
+
+# ---------------------------------------------------------------------------
+# GF(256) decode kernel (coded shuffle, shuffle/coding.py)
+# ---------------------------------------------------------------------------
+
+
+def gf256_accumulate(blocks, coeffs) -> jax.Array:
+    """XOR-accumulate GF(256)-scaled byte rows: out = XOR_i c_i * B_i.
+
+    The vectorized decode step of the coded shuffle (shuffle/coding.py):
+    `blocks` is uint8[n, L] length-framed byte columns (survivor buckets
+    zero-padded to the frame width), `coeffs` is uint8[n] GF(256)
+    coefficients — all ones for the XOR scheme, Cauchy-matrix entries
+    for rs(k, m). Multiplication is two log-table gathers plus an exp
+    gather with the zero operands masked (log(0) is undefined; a zero
+    factor makes the product zero), so the whole decode is gather/where/
+    xor work the VPU streams. Must stay bit-identical to the numpy twin
+    coding._accumulate_np — test_dense.py asserts host-vs-device parity.
+    """
+    from vega_tpu.shuffle.coding import GF_EXP, GF_LOG
+
+    blocks = jnp.asarray(blocks, dtype=jnp.uint8)
+    coeffs = jnp.asarray(coeffs, dtype=jnp.uint8)
+    exp_t = jnp.asarray(GF_EXP, dtype=jnp.uint8)
+    log_t = jnp.asarray(GF_LOG, dtype=jnp.int32)
+    logs = (jnp.take(log_t, blocks.astype(jnp.int32))
+            + jnp.take(log_t, coeffs.astype(jnp.int32))[:, None])
+    prod = jnp.take(exp_t, logs)
+    prod = jnp.where((blocks == 0) | (coeffs == 0)[:, None],
+                     jnp.uint8(0), prod)
+    out = jnp.zeros(blocks.shape[1], dtype=jnp.uint8)
+    # Group sizes are small (k ≤ 128, typically 4): a static unrolled
+    # XOR chain beats a lax.reduce round trip on every jax version.
+    for i in range(blocks.shape[0]):
+        out = lax.bitwise_xor(out, prod[i])
+    return out
