@@ -36,10 +36,17 @@ is wall <= 1.25x the replica leg while spending <= 0.6x its
 parity included) and `push_bytes` (the workers' redundancy-plane
 counters) land in the one JSON line.
 
+`--coded=SPEC` picks the coding scheme for the coded leg:
+`--coded=xor` (the default, one parity unit) or `--coded=rs(4,2)`
+(GF(256) Reed–Solomon, m=2 parity units — any two losses in a group
+decode, storage 2/k instead of 1/k). The replica leg and the kill
+choreography are identical, so the rs numbers read directly against the
+xor line in BENCH_LEG_HISTORY.
+
 Usage:
 
   python benchmarks/straggler_ab.py [n_map_tasks] [task_work_s]
-  python benchmarks/straggler_ab.py --coded [n_map_tasks] [rows_per_map]
+  python benchmarks/straggler_ab.py --coded[=SPEC] [n_map_tasks] [rows_per_map]
 """
 
 import json
@@ -76,9 +83,10 @@ def _clear_fault_env():
         os.environ.pop(name, None)
 
 
-def _coded_main(argv):
-    """Equal-redundancy A/B (PR 19): replication=2 vs xor parity under a
-    real mid-reduce SIGKILL of one server, on a 5-worker fleet."""
+def _coded_main(argv, spec="xor"):
+    """Equal-redundancy A/B (PR 19): replication=2 vs parity coding
+    (`spec`: xor or rs(k,m)) under a real mid-reduce SIGKILL of one
+    server, on a 5-worker fleet."""
     n_tasks = int(argv[0]) if argv else 16
     rows_per_map = int(argv[1]) if len(argv) > 1 else 2000
     n_red = 4
@@ -89,6 +97,14 @@ def _coded_main(argv):
     from vega_tpu import faults
     from vega_tpu.distributed.shuffle_server import check_status
     from vega_tpu.env import Env
+    from vega_tpu.shuffle import coding
+
+    class _Spec:
+        shuffle_coding = spec
+
+    if coding.spec_from_conf(_Spec()) is None:
+        raise SystemExit(f"unknown coding spec {spec!r} "
+                         "(try --coded=xor or --coded=rs(4,2))")
 
     expected = None
 
@@ -101,7 +117,7 @@ def _coded_main(argv):
         os.environ["VEGA_TPU_FAULT_EXECUTOR"] = victim
         faults.reset()
         kw = dict(shuffle_replication=2) if leg == "replica2" \
-            else dict(shuffle_coding="xor", coding_group_k=4)
+            else dict(shuffle_coding=spec, coding_group_k=4)
         ctx = v.Context("distributed", num_executors=n_workers,
                         heartbeat_interval_s=0.2,
                         executor_liveness_timeout_s=1.5,
@@ -175,8 +191,9 @@ def _coded_main(argv):
     print(json.dumps({
         "metric": "shuffle-job wall + redundancy bytes with one server "
                   "SIGKILLed mid-reduce: shuffle_replication=2 vs "
-                  "shuffle_coding=xor(k=4) on a real 5-worker fleet "
+                  f"shuffle_coding={spec} on a real 5-worker fleet "
                   "(medians of 3, legs interleaved per rep)",
+        "coding": spec,
         "map_tasks": n_tasks,
         "rows_per_map": rows_per_map,
         "replica2_wall_s": round(rep_wall, 3),
@@ -199,8 +216,10 @@ def _coded_main(argv):
 
 
 def main():
-    if len(sys.argv) > 1 and sys.argv[1] == "--coded":
-        _coded_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--coded"):
+        arg = sys.argv[1]
+        spec = arg.split("=", 1)[1] if "=" in arg else "xor"
+        _coded_main(sys.argv[2:], spec=spec)
         return
     n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     work_s = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0

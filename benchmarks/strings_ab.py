@@ -1,0 +1,155 @@
+"""A/B: string-keyed analytics on device dictionary codes vs forced host.
+
+One query — groupBy(string)-sum -> join(dims on string) -> sort(string) —
+over a parquet events table whose key column is a STRING (the workload
+class PR 20 moves on-device: before dictionary encoding, any string
+column demoted the whole plan to the host tier's row pivot). Two legs,
+same logical plan:
+
+  device  defaults: pyarrow dictionary pages feed int32 codes + sidecar
+          straight into the SPMD pipeline; equality/grouping on unified
+          codes, ordering on rank codes, decode only at collect
+  host    hint(tier="host"): the pre-PR-20 path — object-array pivot,
+          per-row Python grouping under the GIL
+
+Legs are interleaved per repetition (shared-sandbox drift hits both
+equally), medians of 3 after one warmup rep per leg (program compiles +
+the source-frame encode memo do NOT carry across reps — every rep pays
+its own encode/pivot). Both legs must be bit-identical (exact string
+keys, int64 sums). The device leg must also compile to the device tier
+with ZERO planner fallbacks — a silent demotion would make the A/B
+measure host-vs-host. Acceptance: device >= 1.5x host on the CPU proxy.
+
+Prints ONE JSON line. Usage:
+
+  python benchmarks/strings_ab.py [rows] [key_space]
+"""
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPS = 3
+
+
+def _median(xs):
+    return statistics.median(xs)
+
+
+def _make_fixture(rows: int, key_space: int):
+    """events parquet: (w string key, x int64 value); dims stays an
+    in-memory frame so the join's right side exercises the
+    cross-dictionary unification path (parquet dict vs create_frame
+    dict are distinct arrays by construction)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tempfile.mkdtemp(prefix="strings_ab_")
+    rng = np.random.default_rng(13)
+    codes = rng.integers(0, key_space, rows)
+    words = np.array([f"sku-{i:06d}" for i in range(key_space)])
+    x = rng.integers(0, 1000, rows).astype(np.int64)
+    events_dir = os.path.join(root, "events")
+    os.makedirs(events_dir)
+    pq.write_table(pa.table({"w": words[codes], "x": x}),
+                   os.path.join(events_dir, "part0.parquet"),
+                   row_group_size=max(1, rows // 8))
+    dim_words = words[:: 2]  # half the keys join
+    dim_z = (np.arange(len(dim_words)) * 37 % 991).astype(np.int64)
+    return root, events_dir, dim_words, dim_z
+
+
+def _canon(rows):
+    return sorted(rows)
+
+
+def run_legs(ctx, rows: int = 300_000, key_space: int = 1024):
+    """Run both legs inside a live Context; returns the result dict
+    (benchmarks/suite.py config 15 calls this)."""
+    import numpy as np
+
+    from vega_tpu.frame import F, planner
+
+    root, events_dir, dim_words, dim_z = _make_fixture(rows, key_space)
+    try:
+        def query():
+            ev = ctx.read_parquet(events_dir)
+            dims = ctx.create_frame(w=dim_words, z=dim_z)
+            return (ev.group_by("w").agg(F.sum("x", "sx"))
+                    .join(dims, on="w")
+                    .sort("w"))
+
+        def device_leg():
+            return query().collect()
+
+        def host_leg():
+            return query().hint(tier="host").collect()
+
+        # The device leg must BE a device leg: compiled tier proven by
+        # explain, zero planner fallbacks across its collects.
+        assert "device tier" in query().explain(), \
+            "string query no longer compiles to the device tier"
+        base_fallbacks = planner.fallback_count()
+
+        canon_dev = _canon(device_leg())   # warmup: compiles + capacities
+        canon_host = _canon(host_leg())
+        if canon_dev != canon_host:
+            raise AssertionError("device and host legs diverged")
+
+        walls = {"device": [], "host": []}
+        for _ in range(REPS):
+            for name, fn in (("device", device_leg), ("host", host_leg)):
+                t0 = time.monotonic()
+                out = fn()
+                walls[name].append(time.monotonic() - t0)
+                del out
+        assert planner.fallback_count() == base_fallbacks, (
+            "device leg silently demoted: "
+            f"{planner.last_fallback()}")
+        dev_s, host_s = _median(walls["device"]), _median(walls["host"])
+        return {
+            "metric": "string-keyed groupBy-sum -> join -> sort over a "
+                      "parquet events table: device dictionary codes vs "
+                      "forced host object pivot (medians of 3, legs "
+                      "interleaved, bit-identical asserted)",
+            "rows": rows,
+            "key_space": key_space,
+            "out_rows": len(canon_dev),
+            "device_s": round(dev_s, 6),
+            "host_s": round(host_s, 6),
+            "device_vs_host": round(host_s / dev_s, 2) if dev_s else None,
+            "accept_1_5x": bool(dev_s and host_s / dev_s >= 1.5),
+            "bit_identical": True,  # asserted above
+            "device_fallbacks": 0,  # asserted above
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    # Importing vega_tpu must never probe a (possibly wedged) TPU
+    # backend: force the CPU mesh first, like every benchmark here.
+    from _cpu_mesh import force_cpu_mesh
+
+    force_cpu_mesh(8)
+
+    import vega_tpu as v
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 300_000
+    key_space = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    ctx = v.Context("local", num_workers=2)
+    try:
+        print(json.dumps(run_legs(ctx, rows, key_space)))
+    finally:
+        ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -522,6 +522,31 @@ def config14_coded(ctx, scale=1.0, bank=None):
     return (n, out["replica2_wall_s"], out["coded_wall_s"])
 
 
+def config15_strings(ctx, scale=1.0, bank=None):
+    """PR 20 device string columns: string-keyed groupBy-sum -> join ->
+    sort over a parquet events table, device dictionary codes vs the
+    forced-host object pivot (benchmarks/strings_ab.py run_legs:
+    interleaved legs, medians of 3, bit-identical + zero planner
+    fallbacks asserted by the A/B itself). Runs IN-PROCESS against the
+    suite Context like config 10. Reported through the standard columns:
+    host_s = forced-host wall, device_s = dictionary-code wall, so
+    device_vs_host reads as the encoding's win (accept >= 1.5x on the
+    CPU proxy). Both legs touch the device planner, so this DOES belong
+    in a TPU window (tpu_jobs/15)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from strings_ab import run_legs
+
+    rows = max(50_000, int(300_000 * scale))
+    out = run_legs(ctx, rows, 1024)
+    assert out["bit_identical"], "string legs diverged"
+    assert out["device_fallbacks"] == 0, "device leg silently demoted"
+    assert out["accept_1_5x"], (
+        f"device leg only {out['device_vs_host']}x the host leg")
+    if bank:
+        bank(rows, out["device_s"])
+    return rows, out["host_s"], out["device_s"]
+
+
 CONFIGS = {
     1: ("group_by (i64,f64)", config1_group_by),
     2: ("inner join", config2_join),
@@ -545,6 +570,8 @@ CONFIGS = {
          "(batch p50 + exactly-once + bounded queue)", config13_streaming),
     14: ("coded shuffle equal-redundancy A/B, replication=2 vs xor "
          "parity under mid-reduce server kill", config14_coded),
+    15: ("string-keyed groupBy-join-sort, device dictionary codes vs "
+         "forced host pivot", config15_strings),
 }
 
 

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Device string columns A/B (PR 20) on the real chip: the CPU proxy shows
+# dictionary codes ~12x the forced-host object pivot on the string-keyed
+# groupBy-join-sort query, but the host leg is GIL-bound there — the chip
+# question is the DEVICE leg's absolute wall (encode + code-domain
+# exchange + rank-code sort as real TPU programs, decode only at collect)
+# and that the unification remap stays one gather. Bit-identical + zero
+# planner fallbacks asserted by the A/B itself. One JSON line.
+cd /root/repo
+exec python benchmarks/strings_ab.py 1000000 4096

@@ -1046,6 +1046,126 @@ def test_parity_server_sigkilled_midstream_reconstructs(monkeypatch,
         ctx.stop()
 
 
+def test_rs_two_servers_of_one_group_sigkilled_reconstructs(monkeypatch,
+                                                            tmp_path):
+    """PR 20 satellite (rs double-loss): SIGKILL TWO member servers of
+    ONE parity group mid-reduce on a 5-worker fleet under
+    shuffle_coding=rs(4,2) and NO replication. Origin-exclusivity caps a
+    group's losses at one per dead server, and m=2 Reed–Solomon units
+    decode any two missing members — so both dead servers' buckets in
+    the shared group must come back through one GF(256) solve:
+    bit-identical results, zero stage resubmission (zero map recompute),
+    zero full-replica fetches.
+
+    The victim pair is chosen from the driver tracker's parity registry
+    AFTER every map output lands: two origins that co-occur in one group
+    and hold no parity for each other (a group hosted on a dead server
+    decodes nothing). Parity fan-out is round-robin over live peers with
+    arbitrary port order, so a given deal may lack such a pair — those
+    deals are redealt with a fresh fleet (bounded attempts) rather than
+    asserted against."""
+    from vega_tpu.env import Env
+
+    # Every server serves slowly (no FAULT_EXECUTOR scope): whichever
+    # pair the registry search picks, reducers are parked mid-stream
+    # against it when the kills land. The delay must exceed the
+    # registration-to-kill window — fetches run in parallel, so a short
+    # delay lets every get complete before the kill.
+    monkeypatch.setenv("VEGA_TPU_FAULT_FETCH_DELAY_S", "0.8")
+    monkeypatch.setenv("VEGA_TPU_FAULT_STATS_DIR", str(tmp_path / "stats"))
+
+    def _find_victims(tracker, sid, uri2exec):
+        """(exec_a, exec_b) co-members of one parity group whose loss
+        keeps every map output decodable, or None for this deal."""
+        with tracker._lock:
+            origins = [lst[0] if lst else None
+                       for lst in tracker._outputs.get(sid, [])]
+        parity = tracker.get_parity_map(sid)
+        hosts = {}    # origin uri -> {parity-holder uris of its groups}
+        covered = {}  # origin uri -> {map_ids with parity coverage}
+        for (puri, _gid), g in parity.items():
+            for mid in g["members"]:
+                o = origins[mid] if 0 <= mid < len(origins) else None
+                if o is None:
+                    continue
+                hosts.setdefault(o, set()).add(puri)
+                covered.setdefault(o, set()).add(mid)
+        full = {o for o in hosts
+                if covered[o] == {m for m, oo in enumerate(origins)
+                                  if oo == o}}
+        for (puri, _gid), g in parity.items():
+            members = sorted(g["members"])
+            group_origins = {origins[mid] for mid in members
+                            if 0 <= mid < len(origins)}
+            for a in sorted(group_origins):
+                for b in sorted(group_origins):
+                    if (a < b and a in full and b in full
+                            and b not in hosts[a] and a not in hosts[b]
+                            and a in uri2exec and b in uri2exec
+                            and puri not in (a, b)):
+                        return uri2exec[a], uri2exec[b]
+        return None
+
+    expected = {}
+    for i in range(180):
+        expected[i % 5] = expected.get(i % 5, 0) + i
+    expected = sorted(expected.items())
+
+    for attempt in range(4):
+        faults.reset()
+        ctx = _chaos_context(num_executors=5, shuffle_coding="rs(4,2)")
+        try:
+            pairs = ctx.parallelize([(i % 5, i) for i in range(180)], 6)
+            future = pairs.reduce_by_key(lambda a, b: a + b, 4) \
+                .collect_async()
+            # Wait for EVERY map output (and its preceding parity fold)
+            # to register: the victim search needs the complete registry,
+            # and killing mid-map would muddy the zero-recompute assert.
+            tracker = Env.get().map_output_tracker
+            deadline = time.time() + 30.0
+            sid = None
+            while time.time() < deadline:
+                outs = getattr(tracker, "_outputs", {})
+                done = [s for s, locs in outs.items()
+                        if locs and all(locs)]
+                if done:
+                    sid = done[0]
+                    break
+                time.sleep(0.05)
+            if sid is None:
+                pytest.fail("map outputs never registered")
+            uri2exec = {
+                info.get("shuffle_uri"): wid
+                for wid, info in ctx._backend.service.live_workers().items()
+                if info.get("shuffle_uri")}
+            victims = _find_victims(tracker, sid, uri2exec)
+            if victims is None:
+                # This deal's round-robin landed without a safe
+                # co-member pair — redeal with a fresh fleet.
+                future.result(120.0)
+                continue
+            time.sleep(0.3)  # reducers are parked on the slow serves
+            for eid in victims:  # both kills land in the same window
+                ctx._backend._executors[eid].process.kill()
+            got = sorted(future.result(120.0))
+            assert got == expected  # bit-identical through the double loss
+            assert _wait_metric(ctx, "executors_lost", 2), \
+                "killed workers were never declared lost"
+            assert _coded_failovers(ctx._backend) >= 1, \
+                "no reducer rode the coded reconstruction rung"
+            summary = ctx.metrics_summary()
+            # Zero map recompute: rs(4,2) parity decoded both losses.
+            assert summary["stages_resubmitted"] == 0
+            # Replication is off — the coded rung was the only plane.
+            assert all(s["fetch"].get("failovers", 0) == 0
+                       for s in ctx._backend.worker_stats().values())
+            return
+        finally:
+            ctx.stop()
+    pytest.fail("no deal produced a safe two-victim parity pair in "
+                "4 attempts")
+
+
 def test_corrupt_parity_degrades_ladder_bit_identical(monkeypatch,
                                                       tmp_path):
     """Satellite: VEGA_TPU_FAULT_PARITY_CORRUPT_N flips a byte in the

@@ -2356,3 +2356,159 @@ def test_gf256_accumulate_host_device_parity():
             assert np.array_equal(
                 coding.accumulate(blocks, coeffs, prefer_device=False),
                 host)
+
+
+# ---------------------------------------------------------------- PR 20:
+# device string columns — dictionary-encoded int32 codes + sidecar, with
+# the host tier as the parity oracle for every op the encoding unlocks.
+
+
+def _string_pairs(seed=0, n=600, nkeys=29):
+    rng = np.random.RandomState(seed)
+    keys = np.array([f"w{i:02d}" for i in rng.randint(0, nkeys, size=n)])
+    vals = rng.randint(-100, 100, size=n).astype(np.int32)
+    return keys, vals
+
+
+def _lineage_nodes(rdd):
+    """Every node reachable through parent/left/right links."""
+    seen, todo = [], [rdd]
+    while todo:
+        node = todo.pop()
+        if any(node is s for s in seen):
+            continue
+        seen.append(node)
+        for attr in ("parent", "left", "right"):
+            child = getattr(node, attr, None)
+            if child is not None:
+                todo.append(child)
+    return seen
+
+
+def test_dense_string_reduce_group_count_parity(dctx):
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    keys, vals = _string_pairs()
+    dev = dctx.dense_from_numpy(keys, vals)
+    host = dctx.parallelize(list(zip(keys.tolist(), vals.tolist())), 4)
+
+    red = dev.reduce_by_key(lambda a, b: a + b)
+    assert isinstance(red, DenseRDD)  # string keys must not fall back
+    assert dict(red.collect()) == dict(
+        host.reduce_by_key(lambda a, b: a + b, 4).collect())
+
+    # Named min/max run on RANK codes (sorted dictionary), so the device
+    # winner-by-code is the winner-by-string.
+    for op, fn in (("min", min), ("max", max)):
+        assert dict(dev.reduce_by_key(op=op).collect()) == dict(
+            host.reduce_by_key(fn, 4).collect())
+
+    dg = {k: sorted(vs) for k, vs in dev.group_by_key().collect()}
+    hg = {k: sorted(vs) for k, vs in host.group_by_key(4).collect()}
+    assert dg == hg
+
+    assert dev.count_by_key() == host.count_by_key()
+
+
+def test_dense_string_sort_distinct_topk_parity(dctx):
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    keys, vals = _string_pairs(seed=3)
+    dev = dctx.dense_from_numpy(keys, vals)
+    host = dctx.parallelize(list(zip(keys.tolist(), vals.tolist())), 4)
+
+    srt = dev.sort_by_key()
+    assert isinstance(srt, DenseRDD)
+    assert [k for k, _ in srt.collect()] == sorted(keys.tolist())
+    desc = dev.sort_by_key(ascending=False).collect()
+    assert [k for k, _ in desc] == sorted(keys.tolist(), reverse=True)
+
+    assert sorted(dev.distinct().collect()) == sorted(host.distinct().collect())
+
+    # Single string column: distinct + count_by_value on codes.
+    col = dctx.dense_from_numpy(keys)
+    assert sorted(col.distinct().collect()) == sorted(set(keys.tolist()))
+    assert col.count_by_value() == \
+        dctx.parallelize(keys.tolist(), 4).count_by_value()
+
+    assert dev.take_ordered(7) == sorted(zip(keys.tolist(), vals.tolist()))[:7]
+    assert dev.top(5) == sorted(zip(keys.tolist(), vals.tolist()),
+                                reverse=True)[:5]
+
+
+def test_dense_string_join_cross_dict_parity(dctx):
+    """Two sides built from DIFFERENT key sets carry different
+    dictionaries: the join must unify them (host merge + device remap)
+    and match the host result exactly, with zero capacity retries at the
+    default dense_dict_capacity."""
+    from vega_tpu.tpu.dense_rdd import _DictUnifyRDD, DenseRDD
+
+    rng = np.random.RandomState(11)
+    lk = np.array([f"k{i:02d}" for i in rng.randint(0, 40, size=300)])
+    lv = rng.randint(0, 1000, size=300).astype(np.int32)
+    rk = np.array([f"k{i:02d}" for i in range(20, 60)])
+    rv = np.arange(40).astype(np.int32)
+
+    j = dctx.dense_from_numpy(lk, lv).join(dctx.dense_from_numpy(rk, rv))
+    assert isinstance(j, DenseRDD)
+    unify = [n for n in _lineage_nodes(j) if isinstance(n, _DictUnifyRDD)]
+    assert unify, "cross-dictionary join never planned a unification"
+    dev = sorted(j.collect())
+    host = sorted(
+        dctx.parallelize(list(zip(lk.tolist(), lv.tolist())), 4)
+        .join(dctx.parallelize(list(zip(rk.tolist(), rv.tolist())), 2))
+        .collect())
+    assert dev == host
+    assert all(n._dict_retries == 0 for n in unify)
+
+
+def test_dense_string_dict_overflow_grows_capacity():
+    """dense_dict_capacity=2 (staged at the 128-entry floor) cannot hold
+    a 300-entry merged dictionary: the remap program's overflow flag must
+    drive capacity-doubling retries (the standard device contract) and
+    still produce the exact host-tier join."""
+    from vega_tpu.tpu.dense_rdd import _DictUnifyRDD
+
+    ctx = v.Context("local", num_workers=2, dense_dict_capacity=2)
+    try:
+        lk = np.array([f"k{i:03d}" for i in range(200)])
+        lv = np.arange(200).astype(np.int32)
+        rk = np.array([f"k{i:03d}" for i in range(100, 300)])
+        rv = (np.arange(200) * 7).astype(np.int32)
+        j = ctx.dense_from_numpy(lk, lv).join(ctx.dense_from_numpy(rk, rv))
+        dev = sorted(j.collect())
+        host = sorted(
+            ctx.parallelize(list(zip(lk.tolist(), lv.tolist())), 4)
+            .join(ctx.parallelize(list(zip(rk.tolist(), rv.tolist())), 2))
+            .collect())
+        assert dev == host
+        unify = [n for n in _lineage_nodes(j)
+                 if isinstance(n, _DictUnifyRDD)]
+        assert unify and any(n._dict_retries >= 1 for n in unify), \
+            "tiny dictionary capacity never exercised the retry path"
+    finally:
+        ctx.stop()
+
+
+def test_rdd_dense_lifts_scalars_pairs_and_degrades(dctx):
+    """RDD.dense(): int64 scalars take the (name, name.lo) wide encoding
+    instead of degrading; string pairs dictionary-encode; mixed-object
+    rows stay on the host tier silently; DenseRDD.dense() is identity."""
+    from vega_tpu.tpu.dense_rdd import DenseRDD
+
+    big = [2**40 + 3, -(2**35), 17, 2**33]
+    d = dctx.parallelize(big, 2).dense()
+    assert isinstance(d, DenseRDD)
+    assert sorted(d.collect()) == sorted(big)
+    assert d.sum() == sum(big)
+    assert d.max() == max(big)
+
+    p = dctx.parallelize([("b", 2), ("a", 1), ("b", 3)], 2).dense()
+    assert isinstance(p, DenseRDD)
+    assert sorted(p.reduce_by_key(lambda a, b: a + b).collect()) == \
+        [("a", 1), ("b", 5)]
+    assert p.dense() is p
+
+    mixed = dctx.parallelize([1, "x", None], 2).dense()
+    assert not isinstance(mixed, DenseRDD)
+    assert sorted(mixed.collect(), key=repr) == ["x", 1, None]

@@ -111,6 +111,39 @@ def test_dense_rdd_crosses_process_boundary(dist_ctx):
     assert cg[2][1] == ["h2"]
 
 
+def test_dense_string_ops_cross_process_boundary(dist_ctx):
+    """PR 20 string columns in distributed mode: device reduce/join run
+    on dictionary codes, decode happens at the collect boundary, and
+    host-tier tasks in REAL worker processes consume the decoded strings
+    (codes and their sidecar must never leak across the task protocol)."""
+    import numpy as np
+
+    keys = np.array([f"w{i % 11:02d}" for i in range(400)])
+    vals = np.arange(400).astype(np.int32)
+    exp = {}
+    for k, x in zip(keys.tolist(), vals.tolist()):
+        exp[k] = exp.get(k, 0) + x
+
+    red = dist_ctx.dense_from_numpy(keys, vals) \
+        .reduce_by_key(lambda a, b: a + b)
+    assert dict(red.collect()) == exp
+
+    # Host-tier continuation across worker processes sees strings.
+    got = dict(red.to_rdd().map_values(lambda x: x * 2)
+               .reduce_by_key(lambda a, b: a + b, 3).collect())
+    assert got == {k: 2 * s for k, s in exp.items()}
+
+    # Cross-dictionary device join, host oracle over the same fleet.
+    dk = np.array([f"w{i:02d}" for i in range(5, 16)])
+    dv = np.arange(11).astype(np.int32)
+    j = sorted(red.join(dist_ctx.dense_from_numpy(dk, dv)).collect())
+    hostj = sorted(
+        dist_ctx.parallelize(list(exp.items()), 3)
+        .join(dist_ctx.parallelize(list(zip(dk.tolist(), dv.tolist())), 2))
+        .collect())
+    assert j == hostj
+
+
 def test_batched_vs_per_bucket_fetch_parity(dist_ctx):
     """The batched get_many pipeline and the legacy per-bucket protocol
     return byte-identical bucket sets over REAL cross-process sockets —

@@ -188,29 +188,47 @@ def test_tier_device_forced_raises_on_untraceable(ctx):
 
 
 def test_object_dtype_source_falls_back_silently(ctx):
+    # A GENUINELY mixed object column has no device form; an all-string
+    # object column does (dictionary encoding) and is covered below.
+    df = ctx.create_frame(k=np.array([1, 2, 1]),
+                          s=np.array(["a", 2, None], dtype=object))
+    q = df.filter(col("k") == 1).select("s")
+    assert "host tier" in q.explain()
+    assert sorted(q.collect(), key=repr) == [("a",), (None,)]
+
+
+def test_all_string_object_column_devices(ctx):
+    # Object columns whose every element is a str dictionary-encode onto
+    # the device tier (the pandas/pyarrow pivot shape).
     df = ctx.create_frame(k=np.array([1, 2, 1]),
                           s=np.array(["a", "b", "c"], dtype=object))
     q = df.filter(col("k") == 1).select("s")
-    assert "host tier" in q.explain()
+    assert "device tier" in q.explain()
     assert sorted(q.collect()) == [("a",), ("c",)]
 
 
-def test_string_group_key_and_join_on_host_tier(ctx):
-    # Object columns through the PIVOTING host paths (group-agg keys,
-    # row pivots for join/sort/to_rdd) — must serve, never crash.
+def test_string_group_key_and_join_compile_to_device(ctx):
+    # String group keys / join keys / sort keys ride dictionary codes on
+    # the device tier now (PR 20) — same rows as the host path, and the
+    # fallback counter proves no silent demotion happened.
+    from vega_tpu.frame import planner
+
     names = np.array(["ada", "bob", "ada", "cy", "bob", "ada"],
                      dtype=object)
     df = ctx.create_frame(name=names, x=np.arange(6))
     g = df.group_by("name").agg(F.sum("x", "sx"), F.count("n")).sort("name")
-    assert "host tier" in g.explain()
+    base = planner.fallback_count()
+    assert "device tier" in g.explain()
     assert g.collect() == [("ada", 0 + 2 + 5, 3), ("bob", 1 + 4, 2),
                            ("cy", 3, 1)]
     assert g.count() == 3
+    assert planner.fallback_count() == base
     rows = sorted(df.select("name", "x").to_rdd().collect())
     assert rows[0] == ("ada", 0)
     dims = ctx.create_frame(name=np.array(["ada", "cy"], dtype=object),
                             w=np.array([10, 20]))
     j = g.select("name", "sx").join(dims, on="name").sort("name")
+    assert "device tier" in j.explain()
     assert j.collect() == [("ada", 7, 10), ("cy", 3, 20)]
 
 
@@ -350,6 +368,75 @@ def test_read_parquet_columns_wrapper(ctx, parquet_dir):
     # parquet_file keeps returning the raw block RDD.
     blocks = ctx.parquet_file(parquet_dir, columns=["c0"]).collect()
     assert all(sorted(b) == ["c0"] for b in blocks)
+
+
+def test_parquet_string_group_join_sort_on_device(ctx, tmp_path):
+    """PR 20: parquet string columns ride pyarrow dictionary pages
+    (codes + dictionary, no object-array pivot) onto the device tier —
+    group/agg, sort, and join on the string key compile to device with
+    host-tier parity."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 300
+    words = [f"w{i % 7:02d}" for i in range(n)]
+    pq.write_table(pa.table({"w": words, "x": np.arange(n)}),
+                   str(tmp_path / "p.parquet"), row_group_size=64)
+    q = (ctx.read_parquet(str(tmp_path)).group_by("w")
+         .agg(F.sum("x", "sx"), F.count("cnt")).sort("w"))
+    assert "device tier" in q.explain()
+    rows = _parity(q)
+    assert [r[0] for r in rows] == sorted(set(words))
+    exp = {}
+    for w, x in zip(words, range(n)):
+        exp[w] = exp.get(w, 0) + x
+    assert {r[0]: r[1] for r in rows} == exp
+
+    dims = ctx.create_frame(w=np.array([f"w{i:02d}" for i in range(3, 10)],
+                                       dtype=object),
+                            z=np.arange(7))
+    j = (ctx.read_parquet(str(tmp_path)).group_by("w")
+         .agg(F.sum("x", "sx")).join(dims, on="w").sort("w"))
+    assert "device tier" in j.explain()
+    _parity(j)
+
+
+def test_parquet_string_nulls_fall_back_correctly(ctx, tmp_path):
+    """A nullable string column has no code slot for null — the reader's
+    row-group null statistics gate it to the host tier, which preserves
+    None exactly."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(
+        pa.table({"w": ["a", None, "b", "a"], "x": [1, 2, 3, 4]}),
+        str(tmp_path / "p.parquet"))
+    q = ctx.read_parquet(str(tmp_path)).select("w", "x")
+    assert "host tier" in q.explain()
+    assert sorted(q.collect(), key=repr) == sorted(
+        [("a", 1), (None, 2), ("b", 3), ("a", 4)], key=repr)
+
+
+def test_frame_string_sort_parity_and_filter_fallback(ctx):
+    """Dedicated string-sort leg (rank codes ARE sort order), plus the
+    counted fallback for a string-literal filter — comparisons compute
+    on codes, so the planner must demote them, visibly."""
+    from vega_tpu.frame import planner
+
+    names = np.array(["pear", "apple", "fig", "apple", "date"],
+                     dtype=object)
+    df = ctx.create_frame(name=names, x=np.arange(5))
+    q = df.select("name", "x").sort("name")
+    assert "device tier" in q.explain()
+    rows = _parity(q)
+    assert [r[0] for r in rows] == sorted(names.tolist())
+
+    base = planner.fallback_count()
+    f = df.filter(col("name") == lit("apple")).select("x")
+    assert "host tier" in f.explain()
+    assert sorted(f.collect()) == [(1,), (3,)]
+    assert planner.fallback_count() > base
+    assert "string" in (planner.last_fallback() or "")
 
 
 def test_parquet_dir_without_parquet_files_raises_crisply(ctx, tmp_path):

@@ -981,6 +981,52 @@ def test_vg012_covers_streaming_receivers(tmp_path):
     assert _rules(res) == ["VG012"]
 
 
+# ---------------------------------------------------------------- VG020
+def test_vg020_fires_on_object_dtype_in_device_tier(tmp_path):
+    res = _lint(tmp_path, "vega_tpu/tpu/badcol.py", """\
+        import numpy as np
+
+        def build(xs, col):
+            a = np.array(xs, dtype=object)
+            b = np.empty(len(xs), np.object_)
+            c = col.astype("O")
+            d = np.full((3,), 0, dtype="object")
+            ufn = np.frompyfunc(str, 1, 1)
+            return a, b, c, d, ufn
+        """, select=["VG020"])
+    assert _rules(res) == ["VG020"] * 5
+    assert "dictionary codes" in res.findings[0].message
+
+
+def test_vg020_silent_on_clean_dtypes_dict_encoding_and_host_tier(tmp_path):
+    clean = _lint(tmp_path, "vega_tpu/tpu/goodcol.py", """\
+        import numpy as np
+
+        def build(xs, col):
+            a = np.array(xs, dtype=np.int32)
+            b = col.astype(np.int64)
+            c = np.full((3,), "O")  # fill VALUE, not a dtype
+            return a, b, c
+        """, select=["VG020"])
+    assert not clean.findings
+    # dict_encoding.py is the sanctioned host-side consumer of object
+    # arrays — exempt; so is anything outside vega_tpu/tpu/.
+    exempt = _lint(tmp_path, "vega_tpu/tpu/dict_encoding.py", """\
+        import numpy as np
+
+        def normalize(src):
+            return src.astype(object)
+        """, select=["VG020"])
+    assert not exempt.findings
+    host = _lint(tmp_path, "vega_tpu/rdd/rows.py", """\
+        import numpy as np
+
+        def pivot(rows):
+            return np.array(rows, dtype=object)
+        """, select=["VG020"])
+    assert not host.findings
+
+
 # ---------------------------- mutation self-tests against the real tree
 import os as _os
 import shutil as _shutil

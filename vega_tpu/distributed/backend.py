@@ -217,6 +217,16 @@ class DistributedBackend(TaskBackend):
                 getattr(conf, "shuffle_coding", "none")),
             "VEGA_TPU_CODING_GROUP_K": str(conf.coding_group_k),
             "VEGA_TPU_CODING_PARITY_M": str(conf.coding_parity_m),
+            # Device-tier string columns: a worker that rebuilds a dense
+            # source from shipped host rows (host->dense round trips in
+            # executor closures) must agree with the driver on whether
+            # strings dictionary-encode and at what starting table
+            # capacity — a mismatch would flip a worker onto the host
+            # path the driver planned on device.
+            "VEGA_TPU_DENSE_DICT_ENABLED":
+                "1" if getattr(conf, "dense_dict_enabled", True) else "0",
+            "VEGA_TPU_DENSE_DICT_CAPACITY": str(
+                getattr(conf, "dense_dict_capacity", 65536)),
             # Push plan: map tasks push buckets to their reducer's
             # owning server; reducers read the pre-merged blob first.
             "VEGA_TPU_SHUFFLE_PLAN": str(

@@ -316,6 +316,22 @@ class Configuration:
     # headline bench must not gamble on an unmeasured plan. "on"/"off"
     # force it per run (the A/B job sets "on").
     dense_table_plan: str = "auto"
+    # --- device-tier string columns (tpu/dict_encoding.py) ---
+    # Master switch for dictionary-encoded string columns on the device
+    # tier: string columns become int32 code columns plus a sorted
+    # dictionary sidecar on the Block (codes ARE rank codes, so order
+    # ops need no extra pass), unified across blocks before keyed binary
+    # ops and decoded only at the collect boundary. False keeps the
+    # pre-PR-20 behavior — string data raises at the block boundary and
+    # the caller degrades to the host tier (the forced-host leg of
+    # benchmarks/strings_ab.py sets this).
+    dense_dict_enabled: bool = True
+    # Starting capacity (entries) of the padded dictionary tables staged
+    # into the cross-block unification remap program. A REAL capacity,
+    # same contract as exchange capacities: a code at or past the staged
+    # table sets the device overflow flag and the driver retries with
+    # doubled capacity (tests shrink this to exercise the retry path).
+    dense_dict_capacity: int = 65536
     # --- micro-batch streaming (vega_tpu/streaming/) ---
     # Discretization interval: how often the streaming context snapshots
     # receiver blocks into one micro-batch and submits its output jobs.
@@ -375,7 +391,8 @@ class Configuration:
                      "NUM_EXECUTORS",
                      "CACHE_CAPACITY_BYTES", "MAX_FAILURES",
                      "DENSE_HBM_BUDGET", "SHUFFLE_MEMORY_BUDGET",
-                     "SHUFFLE_SPILL_THRESHOLD", "EXECUTOR_MAX_RESTARTS",
+                     "SHUFFLE_SPILL_THRESHOLD", "DENSE_DICT_CAPACITY",
+                     "EXECUTOR_MAX_RESTARTS",
                      "EXECUTOR_BLACKLIST_THRESHOLD", "FETCH_RETRIES",
                      "FETCH_QUEUE_BUCKETS", "TASK_BINARY_CACHE_ENTRIES",
                      "SHUFFLE_REPLICATION", "CODING_GROUP_K",
@@ -387,7 +404,8 @@ class Configuration:
                 setattr(cfg, name.lower(), int(env[pref + name]))
         for name in ("LOG_CLEANUP", "SLAVE_DEPLOYMENT", "SERIALIZE_TASKS_LOCALLY",
                      "SPECULATION_ENABLED", "FETCH_BATCH_ENABLED",
-                     "TASK_BINARY_DEDUP", "ELASTIC_ENABLED"):
+                     "TASK_BINARY_DEDUP", "ELASTIC_ENABLED",
+                     "DENSE_DICT_ENABLED"):
             if env.get(pref + name):
                 setattr(cfg, name.lower(), env[pref + name].lower() in ("1", "true"))
         for name in ("RESUBMIT_TIMEOUT_S", "POLL_TIMEOUT_S",

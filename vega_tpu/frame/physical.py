@@ -33,7 +33,13 @@ class HostFallback(Exception):
 
 
 def coerce_dtype(np_dtype) -> str:
-    """numpy dtype -> device dtype name, or raise HostFallback."""
+    """numpy dtype -> device dtype name, or raise HostFallback.
+
+    Unicode/bytes dtypes return "dict32": the column lowers to an int32
+    dictionary CODE column plus a Block.dicts sidecar (tpu/dict_encoding);
+    object dtypes stay host-only at the dtype level — whether an object
+    column is all-strings needs a value scan, which only the
+    sources (coerced_dtype / make_*_source) may pay."""
     dt = np.dtype(np_dtype)
     if dt == np.bool_:
         return "int32"
@@ -43,22 +49,45 @@ def coerce_dtype(np_dtype) -> str:
         return "int64?"  # needs a value-range check (fits-int32 proof)
     if dt.kind == "f":
         return "float32"
+    if dt.kind in ("U", "S"):
+        from vega_tpu.tpu import dict_encoding
+
+        if dict_encoding.dict_enabled():
+            return "dict32"
+        raise HostFallback(
+            f"string column (dtype {dt}) with dense_dict_enabled off")
     raise HostFallback(f"dtype {dt} has no device column form")
 
 
-def coerced_dtype(name: str, col: np.ndarray) -> np.dtype:
-    """Device dtype one host column will coerce to — CHECK only (dtype
-    kind + the int64 range proof), no copy; the astype itself runs at
-    materialization. Raises HostFallback when the host tier must serve."""
+def coerced_dtype(name: str, col: np.ndarray) -> Tuple[np.dtype, bool]:
+    """(device dtype one host column will coerce to, is_dictionary) —
+    CHECK only (dtype kind + the int64 range proof + the all-str object
+    scan), no copy; the astype/encode itself runs at materialization.
+    Raises HostFallback when the host tier must serve."""
+    from vega_tpu.tpu import dict_encoding
+
     col = np.asarray(col)
+    if col.dtype.kind == "O":
+        # Object columns are host-only UNLESS every element is a str
+        # (the pandas pivot shape) — a full scan, but the same class of
+        # compile-time value check as the int64 range proof below.
+        if dict_encoding.is_string_array(col):
+            if dict_encoding.dict_enabled():
+                return np.dtype(np.int32), True
+            raise HostFallback(
+                f"string column {name!r} with dense_dict_enabled off")
+        raise HostFallback(
+            f"column {name!r} (object dtype) has no device column form")
     kind = coerce_dtype(col.dtype)
+    if kind == "dict32":
+        return np.dtype(np.int32), True
     if kind == "int64?":
         info = np.iinfo(np.int32)
         if len(col) and (col.min() < info.min or col.max() > info.max):
             raise HostFallback(
                 f"column {name!r} holds int64 values beyond int32 range")
         kind = "int32"
-    return np.dtype(kind)
+    return np.dtype(kind), False
 
 
 # ---------------------------------------------------------------------------
@@ -84,26 +113,56 @@ def make_columns_source(ctx, data: Dict[str, np.ndarray],
     # needs exactly that); the astype copies run at materialization, so
     # explain() and plan construction stay O(metadata) and the closure
     # pins no second copy of the data.
-    dtypes = {bn: coerced_dtype(fn, data[fn]) for fn, bn in names}
+    dtypes = {}
+    dict_bns = set()   # block names that are dictionary (string) columns
+    dict_fns = set()   # same set, frame-name side (planner gates)
+    for fn, bn in names:
+        dtypes[bn], is_dict = coerced_dtype(fn, data[fn])
+        if is_dict:
+            dict_bns.add(bn)
+            dict_fns.add(fn)
     name_pairs = list(names)
+    enc_memo: Dict[str, tuple] = {}  # bn -> (codes, sorted values)
+
+    def _encoded(fn: str, bn: str):
+        # One encode total, shared between _dicts() (graph-build gates /
+        # unification need the dictionaries) and _materialize.
+        if bn not in enc_memo:
+            from vega_tpu.tpu import dict_encoding
+
+            enc_memo[bn] = dict_encoding.encode_array(np.asarray(data[fn]))
+        return enc_memo[bn]
 
     class _ColumnsDenseSource(DenseRDD):
+        _frame_dict_cols = frozenset(dict_fns)
+
         def _schema(self):
             return tuple((bn, jnp.dtype(dtypes[bn]))
                          for _fn, bn in name_pairs)
 
         def _fp_extra(self):
-            return tuple((bn, str(dtypes[bn]), len(data[fn]))
+            return tuple((bn, str(dtypes[bn]), bn in dict_bns,
+                          len(data[fn]))
                          for fn, bn in name_pairs)
+
+        def _dicts(self):
+            return {bn: _encoded(fn, bn)[1]
+                    for fn, bn in name_pairs if bn in dict_bns}
 
         def _materialize(self):
             from vega_tpu.tpu import block as block_lib
 
-            cols = {bn: np.asarray(data[fn]).astype(dtypes[bn],
-                                                    copy=False)
-                    for fn, bn in name_pairs}
+            cols = {}
+            dicts = {}
+            for fn, bn in name_pairs:
+                if bn in dict_bns:
+                    cols[bn], dicts[bn] = _encoded(fn, bn)
+                else:
+                    cols[bn] = np.asarray(data[fn]).astype(dtypes[bn],
+                                                           copy=False)
             return block_lib.from_numpy(cols, self.mesh,
-                                        wide_values=False)
+                                        wide_values=False,
+                                        dicts=dicts or None)
 
         def unpersist(self):
             return self  # source: host copy IS the data; nothing to free
@@ -120,15 +179,53 @@ def make_parquet_source(ctx, path: str, columns: List[str],
     materialization."""
     from vega_tpu.io.readers import (discover_parquet_files,
                                      iter_parquet_batches,
-                                     parquet_column_minmax)
+                                     parquet_column_minmax,
+                                     parquet_column_nulls,
+                                     parquet_string_columns)
     from vega_tpu.tpu import mesh as mesh_lib
     from vega_tpu.tpu.dense_rdd import DenseRDD
 
     import jax.numpy as jnp
 
+    string_cols = parquet_string_columns(path)
+    for nm, _op, _lit in predicate:
+        if nm in string_cols:
+            # A pushed-down conjunct evaluates as a numpy mask inside the
+            # reader; there is no device-side literal-encode yet, so a
+            # string predicate keeps the whole scan on the host tier.
+            raise HostFallback(
+                f"pushed-down predicate on string column {nm!r} — "
+                "host tier filters it")
     out_dtypes = {}
+    dict_bns = set()
+    dict_fns = set()
     for fn, bn in names:
+        if fn in string_cols:
+            from vega_tpu.tpu import dict_encoding
+
+            if not dict_encoding.dict_enabled():
+                raise HostFallback(
+                    f"parquet string column {fn!r} with "
+                    "dense_dict_enabled off")
+            # Dictionary codes have no null slot: the device path needs a
+            # statistics PROOF the column is null-free (same move as the
+            # int64 fits-int32 proof — metadata only, never data).
+            nulls = parquet_column_nulls(path, fn)
+            if nulls is None or nulls > 0:
+                raise HostFallback(
+                    f"parquet string column {fn!r} has nulls (or no "
+                    "null-count statistics); codes have no null slot")
+            out_dtypes[bn] = np.dtype(np.int32)
+            dict_bns.add(bn)
+            dict_fns.add(fn)
+            continue
         kind = coerce_dtype(dtypes[fn])
+        if kind == "dict32":
+            # parquet_string_columns covers arrow string types; a 'U'/'S'
+            # pandas dtype without one would be a metadata mismatch.
+            raise HostFallback(
+                f"parquet column {fn!r}: string dtype without an arrow "
+                "string type — host tier serves it")
         if kind == "int64?":
             mm = parquet_column_minmax(path, fn)
             info = np.iinfo(np.int32)
@@ -140,8 +237,45 @@ def make_parquet_source(ctx, path: str, columns: List[str],
         out_dtypes[bn] = np.dtype(kind)
     files = discover_parquet_files(path)
     name_pairs = list(names)
+    enc_memo: Dict[str, np.ndarray] = {}  # bn -> sorted dictionary
+
+    def _read_encoded():
+        """One pass over the files; string columns arrive as per-batch
+        (codes, values) pairs off the arrow dictionary pages (no
+        object-array pivot) and are remapped onto ONE sorted dictionary
+        per column."""
+        from vega_tpu.tpu import dict_encoding
+
+        parts: Dict[str, list] = {fn: [] for fn, _bn in name_pairs}
+        for batch in iter_parquet_batches(files, columns, predicate,
+                                          arrow_columns=dict_fns):
+            for fn, _bn in name_pairs:
+                parts[fn].append(batch[fn])
+        cols = {}
+        dicts = {}
+        for fn, bn in name_pairs:
+            if bn in dict_bns:
+                piece_vals = [v for _c, v in parts[fn]]
+                merged = (np.unique(np.concatenate(piece_vals))
+                          if piece_vals else np.zeros(0, "<U1"))
+                merged = enc_memo.setdefault(bn, merged)
+                if piece_vals:
+                    cols[bn] = np.concatenate([
+                        np.searchsorted(merged, v).astype(
+                            dict_encoding.CODE_DTYPE)[c]
+                        for c, v in parts[fn]])
+                else:
+                    cols[bn] = np.zeros(0, dict_encoding.CODE_DTYPE)
+                dicts[bn] = merged
+            else:
+                stacked = (np.concatenate(parts[fn]) if parts[fn]
+                           else np.empty((0,), dtypes[fn]))
+                cols[bn] = stacked.astype(out_dtypes[bn], copy=False)
+        return cols, (dicts or None)
 
     class _ParquetDenseSource(DenseRDD):
+        _frame_dict_cols = frozenset(dict_fns)
+
         def _schema(self):
             return tuple((bn, jnp.dtype(out_dtypes[bn]))
                          for _fn, bn in name_pairs)
@@ -149,21 +283,36 @@ def make_parquet_source(ctx, path: str, columns: List[str],
         def _fp_extra(self):
             return (path, tuple(columns), tuple(map(tuple, predicate)),
                     tuple(sorted((bn, str(dt))
-                                 for bn, dt in out_dtypes.items())))
+                                 for bn, dt in out_dtypes.items())),
+                    tuple(sorted(dict_bns)))
+
+        def _dicts(self):
+            if dict_bns and not enc_memo:
+                # Graph-build consumers (keyed-op unification) need the
+                # dictionaries before an action: one column-pruned read
+                # of JUST the string columns, memoized so _materialize
+                # reuses the identical sorted dictionary.
+                from vega_tpu.tpu import dict_encoding
+
+                sub = [fn for fn, bn in name_pairs if bn in dict_bns]
+                pieces: Dict[str, list] = {fn: [] for fn in sub}
+                for batch in iter_parquet_batches(
+                        files, sub, predicate, arrow_columns=set(sub)):
+                    for fn in sub:
+                        pieces[fn].append(batch[fn][1])
+                for fn, bn in name_pairs:
+                    if bn in dict_bns:
+                        vals = pieces[fn]
+                        enc_memo[bn] = (np.unique(np.concatenate(vals))
+                                        if vals else np.zeros(0, "<U1"))
+            return {bn: enc_memo[bn] for bn in dict_bns}
 
         def _materialize(self):
             from vega_tpu.tpu import block as block_lib
 
-            parts: Dict[str, list] = {fn: [] for fn, _bn in name_pairs}
-            for batch in iter_parquet_batches(files, columns, predicate):
-                for fn, _bn in name_pairs:
-                    parts[fn].append(batch[fn])
-            cols = {}
-            for fn, bn in name_pairs:
-                stacked = (np.concatenate(parts[fn]) if parts[fn]
-                           else np.empty((0,), dtypes[fn]))
-                cols[bn] = stacked.astype(out_dtypes[bn], copy=False)
-            return block_lib.from_numpy(cols, self.mesh, wide_values=False)
+            cols, dicts = _read_encoded()
+            return block_lib.from_numpy(cols, self.mesh, wide_values=False,
+                                        dicts=dicts)
 
         def unpersist(self):
             return self  # re-read is the recompute; nothing cheaper to drop

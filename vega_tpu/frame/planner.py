@@ -47,6 +47,23 @@ DEFAULT_OPTIONS = {
     "shuffle_plan": None,  # host-tier distributed shuffle plan (pull|push)
 }
 
+# Device-lowering fallback observability: every compile that lands on the
+# host tier because the device trace rejected the plan bumps the counter
+# and records why. Tests (and the strings A/B) use it to PROVE a plan
+# compiled to the device tier — "it returned the right rows" cannot
+# distinguish the tiers, the counter can.
+_FALLBACKS = {"count": 0, "last": None}
+
+
+def fallback_count() -> int:
+    """Total device->host compile fallbacks in this process."""
+    return _FALLBACKS["count"]
+
+
+def last_fallback() -> Optional[str]:
+    """Reason string of the most recent device->host fallback."""
+    return _FALLBACKS["last"]
+
 
 
 class Compiled:
@@ -91,6 +108,8 @@ def compile_plan(ctx, plan: L.LogicalPlan, options: dict) -> Compiled:
                 raise VegaError(
                     f"tier='device' requested but the plan has no device "
                     f"lowering: {e}") from e
+            _FALLBACKS["count"] += 1
+            _FALLBACKS["last"] = str(e)
             notes.append(f"host tier: {e}")
     else:
         notes.append("host tier: requested via hint")
@@ -148,11 +167,16 @@ class _DState:
     block column mapping, and the pending (not yet flushed) narrow steps
     of the current stage."""
 
-    def __init__(self, node, colmap: List[Tuple[str, str]]):
+    def __init__(self, node, colmap: List[Tuple[str, str]],
+                 dict_cols=()):
         self.node = node
         self.colmap = list(colmap)
         self.steps: List[tuple] = []
         self.est_rows: Optional[int] = None  # source row estimate
+        # Frame columns currently dictionary-encoded (string columns on
+        # int32 codes): codes support equality/order/passthrough, never
+        # arithmetic — _flush gates any computing expression over them.
+        self.dict_cols = set(dict_cols)
 
 
 def _step_token(step) -> tuple:
@@ -193,6 +217,53 @@ def _flush(st: _DState, out_pairs: List[Tuple[str, Expr]], fused: bool):
             return node  # pure passthrough: nothing to compile
     from vega_tpu.frame.expr import evaluate
 
+    # Dictionary (string) columns through the stage: codes only ever
+    # PASS THROUGH (bare Col) — any computing expression over one would
+    # run arithmetic on dictionary codes (meaningless values), so it
+    # lowers on the host tier instead. Filters whose predicate avoids
+    # dict columns are fine: compaction moves code rows untouched.
+    # `origin` tracks which parent block column each live frame column
+    # is a pure passthrough of; surviving passthroughs become the
+    # pipeline's _dict_renames so Block.dicts follows the data.
+    dict_live = set(st.dict_cols)
+    origin = {fn: bn for fn, bn in colmap}
+
+    def _refs(e) -> set:
+        out: set = set()
+        e.references(out)
+        return out
+
+    for kind, payload in steps:
+        if kind == "project":
+            for nm, e in payload:
+                if not isinstance(e, Col) and _refs(e) & dict_live:
+                    raise HostFallback(
+                        f"expression over string column(s) "
+                        f"{sorted(_refs(e) & dict_live)} computes on "
+                        "dictionary codes; host tier evaluates it")
+            origin = {nm: (origin.get(e.name)
+                           if isinstance(e, Col) else None)
+                      for nm, e in payload}
+            dict_live = {nm for nm, e in payload
+                         if isinstance(e, Col) and e.name in dict_live}
+        else:  # filter
+            if _refs(payload) & dict_live:
+                raise HostFallback(
+                    f"filter over string column(s) "
+                    f"{sorted(_refs(payload) & dict_live)} compares "
+                    "dictionary codes; host tier evaluates it")
+    dict_renames = {}
+    for bn, e in out_pairs:
+        if isinstance(e, Col):
+            src = origin.get(e.name)
+            if src is not None:
+                dict_renames[bn] = src
+        elif _refs(e) & dict_live:
+            raise HostFallback(
+                f"expression over string column(s) "
+                f"{sorted(_refs(e) & dict_live)} computes on "
+                "dictionary codes; host tier evaluates it")
+
     def cols_fn(cols, count):
         cap = cols[in_names[0]].shape[0]
         env = {fn: cols[bn] for fn, bn in colmap}
@@ -227,7 +298,21 @@ def _flush(st: _DState, out_pairs: List[Tuple[str, Expr]], fused: bool):
     token = ("frame_stage", tuple(colmap),
              tuple(_step_token(s) for s in steps),
              tuple((bn, e.token()) for bn, e in out_pairs))
-    return dr.dense_pipeline(node, cols_fn, out_schema, token, fused=fused)
+    return dr.dense_pipeline(node, cols_fn, out_schema, token, fused=fused,
+                             dict_renames=dict_renames)
+
+
+def _dicts_after(st: _DState, out_cols: List[str]) -> set:
+    """Frame columns still dictionary-encoded AFTER the pending steps:
+    a dict column survives a project only as a bare Col passthrough
+    (anything else already raises in _flush), and filters never change
+    column identity."""
+    live = set(st.dict_cols)
+    for kind, payload in st.steps:
+        if kind == "project":
+            live = {nm for nm, e in payload
+                    if isinstance(e, Col) and e.name in live}
+    return {c for c in out_cols if c in live}
 
 
 def _key_dtype(node, allowed) -> None:
@@ -286,7 +371,8 @@ def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
         taken: set = set()
         names = [(fn, _sanitize(fn, taken)) for fn in plan.data]
         node = P.make_columns_source(ctx, plan.data, names)
-        st = _DState(node, names)
+        st = _DState(node, names,
+                     dict_cols=getattr(node, "_frame_dict_cols", ()))
         st.est_rows = len(next(iter(plan.data.values()))) if plan.data \
             else 0
         return st
@@ -304,7 +390,8 @@ def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
         names = [(fn, _sanitize(fn, taken)) for fn in cols]
         node = P.make_parquet_source(ctx, plan.path, cols, plan.predicate,
                                      names, dtypes)
-        st = _DState(node, names)
+        st = _DState(node, names,
+                     dict_cols=getattr(node, "_frame_dict_cols", ()))
         try:
             from vega_tpu.io.readers import parquet_num_rows
 
@@ -327,11 +414,29 @@ def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
     if isinstance(plan, L.GroupAgg):
         st = _lower_device(ctx, plan.child, options, notes)
         specs, slots = _agg_specs(plan)
+        live = _dicts_after(st, plan.child.columns())
+        ops = [m for _bn, _e, m in specs]
+        dict_specs = set()
+        for bn, e, m in specs:
+            refs: set = set()
+            e.references(refs)
+            if refs & live:
+                # Rank codes make min/max of a string column sound on
+                # device; every other monoid would fold dictionary codes.
+                if m not in ("min", "max"):
+                    raise HostFallback(
+                        f"aggregate '{m}' over string column(s) "
+                        f"{sorted(refs & live)} folds dictionary codes; "
+                        "host tier aggregates it")
+                if len(set(ops)) != 1:
+                    raise HostFallback(
+                        "mixed-op aggregation with a string column has "
+                        "no device combiner; host tier aggregates it")
+                dict_specs.add(bn)
         out_pairs = [("k", Col(plan.key))] + [(bn, e)
                                               for bn, e, _m in specs]
         staged = _flush(st, out_pairs, fused)
         _key_dtype(staged, ("int32",))
-        ops = [m for _bn, _e, m in specs]
         exchange = _pick_exchange(ctx, options, st, len(specs) + 1, notes)
         if len(set(ops)) == 1:
             red = staged.reduce_by_key(op=ops[0], exchange=exchange)
@@ -342,7 +447,9 @@ def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
             notes.append(
                 f"groupBy: traced tuple combiner over {ops}")
         out = _DState(red, [(plan.key, "k")] + [
-            (bn, bn) for bn, _e, _m in specs])
+            (bn, bn) for bn, _e, _m in specs],
+            dict_cols=(({plan.key} if plan.key in live else set())
+                       | dict_specs))
         out.est_rows = st.est_rows
         # Mean finalization (and companion drop) rides the NEXT stage.
         proj = [(plan.key, Col(plan.key))]
@@ -385,8 +492,15 @@ def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
         if not isinstance(joined, DenseRDD):
             raise HostFallback("join degraded to the host path")
         notes.append(f"join: device sort-merge ({plan.how})")
+        llive = _dicts_after(lst, plan.left.columns())
+        rlive = _dicts_after(rst, plan.right.columns())
         out = _DState(joined, [(plan.on, "k"), (lvals[0], "lv"),
-                               (rvals[0], "rv")])
+                               (rvals[0], "rv")],
+                      dict_cols=(({plan.on} if plan.on in llive else set())
+                                 | ({lvals[0]} if lvals[0] in llive
+                                    else set())
+                                 | ({rvals[0]} if rvals[0] in rlive
+                                    else set())))
         out.est_rows = lst.est_rows
         return out
     if isinstance(plan, L.Sort):
@@ -402,7 +516,8 @@ def _lower_device(ctx, plan: L.LogicalPlan, options: dict,
                                        exchange=exchange)
         notes.append("sort: device sample-sort exchange")
         out = _DState(sorted_node, [(plan.by, "k")] + list(
-            zip(others, [bn for bn, _e in pairs[1:]])))
+            zip(others, [bn for bn, _e in pairs[1:]])),
+            dict_cols=_dicts_after(st, plan.columns()))
         out.est_rows = st.est_rows
         return out
     raise HostFallback(f"no device lowering for {type(plan).__name__}")
@@ -415,7 +530,8 @@ def _unfused_break(st: _DState, cols: List[str], options: dict) -> _DState:
     taken: set = set()
     pairs = [(_sanitize(c, taken), Col(c)) for c in cols]
     node = _flush(st, pairs, fused=False)
-    out = _DState(node, list(zip(cols, [bn for bn, _e in pairs])))
+    out = _DState(node, list(zip(cols, [bn for bn, _e in pairs])),
+                  dict_cols=_dicts_after(st, cols))
     out.est_rows = st.est_rows
     return out
 

@@ -210,14 +210,24 @@ def _row_group_may_match(meta_rg, col_index: dict, predicate) -> bool:
 
 
 def iter_parquet_batches(paths: List[str], columns: Optional[List[str]],
-                         predicate=None, batch_rows: int = 1 << 20):
+                         predicate=None, batch_rows: int = 1 << 20,
+                         arrow_columns=None):
     """Yield {name: numpy column} dicts with column pruning AND predicate
     pushdown applied inside the reader. Columns the query never names and
-    rows no conjunct can accept never leave the file layer."""
+    rows no conjunct can accept never leave the file layer.
+
+    Columns named in `arrow_columns` skip the numpy pivot: each is
+    dictionary-encoded ON THE ARROW SIDE (string columns ride the file's
+    dictionary pages straight through — no per-row Python objects) and
+    yielded as a `(codes int32, values '<U') numpy pair` instead of a
+    flat array. Predicate columns are excluded — the conjunct mask
+    evaluates on numpy values."""
     import numpy as np
     import pyarrow.parquet as pq
 
     predicate = list(predicate or ())
+    arrow_columns = set(arrow_columns or ()) - {nm for nm, _o, _v
+                                               in predicate}
     # Predicate columns must be read to evaluate the mask even when the
     # query output prunes them; they are dropped again after filtering.
     read_cols = columns
@@ -238,17 +248,29 @@ def iter_parquet_batches(paths: List[str], columns: Optional[List[str]],
             groups = None  # all
         for batch in pf.iter_batches(batch_size=batch_rows,
                                      columns=read_cols, row_groups=groups):
-            block = {
-                name: batch.column(i).to_numpy(zero_copy_only=False)
-                for i, name in enumerate(batch.schema.names)
-            }
+            block = {}
+            for i, name in enumerate(batch.schema.names):
+                col = batch.column(i)
+                if name in arrow_columns:
+                    enc = col.dictionary_encode()
+                    codes = np.asarray(
+                        enc.indices.to_numpy(zero_copy_only=False)
+                    ).astype(np.int32, copy=False)
+                    vals = np.asarray(enc.dictionary).astype(np.str_)
+                    block[name] = (codes, vals)
+                else:
+                    block[name] = col.to_numpy(zero_copy_only=False)
             if predicate:
                 mask = None
                 for nm, op, lit in predicate:
                     m = _PRED_OPS[op](block[nm], lit)
                     mask = m if mask is None else (mask & m)
                 if mask is not None and not np.all(mask):
-                    block = {nm: c[mask] for nm, c in block.items()}
+                    block = {
+                        nm: ((c[0][mask], c[1]) if nm in arrow_columns
+                             else c[mask])
+                        for nm, c in block.items()
+                    }
             if columns is not None:
                 block = {nm: block[nm] for nm in columns}
             yield block
@@ -294,11 +316,27 @@ def _file_meta(path: str) -> dict:
                 complete = False
                 break
         minmax[name] = (lo, hi) if complete and lo is not None else None
+    import pyarrow as pa
+
+    nulls = {}
+    for name, i in idx.items():
+        total = 0
+        for g in range(m.num_row_groups):
+            stats = m.row_group(g).column(i).statistics
+            if stats is None or stats.null_count is None:
+                total = None
+                break
+            total += stats.null_count
+        nulls[name] = total
     meta = {
         "schema": {f.name: f.type.to_pandas_dtype()
                    for f in pf.schema_arrow},
+        "strings": {f.name for f in pf.schema_arrow
+                    if pa.types.is_string(f.type)
+                    or pa.types.is_large_string(f.type)},
         "num_rows": m.num_rows,
         "minmax": minmax,
+        "nulls": nulls,
     }
     if len(_META_CACHE) >= _META_CACHE_MAX:
         _META_CACHE.clear()
@@ -317,6 +355,31 @@ def parquet_num_rows(path: str) -> int:
     planner's exchange-sizing estimate)."""
     return sum(_file_meta(f)["num_rows"]
                for f in discover_parquet_files(path))
+
+
+def parquet_string_columns(path: str) -> set:
+    """Column names with an arrow string/large_string type, from metadata
+    only — the frame planner's dictionary-encoding eligibility source
+    (a pandas-dtype `object` alone cannot distinguish string columns
+    from arbitrary object columns)."""
+    out: set = set()
+    for f in discover_parquet_files(path):
+        out |= _file_meta(f)["strings"]
+    return out
+
+
+def parquet_column_nulls(path: str, column: str):
+    """Total null count across the path's files from statistics, or None
+    when any row group lacks them. Metadata only — the dictionary-encoded
+    device path requires a proven null-free string column (codes have no
+    null slot); unknown counts keep the column on the host tier."""
+    total = 0
+    for f in discover_parquet_files(path):
+        n = _file_meta(f)["nulls"].get(column)
+        if n is None:
+            return None
+        total += n
+    return total
 
 
 def parquet_column_minmax(path: str, column: str):

@@ -1,4 +1,4 @@
-"""vegalint rules VG001–VG019: the project invariants as AST checks.
+"""vegalint rules VG001–VG020: the project invariants as AST checks.
 
 Each rule encodes one CLAUDE.md invariant (see docs/LINTING.md for the
 catalog with rationale and examples). Rules are deliberately conservative:
@@ -36,6 +36,11 @@ leaked socket/file handles on cross-process paths (VG018), and no
 driver-only function reachable from a confined worker/receiver role
 (VG019). Implementations live in callgraph.py; registration is here so
 one import populates the whole registry.
+
+VG020 (PR 20) guards the string-column invariant: device-tier code
+(vega_tpu/tpu/) must never create object-dtype numpy arrays — strings
+cross the device boundary only as int32 dictionary codes
+(tpu/dict_encoding.py, the one exempt file).
 """
 
 from __future__ import annotations
@@ -1581,3 +1586,68 @@ def vg018(ctx: FileCtx) -> Iterator[Finding]:
       project=True, extract=_cg.extract_callgraph, extract_key="callgraph")
 def vg019(records) -> Iterator[Finding]:
     yield from _cg.check_vg019(records)
+
+
+def _vg020_is_object_dtype(node: ast.AST) -> bool:
+    """True for the spellings that name the numpy object dtype: the
+    `object` builtin, `np.object_`, and the 'O'/'object' dtype strings."""
+    if isinstance(node, ast.Name) and node.id == "object":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in ("object_", "object"):
+        return True
+    if isinstance(node, ast.Constant) and node.value in ("O", "object"):
+        return True
+    return False
+
+
+@rule("VG020", "object-dtype array created on a device-bound path")
+def vg020(ctx: FileCtx) -> Iterator[Finding]:
+    """Device-tier code (vega_tpu/tpu/) must never CREATE object-dtype
+    numpy arrays: jax.device_put has no representation for them, so one
+    reaching a shard program or device kernel dies with a raw TypeError
+    mid-stage (block._check_dtype turns that into a crisp VegaError, but
+    only at the block boundary — anything conjured past it is unguarded).
+    Strings and Python objects cross the device boundary only as int32
+    dictionary codes; tpu/dict_encoding.py is the one exempt file — it is
+    the host-side encoder whose JOB is consuming such arrays to produce
+    codes. Flags `dtype=object` / `dtype=np.object_` / `dtype="O"`
+    keywords, the positional dtype of the common numpy constructors,
+    `.astype(object)`-family calls, and `np.frompyfunc` (whose result is
+    always an object array)."""
+    if not ctx.in_dir("vega_tpu", "tpu"):
+        return
+    if ctx.endswith("tpu/dict_encoding.py"):
+        return
+    ctors = {"array", "asarray", "empty", "zeros", "ones", "full",
+             "fromiter", "frombuffer"}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_name(node.func)
+        if name == "frompyfunc":
+            yield Finding(
+                "VG020", ctx.display, node.lineno, node.col_offset + 1,
+                "np.frompyfunc always returns an object-dtype array — "
+                "object arrays have no device representation; encode "
+                "through tpu/dict_encoding.py instead")
+            continue
+        hit = None
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _vg020_is_object_dtype(kw.value):
+                hit = kw.value
+        if hit is None and name == "astype" and node.args \
+                and _vg020_is_object_dtype(node.args[0]):
+            hit = node.args[0]
+        # positional dtype: arg index 1 for array/asarray/empty/zeros/
+        # ones/fromiter/frombuffer, 2 for full (arg 1 is the fill value)
+        pos = 2 if name == "full" else 1
+        if hit is None and name in ctors and len(node.args) > pos \
+                and _vg020_is_object_dtype(node.args[pos]):
+            hit = node.args[pos]
+        if hit is not None:
+            yield Finding(
+                "VG020", ctx.display, node.lineno, node.col_offset + 1,
+                "object-dtype array created in device-tier code — object "
+                "arrays have no device representation (jax.device_put "
+                "raises); strings/objects cross the boundary only as "
+                "int32 dictionary codes (tpu/dict_encoding.py)")

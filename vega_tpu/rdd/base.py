@@ -394,6 +394,37 @@ class RDD(PairOpsMixin):
             .values()
         )
 
+    def dense(self):
+        """Lift this host RDD onto the device tier: 2-tuples become a
+        (key, value) pair block, scalars a single value column. int64
+        beyond int32 range rides the wide (name, name.lo) two-column
+        encoding; string data dictionary-encodes (int32 codes + a
+        dictionary sidecar). Data the device cannot represent (mixed
+        object rows, >2-tuples) returns self unchanged — the two-tier
+        contract: degrade, never error. Materializes this lineage once
+        (the device tier holds whole columns, not lazy partitions)."""
+        import logging
+
+        import numpy as np
+
+        log = logging.getLogger("vega_tpu")
+        rows = self.collect()
+        try:
+            from vega_tpu.tpu import block as block_lib
+            from vega_tpu.tpu.dense_rdd import _SourceRDD
+
+            if rows and all(isinstance(r, tuple) and len(r) == 2
+                            for r in rows):
+                keys = np.asarray([k for k, _v in rows])
+                vals = np.asarray([v for _k, v in rows])
+                blk = block_lib.pair_block(keys, vals)
+            else:
+                blk = block_lib.single_column(np.asarray(rows))
+            return _SourceRDD(self.context, blk)
+        except VegaError as e:
+            log.info("dense() stays on the host tier: %s", e)
+            return self
+
     def pipe(self, command: List[str] | str):
         """Pipe each partition through an external command, one item per line
         (Spark parity; absent from the reference)."""

@@ -84,6 +84,18 @@ def _decode_key_cols(cols: dict) -> dict:
     return out
 
 
+def _decode_dict_cols(cols: dict, dicts) -> dict:
+    """Turn dictionary-encoded int32 code columns back into their string
+    columns for host-facing reads (the collect-boundary decode of
+    tpu/dict_encoding.py); non-dict columns pass through (order
+    preserved). Runs AFTER _decode_key_cols — dict names never carry a
+    '.lo' pair, so the two decodes touch disjoint columns."""
+    if not dicts:
+        return cols
+    return {name: (dicts[name][np.asarray(col)] if name in dicts else col)
+            for name, col in cols.items()}
+
+
 @dataclasses.dataclass
 class Block:
     cols: Dict[str, jax.Array]  # each [n_shards * capacity, ...]
@@ -105,6 +117,11 @@ class Block:
     # an unsettled speculative block could observe capacity-truncated
     # data.
     settle: Optional[object] = None
+    # Dictionary sidecar for string columns (tpu/dict_encoding.py):
+    # {column name -> sorted host numpy array of dictionary values}, where
+    # the column holds int32 codes indexing it. Host metadata only — never
+    # shipped to device. None when no column is dictionary-encoded.
+    dicts: Optional[Dict[str, np.ndarray]] = None
     # Multi-process only: replicated host copy of all columns, filled by
     # the first shard_rows (each host read there costs a full-block
     # all-gather; per-split consumption reads every shard).
@@ -180,7 +197,7 @@ class Block:
                 out[name].append(host_cols[name][lo:lo + c])
         gathered = {n: np.concatenate(parts) if parts else np.empty((0,))
                     for n, parts in out.items()}
-        return _decode_key_cols(gathered)
+        return _decode_dict_cols(_decode_key_cols(gathered), self.dicts)
 
     def shard_rows(self, shard: int) -> Dict[str, np.ndarray]:
         counts = self.counts_np
@@ -206,13 +223,16 @@ class Block:
             # another inside device_get, 0% CPU). One lock here costs
             # nothing — the path is host-bound anyway — and removes the
             # interleaving entirely.
-            with _host_cache_lock:
+            with _host_cache_lock, mesh_lib.device_door():
                 # vegalint: ignore[VG003] — serializing this device_get IS the fix: concurrent slice+device_get from two task threads deadlocks old XLA:CPU on 1 core (CLAUDE.md)
                 sliced = jax.device_get(
                     {name: col[lo:lo + c] for name, col in self.cols.items()}
                 )  # one transfer for all columns
-        return _decode_key_cols(
-            {name: np.asarray(col) for name, col in sliced.items()}
+        return _decode_dict_cols(
+            _decode_key_cols(
+                {name: np.asarray(col) for name, col in sliced.items()}
+            ),
+            self.dicts,
         )
 
 
@@ -239,6 +259,20 @@ def _check_dtype(name: str, src: np.ndarray) -> np.ndarray:
     precision loss, which is the documented dtype contract."""
     import jax as _jax
 
+    if src.dtype.kind in "OUS":
+        # Strings were already dictionary-encoded upstream (from_numpy
+        # runs encode_string_columns first), so anything still here is a
+        # mixed-object column or a string column with encoding disabled.
+        # jax.device_put would throw a raw TypeError — raise the crisp
+        # VegaError instead so callers (RDD.dense, the frame planner)
+        # degrade to the host tier.
+        from vega_tpu.errors import VegaError
+
+        raise VegaError(
+            f"column {name!r} has dtype {src.dtype} which has no device "
+            "representation (mixed Python objects, or strings with "
+            "dense_dict_enabled=false) — use the host tier for this data."
+        )
     if _jax.config.read("jax_enable_x64"):
         return src
     if src.dtype in (np.int64, np.uint64):
@@ -344,16 +378,28 @@ def encode_value_columns(columns: Dict[str, np.ndarray]
 
 def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
                capacity: Optional[int] = None,
-               wide_values: bool = True) -> Block:
+               wide_values: bool = True,
+               dicts: Optional[Dict[str, np.ndarray]] = None) -> Block:
     """Build a row-sharded Block from host columns (equal lengths). int64
     columns beyond int32 range are transparently stored as two-column
     (name, name.lo) encodings (see LO_SUFFIX above) — the KEY via
     encode_key_columns, value columns via encode_value_columns (unless
     wide_values=False, for layouts with no wide form: the caller then
-    degrades to the host tier on the VegaError _check_dtype raises)."""
+    degrades to the host tier on the VegaError _check_dtype raises).
+    String columns dictionary-encode into int32 codes plus a dicts
+    sidecar (tpu/dict_encoding.py); pre-encoded callers (parquet
+    dictionary pages, streamed chunks) pass the code columns plus their
+    `dicts` directly. With dense_dict_enabled off, strings raise the same
+    crisp VegaError — callers degrade to the host tier."""
+    from vega_tpu.tpu import dict_encoding
+
     mesh = mesh or mesh_lib.default_mesh()
     n_shards = mesh.size
-    columns = encode_key_columns(dict(columns))
+    # Strings first: their codes are plain int32 columns for the int64
+    # wide encodes below (which pass them through untouched).
+    columns, dicts = dict_encoding.encode_string_columns(
+        dict(columns), dicts)
+    columns = encode_key_columns(columns)
     if wide_values:
         columns = encode_value_columns(columns)
     names = list(columns)
@@ -374,7 +420,7 @@ def from_numpy(columns: Dict[str, np.ndarray], mesh=None,
         cols[name] = mesh_lib.host_put(dst, mesh_lib.shard_spec(mesh))
     counts_arr = mesh_lib.host_put(counts, mesh_lib.shard_spec(mesh))
     return Block(cols=cols, counts=counts_arr, capacity=cap, mesh=mesh,
-                 counts_host=counts)
+                 counts_host=counts, dicts=dicts)
 
 
 def block_range(n: int, mesh=None, dtype=jnp.int32, start: int = 0) -> Block:

@@ -32,7 +32,7 @@ from __future__ import annotations
 import logging
 import math
 import weakref
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,7 @@ from vega_tpu.errors import VegaError
 from vega_tpu.rdd.base import RDD
 from vega_tpu.split import Split
 from vega_tpu.tpu import block as block_lib
+from vega_tpu.tpu import dict_encoding
 from vega_tpu.tpu import kernels
 from vega_tpu.tpu import pallas_kernels
 from vega_tpu.tpu import mesh as mesh_lib
@@ -557,6 +558,7 @@ class DenseRDD(RDD):
                          mesh_lib.host_get(dict(blk.cols)).items()},
                 "counts": blk.counts_np,
                 "capacity": blk.capacity,
+                "dicts": blk.dicts,
             }
             self._pickle_state_memo = memo
         return memo
@@ -575,7 +577,13 @@ class DenseRDD(RDD):
             cols=state["cols"], counts=state["counts"],
             capacity=state["capacity"],
             mesh=_HostMeshStub(len(state["counts"])),
+            dicts=state.get("dicts"),
         )
+
+    def dense(self):
+        """Already on the device tier — identity (RDD.dense() lifts host
+        lineages; re-lifting a dense node would round-trip the data)."""
+        return self
 
     # --- device plane -------------------------------------------------------
     def block(self) -> Block:
@@ -604,6 +612,15 @@ class DenseRDD(RDD):
             blk = _load_spilled_block(self)
             if blk is None:
                 blk = self._materialize()
+            if blk.dicts is None:
+                # ONE attachment point for the dictionary sidecar: every
+                # materializer builds plain code-column Blocks; the
+                # lineage-propagated dictionaries (_dicts) hang on here so
+                # host-facing reads (to_numpy/shard_rows) decode. Sources
+                # already carry dicts from from_numpy and keep theirs.
+                d = self._dicts()
+                if d:
+                    blk.dicts = dict(d)
             self._block = blk
             # Only lineage-recomputable nodes enter the eviction LRU:
             # sources set _block in __init__ and never take this path.
@@ -738,6 +755,37 @@ class DenseRDD(RDD):
         """(name, dtype) of columns without materializing."""
         raise NotImplementedError
 
+    def _dicts(self) -> Dict[str, np.ndarray]:
+        """{column name -> sorted host dictionary array} for every
+        dictionary-encoded (string) column of THIS node's output
+        (tpu/dict_encoding.py). Pure host metadata, known at
+        graph-construction time — never materializes device data.
+
+        Default: union of the parents' dictionaries (first parent wins a
+        name tie — binary nodes that mix sides override), filtered to
+        this node's schema. Nodes that mint or move columns set
+        `_dict_renames` ({out name -> parent name}), which REPLACES the
+        walk: only listed columns inherit dict-ness ({} = mints all
+        columns fresh, e.g. a traced map). Memoized per node (lineage
+        walks are repeated by every public-method gate)."""
+        memo = getattr(self, "_dicts_memo", None)
+        if memo is not None:
+            return memo
+        parent_dicts: Dict[str, np.ndarray] = {}
+        for p in self._dense_parents:
+            for nm, d in p._dicts().items():
+                parent_dicts.setdefault(nm, d)
+        renames = getattr(self, "_dict_renames", None)
+        if renames is not None:
+            out = {out_nm: parent_dicts[src]
+                   for out_nm, src in renames.items() if src in parent_dicts}
+        else:
+            out = parent_dicts
+        names = {nm for nm, _ in self._schema()}
+        res = {nm: d for nm, d in out.items() if nm in names}
+        self._dicts_memo = res
+        return res
+
     # --- RDD interop (host tier sees a normal RDD) --------------------------
     @property
     def num_partitions(self) -> int:
@@ -785,6 +833,7 @@ class DenseRDD(RDD):
                       for n, c in blk.host_cols().items()},
                 counts=blk.counts_np, capacity=blk.capacity,
                 mesh=_HostMeshStub(self.mesh.size),
+                dicts=blk.dicts,
             )
         return [Split(i) for i in range(self.num_partitions)]
 
@@ -858,10 +907,24 @@ class DenseRDD(RDD):
         return MapPartitionsRDD(self, lambda _i, it: it)
 
     # --- narrow ops ---------------------------------------------------------
+    def _dict_row_gate(self) -> None:
+        """Raise _NotTraceable when any column is dictionary-encoded: a
+        traced row closure would see int32 codes where the user wrote
+        string logic (silently wrong results — codes are private to the
+        device tier). The host fallback sees decoded strings, so the
+        normal two-tier contract covers strings too."""
+        d = self._dicts()
+        if d:
+            raise _NotTraceable(
+                f"dictionary-encoded (string) columns {sorted(d)}; row "
+                "closures see decoded strings on the host tier"
+            )
+
     def map(self, f: Callable):
         """Vectorized per-row map if f is traceable, else host fallback
         (the two-tier contract, SURVEY.md §7 hard part 2)."""
         try:
+            self._dict_row_gate()
             return _MapRDD(self, f)
         except _NotTraceable as e:
             log.info("dense map fell back to host tier: %s", e)
@@ -869,6 +932,7 @@ class DenseRDD(RDD):
 
     def filter(self, predicate: Callable):
         try:
+            self._dict_row_gate()
             return _FilterRDD(self, predicate)
         except _NotTraceable as e:
             log.info("dense filter fell back to host tier: %s", e)
@@ -884,6 +948,7 @@ class DenseRDD(RDD):
         flat_map (dynamic-arity flat_map falls back to the host tier
         automatically via the normal RDD method)."""
         try:
+            self._dict_row_gate()
             return _MapExpandRDD(self, f, factor)
         except _NotTraceable as e:
             log.info("dense map_expand fell back to host tier: %s", e)
@@ -905,6 +970,7 @@ class DenseRDD(RDD):
         flat_map (rdd.rs:207-214): the per-row bound keeps shapes static;
         genuinely unbounded closures use .flat_map (host tier)."""
         try:
+            self._dict_row_gate()
             return _FlatMapRaggedRDD(self, f, max_out_per_row)
         except _NotTraceable as e:
             log.info("dense flat_map_ragged fell back to host tier: %s", e)
@@ -968,6 +1034,21 @@ class DenseRDD(RDD):
                 f"{value_names}); use select(...) or a tuple-valued "
                 "reduce_by_key on multi-column blocks"
             )
+        if value_names[0] in self._dicts():
+            if value_names == [VALUE]:
+                # Dictionary-encoded (string) VALUE: a traced f would see
+                # int32 codes, not strings; the canonical pair layout
+                # decodes to (k, v) rows — silent host fallback.
+                log.info("dense map_values fell back to host tier: "
+                         "dictionary-encoded (string) value column")
+                return super().map_values(f)
+            raise VegaError(
+                f"map_values over dictionary-encoded (string) column "
+                f"{value_names[0]!r} on a named block has no device trace "
+                f"or host row form; rename({{{value_names[0]!r}: "
+                f"{VALUE!r}}}) to the canonical layout for the host "
+                "fallback"
+            )
         if value_names[0] in block_lib.wide_value_pairs(names):
             # ONE named wide column: a traced f would see only the hi
             # word, and a named block has no host (k, v) row form to fall
@@ -1018,6 +1099,33 @@ class DenseRDD(RDD):
                 # closure, so the fallback contract applies — let the
                 # func path raise _NotTraceable and fold on the host.
                 op = None
+        dict_vals = sorted(nm for nm in self._dicts()
+                           if nm not in (KEY, KEY_LO))
+        if dict_vals and op not in ("min", "max"):
+            # Codes are RANK codes, so min/max of codes == lexicographic
+            # min/max of the strings (one dictionary per lineage; binary
+            # ops unify first) and those folds stay on device. Any other
+            # fold (add/prod/closure) would compute on the code VALUES —
+            # no string meaning — so host semantics apply (e.g. '+'
+            # concatenates strings there).
+            plain = {nm for nm, _ in self._schema()
+                     if not block_lib.is_lo(nm)}
+            if plain != {KEY, VALUE}:
+                raise VegaError(
+                    "reduce_by_key over dictionary-encoded (string) value "
+                    f"columns {dict_vals} needs op='min'/'max' (codes are "
+                    "rank codes; other folds have no string meaning on "
+                    "device), and a named/multi-column block has no host "
+                    "row form to fall back on"
+                )
+            log.info("dense reduce_by_key fell back to host tier: "
+                     "dictionary-encoded (string) value column under "
+                     "op=%s", op)
+            import operator
+
+            host_func = func if func is not None else \
+                {"add": operator.add, "prod": operator.mul}[op]
+            return super().reduce_by_key(host_func, partitioner_or_num)
         if op is not None:
             return _with_exchange(_ReduceByKeyRDD(self, op=op, func=None),
                                   exchange)
@@ -1069,12 +1177,15 @@ class DenseRDD(RDD):
         fallback must not re-dispatch through this override)."""
         if not self.is_pair:
             raise VegaError("combine_by_key on non-pair DenseRDD")
-        if block_lib.wide_value_pairs(nm for nm, _ in self._schema()):
+        if block_lib.wide_value_pairs(nm for nm, _ in self._schema()) or \
+                any(nm not in (KEY, KEY_LO) for nm in self._dicts()):
             # Wide int64 values: _MapValuesRDD would trace create_combiner
-            # over the hi word alone and silently drop the low word. No
-            # row form -> host tier (exact int64 combiners).
+            # over the hi word alone and silently drop the low word.
+            # Dictionary-encoded (string) values: the combiner would see
+            # int32 codes, not strings. Either way no device trace -> host
+            # tier (exact int64 / decoded-string combiners).
             log.info("dense combine_by_key fell back to host tier: wide "
-                     "int64 value column")
+                     "int64 or dictionary-encoded value column")
             from vega_tpu.rdd.pair import PairOpsMixin
 
             return PairOpsMixin.combine_by_key(
@@ -1165,12 +1276,16 @@ class DenseRDD(RDD):
         fill_value so results don't depend on which path ran."""
         wide_right = isinstance(other, DenseRDD) and other.is_pair and \
             block_lib.wide_value_pairs(nm for nm, _ in other._schema())
-        if fill_value is not None and not wide_right and \
-                self._dense_joinable(other, partitioner_or_num):
+        dict_right = isinstance(other, DenseRDD) and other.is_pair and \
+            any(nm not in (KEY, KEY_LO) for nm in other._dicts())
+        if fill_value is not None and not wide_right and not dict_right \
+                and self._dense_joinable(other, partitioner_or_num):
             # wide_right gate: the kernel fills unmatched right columns
             # with one scalar per column, which would land RAW in the
             # encoded (hi, lo) words and decode to garbage — the host
-            # path fills the real int64.
+            # path fills the real int64. dict_right likewise: the fill
+            # scalar would land in the CODE column and decode to an
+            # arbitrary dictionary string instead of fill_value.
             pair = _align_keys(self, other)
             if pair is not None:
                 return _with_exchange(
@@ -1222,7 +1337,11 @@ class DenseRDD(RDD):
 
         if (isinstance(other, DenseRDD) and other.mesh == self.mesh
                 and [n for n, _ in self._schema()] == [VALUE]
-                and [n for n, _ in other._schema()] == [VALUE]):
+                and [n for n, _ in other._schema()] == [VALUE]
+                and not self._dicts() and not other._dicts()):
+            # dict gate: the kernel snapshots the right side via
+            # to_numpy(), which decodes strings — re-staging them on
+            # device has no form. The host tier streams decoded rows.
             budget = getattr(Env.get().conf, "dense_hbm_budget", 4 << 30)
             try:
                 return _CartesianDenseRDD(self, other, budget)
@@ -1244,6 +1363,10 @@ class DenseRDD(RDD):
         if self.is_pair:
             return super().distinct(num_partitions)
         keyed = _MapRDD(self, lambda v: (v, jnp.int32(0)))
+        # Trusted internal closure: the value moves to the key unchanged,
+        # so dict-ness (string codes) follows it — dedup by code == dedup
+        # by string within one lineage's dictionary.
+        keyed._dict_renames = {KEY: VALUE}
         return _ReduceByKeyRDD(keyed, op="min", func=None).keys_dense()
 
     def _dense_set_op_ok(self, other) -> bool:
@@ -1263,11 +1386,17 @@ class DenseRDD(RDD):
         join elides BOTH exchanges and sorts), then keeps the joined keys
         (reference semantics: rdd.rs:831-841, deduplicated)."""
         if self._dense_set_op_ok(other):
-            a = _ReduceByKeyRDD(_MapRDD(self, lambda v: (v, jnp.int32(0))),
-                                op="min", func=None)
-            b = _ReduceByKeyRDD(_MapRDD(other, lambda v: (v, jnp.int32(0))),
-                                op="min", func=None)
-            return _JoinRDD(a, b).keys_dense()
+            pair = _unify_dict_cols(self, other, (VALUE,))
+            if pair is None:  # dict-ness mismatch: only host equality holds
+                return RDD.intersection(self, other, num_partitions)
+            left, right = pair
+
+            def dedup(side):
+                keyed = _MapRDD(side, lambda v: (v, jnp.int32(0)))
+                keyed._dict_renames = {KEY: VALUE}  # value moves to key
+                return _ReduceByKeyRDD(keyed, op="min", func=None)
+
+            return _JoinRDD(dedup(left), dedup(right)).keys_dense()
         return RDD.intersection(self, other, num_partitions)
 
     def subtract(self, other, num_partitions=None):
@@ -1278,14 +1407,22 @@ class DenseRDD(RDD):
         The marks side is a reduce output, so its exchange elides
         (reference semantics: rdd.rs:843-870)."""
         if self._dense_set_op_ok(other):
-            keyed = _MapRDD(self, lambda v: (v, jnp.int32(1)))
-            marks = _ReduceByKeyRDD(
-                _MapRDD(other, lambda v: (v, jnp.int32(1))),
-                op="min", func=None,
-            )
+            pair = _unify_dict_cols(self, other, (VALUE,))
+            if pair is None:  # dict-ness mismatch: only host equality holds
+                return RDD.subtract(self, other, num_partitions)
+            left, right = pair
+            keyed = _MapRDD(left, lambda v: (v, jnp.int32(1)))
+            keyed._dict_renames = {KEY: VALUE}  # value moves to key
+            marked = _MapRDD(right, lambda v: (v, jnp.int32(1)))
+            marked._dict_renames = {KEY: VALUE}
+            marks = _ReduceByKeyRDD(marked, op="min", func=None)
             joined = _JoinRDD(keyed, marks, outer=True, fill_value=0)
-            return joined.select(KEY, "rv").filter(
-                lambda row: row[1] == 0
+            # Trusted internal predicate: it reads only the int32 mark
+            # column, so construct _FilterRDD directly — the public
+            # filter's dict gate would see the (possibly dict-encoded)
+            # KEY and needlessly force the host tier.
+            return _FilterRDD(
+                joined.select(KEY, "rv"), lambda row: row[1] == 0
             ).keys_dense()
         return RDD.subtract(self, other, num_partitions)
 
@@ -1352,6 +1489,10 @@ class DenseRDD(RDD):
             # No scalar row form for wide int64 — host fold sees the
             # decoded int64s (and keeps exact bignum arithmetic).
             return super().reduce(f)
+        if VALUE in self._dicts():
+            # Dictionary-encoded strings: the traced binop would fold
+            # int32 codes — host fold sees the decoded strings.
+            return super().reduce(f)
         cap = blk.capacity
 
         def shard_reduce(vals, counts):
@@ -1382,6 +1523,14 @@ class DenseRDD(RDD):
         return acc.item() if acc.ndim == 0 else acc
 
     def _named_reduce(self, op: str):
+        vdict = self._dicts().get(VALUE)
+        if vdict is not None and op == "add":
+            # A sum of dictionary codes has no string meaning, and there
+            # is no host sum of strings either — crisp, not silent.
+            raise VegaError(
+                "sum() over a string (dictionary-encoded) column has no "
+                "meaning; min()/max() are the defined string reductions"
+            )
         blk = self.block()
         if self.is_pair:
             raise VegaError(f"{op}() on pair DenseRDD — reduce values instead")
@@ -1399,9 +1548,17 @@ class DenseRDD(RDD):
         partials = np.asarray(mesh_lib.host_get(prog(blk.cols[VALUE], blk.counts)))
         if op == "add":
             return partials.sum(axis=0).item()
-        if op == "min":
-            return partials.min(axis=0).item()
-        return partials.max(axis=0).item()
+        code = (partials.min(axis=0) if op == "min"
+                else partials.max(axis=0)).item()
+        if vdict is not None:
+            # min/max of rank codes == lexicographic min/max; decode the
+            # winning code back to its string at this collect boundary.
+            # An out-of-range code is the masked_reduce identity sentinel:
+            # every row was padding.
+            if not 0 <= code < len(vdict):
+                raise VegaError(f"{op}() of empty DenseRDD")
+            return vdict[code].item()
+        return code
 
     def _named_reduce_wide(self, op: str, blk: Block):
         """sum/min/max over a wide (two-column int64) keyless VALUE: one
@@ -1467,7 +1624,11 @@ class DenseRDD(RDD):
         anything else falls back to the host UnionRDD."""
         if isinstance(other, DenseRDD) and \
                 dict(self._schema()) == dict(other._schema()):
-            return _DenseUnionRDD(self, other)
+            names = tuple(nm for nm, _ in self._schema())
+            pair = _unify_dict_cols(self, other, names)
+            if pair is None:  # dict-ness mismatch: host rows compare right
+                return RDD.union(self, other)
+            return _DenseUnionRDD(*pair)
         return RDD.union(self, other)
 
     def count_by_value(self) -> dict:
@@ -1477,6 +1638,10 @@ class DenseRDD(RDD):
             # wide: no scalar row form for the value->key map closure
             return RDD.count_by_value(self)
         keyed = _MapRDD(self, lambda x: (x, jnp.int32(1)))
+        # Trusted internal closure: the value moves to the key unchanged,
+        # so dict-ness follows it; counts per code == counts per string,
+        # and collect() decodes the keys.
+        keyed._dict_renames = {KEY: VALUE}
         return dict(_ReduceByKeyRDD(keyed, op="add", func=None).collect())
 
     def take_ordered(self, n: int, key=None) -> list:
@@ -1539,6 +1704,11 @@ class DenseRDD(RDD):
         candidates = np.sort(candidates)
         if largest:
             candidates = candidates[::-1]
+        vdict = self._dicts().get(VALUE)
+        if vdict is not None:
+            # Rank codes ordered == strings ordered; decode the survivors
+            # at this collect boundary.
+            candidates = vdict[candidates.astype(np.int64)]
         return candidates[:n].tolist()
 
     def _device_topk_rows(self, n: int, largest: bool) -> list:
@@ -1633,12 +1803,17 @@ class DenseRDD(RDD):
         merged = block_lib._decode_key_cols(merged)  # schema order kept
         order_cols = list(merged.values())
         # np.lexsort: last key is primary -> reverse; stable like the
-        # device sort.
+        # device sort. Dictionary-encoded columns order by their RANK
+        # codes here — identical to string order — and decode below.
         order = np.lexsort([c if not largest else
                             (-c if np.issubdtype(c.dtype, np.floating)
                              else ~c)
                             for c in reversed(order_cols)])
         out_names = [nm for nm in names if not block_lib.is_lo(nm)]
+        dicts = self._dicts()
+        for nm in out_names:
+            if nm in dicts:  # collect boundary: codes -> strings
+                merged[nm] = dicts[nm][merged[nm]]
         rows = [tuple(merged[nm][i] for nm in out_names)
                 for i in order[:n]]
         if out_names == [KEY, VALUE]:
@@ -1653,8 +1828,10 @@ class DenseRDD(RDD):
         import math
 
         blk = self.block()
-        if self.is_pair or self._wide_value():
-            return RDD.stats(self)  # wide: host sees decoded int64 rows
+        if self.is_pair or self._wide_value() or VALUE in self._dicts():
+            # wide/dict: host sees decoded int64 / string rows (and the
+            # host path raises its normal TypeError for string stats)
+            return RDD.stats(self)
 
         def shard_stats(vals, counts):
             count = counts[0]
@@ -1713,8 +1890,10 @@ class DenseRDD(RDD):
 
     def histogram(self, buckets):
         """Device histogram: bucketize + per-shard bincount + driver sum."""
-        if self.is_pair or self._wide_value():
-            # wide: float32 bucketing would mangle int64s; host is exact
+        if self.is_pair or self._wide_value() or VALUE in self._dicts():
+            # wide: float32 bucketing would mangle int64s; host is exact.
+            # dict: bucketing codes is not bucketing strings — the host
+            # path raises its normal TypeError for string histograms.
             return RDD.histogram(self, buckets)
         if isinstance(buckets, int):
             lo, hi = self._min_max()
@@ -1939,6 +2118,11 @@ class _MapRDD(_NarrowRDD):
         super().__init__(parent, out_schema)
         self._cols_fn = cols_fn
         self._user_fn = f
+        # A traced closure mints its outputs fresh — no dictionary rides
+        # through by default. Trusted internal callers that merely MOVE a
+        # dict column (distinct/set ops/count_by_value) overwrite this
+        # right after construction.
+        self._dict_renames = {}
 
     def _shard_fn(self, cols, count):
         return self._cols_fn(cols), count
@@ -1964,6 +2148,9 @@ class _MapValuesRDD(_NarrowRDD):
         super().__init__(parent, key_schema + ((self._vname, out.dtype),))
         self._f = f
         self._user_fn = f
+        # Keys pass through untouched (dict KEY keeps its dictionary);
+        # the value column is minted by the closure.
+        self._dict_renames = {KEY: KEY}
 
     def _shard_fn(self, cols, count):
         out = {KEY: cols[KEY],
@@ -2053,6 +2240,7 @@ class _MapExpandRDD(_NarrowRDD):
         self._f = f
         self._factor = factor
         self._user_fn = (f, factor)
+        self._dict_renames = {}  # closure-minted outputs: no dict rides
 
     def _materialize(self) -> Block:
         # Expansion changes capacity; run as its own program (not chained).
@@ -2131,6 +2319,7 @@ class _FlatMapRaggedRDD(_NarrowRDD):
         self._f = f
         self._max_out = max_out
         self._user_fn = (f, max_out)
+        self._dict_renames = {}  # closure-minted outputs: no dict rides
 
     def _materialize(self) -> Block:
         parent_blk = self.parent.block()
@@ -2181,6 +2370,8 @@ class _ZipWithIndexRDD(DenseRDD):
     def __init__(self, parent: DenseRDD):
         super().__init__(parent.context, parent.mesh, [parent])
         self.parent = parent
+        # The value moves to the key slot unchanged; the index is fresh.
+        self._dict_renames = {KEY: VALUE}
 
     def _schema(self):
         pschema = dict(self.parent._schema())
@@ -2224,6 +2415,18 @@ class _DenseZipRDD(DenseRDD):
         l = dict(self.left._schema())
         r = dict(self.right._schema())
         return ((KEY, l[VALUE]), (VALUE, r[VALUE]))
+
+    def _dicts(self):
+        # Sides keep their OWN dictionaries (no cross-side comparison
+        # happens in a zip): left value -> KEY, right value -> VALUE.
+        out = {}
+        ld = self.left._dicts().get(VALUE)
+        rd = self.right._dicts().get(VALUE)
+        if ld is not None:
+            out[KEY] = ld
+        if rd is not None:
+            out[VALUE] = rd
+        return out
 
     def _materialize(self) -> Block:
         lb = self.left.block()
@@ -2283,6 +2486,9 @@ class _RenameRDD(_NarrowRDD):
             (mapping.get(nm, nm), dt) for nm, dt in pschema))
         self._mapping = dict(mapping)
         self._user_fn = tuple(sorted(mapping.items()))
+        # Dictionaries follow their columns to the new names (identity
+        # for unrenamed columns).
+        self._dict_renames = {mapping.get(nm, nm): nm for nm, _ in pschema}
 
     def _shard_fn(self, cols, count):
         return {self._mapping.get(nm, nm): col
@@ -2312,6 +2518,8 @@ class _OnesValueRDD(_NarrowRDD):
         out.append((VALUE, jnp.int32))
         super().__init__(parent, tuple(out))
         self._user_fn = "ones_value"
+        # KEY passes through (keeps its dictionary); VALUE is fresh ones.
+        self._dict_renames = {KEY: KEY}
 
     def _shard_fn(self, cols, count):
         out = {nm: cols[nm] for nm in cols if nm in (KEY, KEY_LO)}
@@ -2372,6 +2580,13 @@ def _align_keys(a: DenseRDD, b: DenseRDD):
     sides, or None when only the host tier can match them faithfully
     (mismatched key dtypes — e.g. int32 2 vs float32 2.0 hash apart on
     device but compare equal under Python semantics)."""
+    pair = _unify_dict_cols(a, b, (KEY,))
+    if pair is None:
+        # One side's KEY is dictionary-encoded strings, the other's is
+        # plain ints: a code 2 and an int 2 would match on device but
+        # differ on the host — only the host tier matches faithfully.
+        return None
+    a, b = pair
     sa, sb = dict(a._schema()), dict(b._schema())
     wide_a, wide_b = KEY_LO in sa, KEY_LO in sb
     if wide_a == wide_b:
@@ -2385,6 +2600,175 @@ def _align_keys(a: DenseRDD, b: DenseRDD):
     return (a, widened) if wide_a else (widened, b)
 
 
+class _DictUnification:
+    """Shared host-side dictionary merge for one binary op: both sides'
+    _DictUnifyRDD wrappers reference ONE instance, so the merge runs once
+    and the sides agree bit-identically on the unified code space. The
+    merge itself (np.union1d + searchsorted remap tables,
+    dict_encoding.merge_dicts) is lazy — graph construction stays cheap
+    until a wrapper actually needs the tables."""
+
+    def __init__(self, left_dicts, right_dicts, names):
+        self.names = tuple(names)
+        self._left = {nm: left_dicts[nm] for nm in self.names}
+        self._right = {nm: right_dicts[nm] for nm in self.names}
+        self._memo = None
+
+    def tables(self):
+        """(merged, left_maps, right_maps): per-name merged sorted
+        dictionary plus int32 remap tables (old code -> merged code)."""
+        if self._memo is None:
+            from vega_tpu.tpu import dict_encoding
+
+            merged, lmaps, rmaps = {}, {}, {}
+            for nm in self.names:
+                m, lt, rt = dict_encoding.merge_dicts(
+                    self._left[nm], self._right[nm])
+                merged[nm], lmaps[nm], rmaps[nm] = m, lt, rt
+            self._memo = (merged, lmaps, rmaps)
+        return self._memo
+
+    def token(self):
+        """Cheap picklable identity for fingerprints — input dictionary
+        shapes and endpoints, no forced merge. Collisions only alias
+        capacity HINTS (the overflow retry is the safety net, as ever)."""
+        out = []
+        for nm in self.names:
+            for d in (self._left[nm], self._right[nm]):
+                out.append((nm, len(d),
+                            str(d[0]) if len(d) else "",
+                            str(d[-1]) if len(d) else ""))
+        return tuple(out)
+
+
+class _DictUnifyRDD(_NarrowRDD):
+    """Remap one side's dictionary codes onto the shared merged
+    dictionary: ONE device gather through a staged remap table per
+    unified column. The staged table capacity is a REAL capacity
+    (Configuration.dense_dict_capacity): a valid code at or past the
+    staged prefix sets the device overflow flag — checked on the RAW
+    codes, like the dense-key table plan — and the driver retries with
+    the capacity doubled. Monotonic remap (sorted dicts in, sorted merge
+    out), so per-shard key order survives; hash placement does NOT (the
+    codes hashed into buckets changed), hence the default hash_placed
+    False."""
+
+    _chainable = False  # own program (replicated table operands)
+
+    def __init__(self, parent: DenseRDD, unif: _DictUnification, side: int):
+        super().__init__(parent, parent._schema())
+        self._unif = unif
+        self._side = side
+        self._dict_retries = 0  # overflow->grown-capacity rounds (tests)
+        self._user_fn = ("dict_unify", side, unif.token())
+
+    def _dicts(self):
+        merged = self._unif.tables()[0]
+        out = dict(self.parent._dicts())
+        for nm in self._unif.names:
+            if nm in out:
+                out[nm] = merged[nm]
+        return out
+
+    @property
+    def key_sorted(self) -> bool:
+        return self.parent.key_sorted  # monotonic remap keeps order
+
+    def _settle_placement(self) -> None:
+        self.parent._settle_placement()
+
+    def _materialize(self) -> Block:
+        from vega_tpu.tpu import dict_encoding
+
+        blk = self.parent.block()
+        _, lmaps, rmaps = self._unif.tables()
+        side_tables = lmaps if self._side == 0 else rmaps
+        names = [nm for nm in self._unif.names if nm in blk.cols]
+        if not names:
+            return blk
+        in_names = list(blk.cols)
+        cap_tab = max(128, dict_encoding.dict_capacity())
+        table_n = max(len(side_tables[nm]) for nm in names)
+        for _round in range(8):
+            staged_n = tuple(min(len(side_tables[nm]), cap_tab)
+                             for nm in names)
+            tabs = []
+            for nm, sn in zip(names, staged_n):
+                t = np.zeros(cap_tab, dtype=np.int32)
+                t[:sn] = side_tables[nm][:sn]
+                tabs.append(mesh_lib.host_put(
+                    t, mesh_lib.replicated_spec(self.mesh)))
+            n_tab = len(names)
+
+            def prog_fn(*args):
+                tables = dict(zip(names, args[:n_tab]))
+                counts = args[n_tab]
+                cols = dict(zip(in_names, args[n_tab + 1:]))
+                count = counts[0]
+                cap_rows = next(iter(cols.values())).shape[0]
+                valid = kernels.valid_mask(cap_rows, count)
+                flag = jnp.zeros((), jnp.int32)
+                out = dict(cols)
+                for nm, sn in zip(names, staged_n):
+                    codes = cols[nm]
+                    # Overflow checked on the RAW codes (never the
+                    # clamped gather index): any valid code past the
+                    # staged prefix means the table was truncated.
+                    bad = valid & ((codes < 0)
+                                   | (codes >= jnp.int32(sn)))
+                    flag = flag | jnp.any(bad).astype(jnp.int32)
+                    out[nm] = jnp.take(
+                        tables[nm], jnp.clip(codes, 0, cap_tab - 1))
+                return ((flag.reshape(1),)
+                        + tuple(out[nm] for nm in in_names))
+
+            prog = _cached_program(
+                ("dict_remap", self.mesh, tuple(in_names), tuple(names),
+                 cap_tab, staged_n, blk.capacity),
+                lambda: _shard_program(
+                    self.mesh, prog_fn,
+                    tuple([_REPL] * n_tab) + (_SPEC,) * (1 + len(in_names)),
+                    (_SPEC,) * (1 + len(in_names)),
+                ),
+            )
+            outs = prog(*tabs, blk.counts,
+                        *[blk.cols[nm] for nm in in_names])
+            flag = np.asarray(mesh_lib.host_get(outs[0]))
+            if not flag.any():
+                return Block(
+                    cols=dict(zip(in_names, outs[1:])),
+                    counts=blk.counts, capacity=blk.capacity,
+                    mesh=self.mesh, counts_host=blk.counts_host,
+                )
+            self._dict_retries += 1
+            cap_tab *= 2
+        raise VegaError(
+            f"dictionary remap overflowed {table_n} entries after 8 "
+            "capacity-doubling retries — raise dense_dict_capacity"
+        )
+
+
+def _unify_dict_cols(a: DenseRDD, b: DenseRDD, names):
+    """Align the named dictionary-encoded columns of two sides onto one
+    merged dictionary so device code equality == string equality.
+    Returns the (possibly wrapped) sides; (a, b) unchanged when nothing
+    needs remapping (no dict columns, or both sides already share the
+    same dictionary arrays); None when dict-ness MISMATCHES on a name —
+    codes on one side and plain values on the other only compare
+    faithfully on the host tier."""
+    da, db = a._dicts(), b._dicts()
+    shared = [nm for nm in names if nm in da or nm in db]
+    if not shared:
+        return a, b
+    if any((nm in da) != (nm in db) for nm in shared):
+        return None
+    todo = [nm for nm in shared if da[nm] is not db[nm]]
+    if not todo:
+        return a, b
+    unif = _DictUnification(da, db, todo)
+    return _DictUnifyRDD(a, unif, 0), _DictUnifyRDD(b, unif, 1)
+
+
 class _ProjectRDD(_NarrowRDD):
     def __init__(self, parent: DenseRDD, col: str):
         pschema = dict(parent._schema())
@@ -2396,6 +2780,8 @@ class _ProjectRDD(_NarrowRDD):
         super().__init__(parent, ((VALUE, pschema[col]),))
         self._col = col
         self._user_fn = col
+        # The projected column keeps its dictionary under the VALUE name.
+        self._dict_renames = {VALUE: col}
 
     def _shard_fn(self, cols, count):
         return {VALUE: cols[self._col]}, count
@@ -2412,10 +2798,14 @@ class _ColsPipelineRDD(_NarrowRDD):
     its OWN single-step program (the frame A/B's unfused leg)."""
 
     def __init__(self, parent: DenseRDD, cols_fn, out_schema, token,
-                 fused: bool = True):
+                 fused: bool = True, dict_renames=None):
         super().__init__(parent, out_schema)
         self._cols_fn = cols_fn
         self._user_fn = token  # _node_fp pickles this, not the closure
+        # The planner DECLARES which output columns are pass-throughs of
+        # dictionary-encoded parent columns ({out name -> parent name});
+        # everything else is closure-minted and drops its dictionary.
+        self._dict_renames = dict(dict_renames or {})
         if not fused:
             self._chainable = False
 
@@ -2433,11 +2823,14 @@ class _ColsPipelineRDD(_NarrowRDD):
 
 
 def dense_pipeline(parent: DenseRDD, cols_fn, out_schema, token,
-                   fused: bool = True) -> DenseRDD:
+                   fused: bool = True, dict_renames=None) -> DenseRDD:
     """Public factory for _ColsPipelineRDD (the frame planner's whole-stage
     entry). `out_schema` is ((name, dtype), ...); `token` must be a stable
-    picklable description of the pipeline (it keys the program cache)."""
-    return _ColsPipelineRDD(parent, cols_fn, out_schema, token, fused=fused)
+    picklable description of the pipeline (it keys the program cache);
+    `dict_renames` maps output columns that pass a dictionary-encoded
+    parent column through unchanged to that parent column's name."""
+    return _ColsPipelineRDD(parent, cols_fn, out_schema, token, fused=fused,
+                            dict_renames=dict_renames)
 
 
 # ---------------------------------------------------------------------------
@@ -2493,6 +2886,9 @@ class _SourceRDD(DenseRDD):
 
     def _schema(self):
         return tuple((n, c.dtype) for n, c in self._block.cols.items())
+
+    def _dicts(self):
+        return dict(self._block.dicts or {})
 
     def _fp_extra(self):
         return (tuple((n, str(c.dtype)) for n, c in self._block.cols.items()),
@@ -3127,7 +3523,11 @@ class _ExchangeRDD(DenseRDD):
                 ))
             try:
                 prog, args = build_program(slot, out_cap)
-                *outs, overflow = prog(*args)
+                # Launch under the CPU dispatch door: a concurrent
+                # device_get on another task thread (shard_rows /
+                # host_get) deadlocks old XLA:CPU (mesh.device_door).
+                with mesh_lib.device_door():
+                    *outs, overflow = prog(*args)
             finally:
                 if bus is not None:
                     # JAX dispatch is async: prog() returned but the device
@@ -3188,7 +3588,8 @@ class _ExchangeRDD(DenseRDD):
                                                              attempt)
                     attempt += 1
                 prog, args = build_program(slot, out_cap)
-                *outs, overflow = prog(*args)
+                with mesh_lib.device_door():  # see the deferred launch
+                    *outs, overflow = prog(*args)
                 self._last_attempts = round_i + 1
                 # One transfer for (counts, any extra driver-needed outputs,
                 # overflow): each separate device_get is a full round trip
@@ -3447,7 +3848,18 @@ class _ReduceByKeyRDD(_ExchangeRDD):
                 np.asarray(list(slot_of), dtype=np.int64))
             out_cols[KEY], out_cols[block_lib.KEY_LO] = hi, lo
         else:
-            out_cols[KEY] = np.asarray(list(slot_of), dtype=keys.dtype)
+            kdict = self.parent._dicts().get(KEY)
+            if kdict is not None:
+                # to_numpy DECODED a dictionary key to strings; re-encode
+                # through the PARENT dictionary (every key is in it) so
+                # the rebuilt codes stay in the lineage's code space —
+                # from_numpy minting a fresh local dictionary here would
+                # diverge from what _dicts() reports downstream.
+                out_cols[KEY] = np.searchsorted(
+                    kdict, np.asarray(list(slot_of), dtype=kdict.dtype),
+                ).astype(dict_encoding.CODE_DTYPE)
+            else:
+                out_cols[KEY] = np.asarray(list(slot_of), dtype=keys.dtype)
         for nm in vnames:
             col = np.asarray(parent_cols[nm])
             if np.issubdtype(col.dtype, np.integer):
@@ -3998,6 +4410,23 @@ class _JoinRDD(_ExchangeRDD):
                 if nm in (KEY, KEY_LO):
                     continue
                 out += ((_join_rename(nm, prefix), dt),)
+        return out
+
+    def _dicts(self):
+        # KEY: both sides were unified by _align_keys before construction
+        # (or never diverged), so the left side's key dictionary IS the
+        # shared one. Values: each side's dictionary follows its column
+        # through the lv/rv rename.
+        out = {}
+        ld, rd = self.left._dicts(), self.right._dicts()
+        if KEY in ld:
+            out[KEY] = ld[KEY]
+        for prefix, side_d, side in (("lv", ld, self.left),
+                                     ("rv", rd, self.right)):
+            for nm, _dt in side._schema():
+                if nm in (KEY, KEY_LO) or nm not in side_d:
+                    continue
+                out[_join_rename(nm, prefix)] = side_d[nm]
         return out
 
     def _materialize(self) -> Block:

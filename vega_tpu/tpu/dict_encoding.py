@@ -1,0 +1,141 @@
+"""Dictionary encoding: string columns on the device tier.
+
+TPUs have no string dtype, so a string column becomes an int32 CODE column
+plus a host-side dictionary sidecar on the Block (block.Block.dicts):
+``dicts[name][code] == original string``. The dictionary is built SORTED
+(np.unique), so codes are RANK codes — comparing codes compares strings
+(lexicographically), which means sort / take_ordered / min / max run on the
+codes directly with no extra pass. Equality ops (group_by, join, distinct,
+count_by_key) ride the existing exchange/segment-reduce kernels unchanged:
+codes are just another int32 column.
+
+Two blocks encoded independently carry DIFFERENT dictionaries, so their
+codes are not comparable; dense_rdd._DictUnifyRDD remaps both sides onto
+one merged dictionary (host-side merge here + one device remap program
+there) lazily before any keyed binary op. Decode happens only at the
+collect boundary (block._decode_dict_cols).
+
+Hash contract note (CLAUDE.md): HOST placement keeps splitmix64 on the
+string bytes; DEVICE bucketing hashes the codes. Placement may differ
+between tiers — only results must match, and the parity tests assert they
+do.
+
+Everything here is '<U'/'S' numpy arrays and int32 codes — never
+object-dtype arrays (vegalint VG020: an object array must not reach a
+shard program or device kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+CODE_DTYPE = np.int32
+
+
+def dict_enabled() -> bool:
+    """Configuration.dense_dict_enabled, read lazily (env import cycles;
+    callers may run before a Context exists)."""
+    from vega_tpu.env import Env
+
+    return bool(getattr(Env.get().conf, "dense_dict_enabled", True))
+
+
+def dict_capacity() -> int:
+    """Starting capacity of the staged unification remap tables
+    (Configuration.dense_dict_capacity) — a real capacity: overflow sets
+    the device flag and the driver retries doubled."""
+    from vega_tpu.env import Env
+
+    return int(getattr(Env.get().conf, "dense_dict_capacity", 65536))
+
+
+def is_string_array(src: np.ndarray) -> bool:
+    """True for columns that need dictionary encoding: unicode/bytes
+    arrays, or object arrays whose every element is a str (the pandas /
+    pyarrow to_numpy pivot shape). The object scan is a full pass, but it
+    is the SOUND gate — sniffing only the first element would silently
+    stringify mixed object columns."""
+    if src.dtype.kind in ("U", "S"):
+        return True
+    if src.dtype.kind == "O":
+        return len(src) > 0 and all(isinstance(x, str) for x in src.flat)
+    return False
+
+
+def encode_array(src: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """One string column -> (int32 codes, sorted dictionary values).
+
+    np.unique returns the SORTED uniques and per-row indices into them, so
+    the codes are rank codes by construction. Object arrays (all-str) are
+    normalized to a fixed-width '<U' dictionary — no object dtype survives
+    past this point."""
+    src = np.asarray(src)
+    if src.dtype.kind == "O":
+        src = src.astype(np.str_)
+    if len(src) == 0:
+        return (np.zeros(0, dtype=CODE_DTYPE),
+                np.zeros(0, dtype=src.dtype if src.dtype.kind in ("U", "S")
+                         else "<U1"))
+    values, codes = np.unique(src, return_inverse=True)
+    return codes.astype(CODE_DTYPE, copy=False).reshape(-1), values
+
+
+def encode_string_columns(
+    columns: Dict[str, np.ndarray],
+    dicts: Optional[Dict[str, np.ndarray]] = None,
+) -> Tuple[Dict[str, np.ndarray], Optional[Dict[str, np.ndarray]]]:
+    """Replace every string column with its int32 code column; returns
+    (columns, dicts) where dicts maps encoded names to their sorted
+    dictionaries (merged over any caller-provided pre-encoded `dicts` —
+    the parquet/stream paths encode upstream and pass codes through).
+    Idempotent for already-encoded columns (int32 codes pass straight
+    through). With dense_dict_enabled=False, a string column raises the
+    crisp VegaError the callers' fallback contract expects (dense_from_*
+    degrade to the host tier on it)."""
+    out_dicts: Dict[str, np.ndarray] = dict(dicts or {})
+    out: Dict[str, np.ndarray] = {}
+    enabled: Optional[bool] = None  # read the knob once, only if needed
+    for name, col in columns.items():
+        src = np.asarray(col)
+        if not is_string_array(src):
+            out[name] = col
+            continue
+        if enabled is None:
+            enabled = dict_enabled()
+        if not enabled:
+            from vega_tpu.errors import VegaError
+
+            raise VegaError(
+                f"column {name!r} holds strings and dense_dict_enabled is "
+                "off — string columns have no device form without "
+                "dictionary encoding; use the host tier for this data"
+            )
+        codes, values = encode_array(src)
+        out[name] = codes
+        out_dicts[name] = values
+    return out, (out_dicts or None)
+
+
+def merge_dicts(left: np.ndarray, right: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Merge two sorted dictionaries into one sorted dictionary plus the
+    per-side remap tables: merged[left_map[c]] == left[c] (resp. right).
+    The remap is monotonic — both inputs and the merge are sorted — so
+    remapped codes keep rank order and a key-sorted block stays
+    key-sorted through the remap."""
+    merged = np.union1d(left, right)
+    left_map = np.searchsorted(merged, left).astype(CODE_DTYPE)
+    right_map = np.searchsorted(merged, right).astype(CODE_DTYPE)
+    return merged, left_map, right_map
+
+
+def decode_codes(codes: np.ndarray, values: np.ndarray) -> np.ndarray:
+    """codes -> strings via the dictionary (the collect-boundary decode).
+    Out-of-range codes are a programming error upstream — indexing raises
+    rather than papering over them."""
+    codes = np.asarray(codes)
+    if len(values) == 0 and len(codes) == 0:
+        return np.zeros(0, dtype=values.dtype)
+    return values[codes]

@@ -10,6 +10,7 @@ context.rs:209-303).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import jax
@@ -21,6 +22,31 @@ SHARD_AXIS = "shards"
 
 _lock = named_lock("tpu.mesh._lock")
 _default_mesh: Optional[Mesh] = None
+
+# Serializes device program dispatch against host transfers on XLA:CPU.
+_device_door = named_lock("tpu.mesh._device_door")
+
+
+def device_door():
+    """Mutual exclusion between device program dispatch and blocking host
+    transfers, ON THE CPU BACKEND ONLY.
+
+    Old XLA:CPU under --xla_force_host_platform_device_count on a 1-core
+    box deadlocks when one thread sits inside jax.device_get while another
+    dispatches a program (runtime pool starvation: the transfer waits on a
+    computation whose execution needs the thread the dispatcher holds).
+    Block.shard_rows' serialized device_get covered the slice+get pair;
+    the same wedge fires between an exchange launch and a concurrent get
+    (two cogroup partitions materializing their grouped sides on separate
+    task threads). Every launch/transfer that can run on a scheduler task
+    thread takes this door: shard_rows' get, host_get, and
+    _run_exchange's program launches. On real accelerators this is a
+    no-op context — dispatch and transfers pipeline freely. Callers must
+    already be past backend init (the door itself reads
+    jax.default_backend(), which must never run on import paths)."""
+    if jax.default_backend() == "cpu":
+        return _device_door
+    return contextlib.nullcontext()
 
 
 def init_multihost(coordinator: Optional[str] = None,
@@ -221,7 +247,8 @@ def host_get(tree):
                 prog = jax.jit(_identity_outputs,
                                out_shardings=NamedSharding(m, P()))
                 _gather_jit_cache[m] = prog
-            gathered = prog(*[leaves[i] for i in idx])
+            with device_door():
+                gathered = prog(*[leaves[i] for i in idx])
             for i, g in zip(idx, gathered):
                 leaves[i] = g  # fully replicated: locally readable
     # The dense tier's stage-launch transfer itself: DenseRDD.splits
@@ -229,8 +256,9 @@ def host_get(tree):
     # program per stage), so the round trip is that job's own work,
     # bounded by device compute and the bench watchdog — it cannot park
     # other tenants' scheduling.
-    # vegalint: ignore[VG016] — stage-launch transfer on the job's own drive thread (see above)
-    return jax.tree_util.tree_unflatten(treedef, jax.device_get(leaves))
+    with device_door():
+        # vegalint: ignore[VG016] — stage-launch transfer on the job's own drive thread (see above)
+        return jax.tree_util.tree_unflatten(treedef, jax.device_get(leaves))
 
 
 def host_put(value, spec: NamedSharding) -> jax.Array:

@@ -391,12 +391,17 @@ def streamed_npz(ctx, cols: dict, chunk_rows: int, mesh=None
     from vega_tpu.tpu.dense_rdd import dense_from_block
 
     mesh = mesh or mesh_lib.default_mesh()
-    # Encode int64 keys AND wide values ONCE over the full column:
-    # per-chunk encoding would give chunks whose local range fits int32 a
-    # different schema than chunks whose range doesn't, and the
-    # accumulator union needs every chunk block to agree.
+    # Encode int64 keys AND wide values AND string dictionaries ONCE over
+    # the full column: per-chunk encoding would give chunks whose local
+    # range fits int32 a different schema than chunks whose range
+    # doesn't — and per-chunk dictionaries would make every accumulator
+    # union pay a dictionary unification — the accumulator union needs
+    # every chunk block to agree.
+    from vega_tpu.tpu import dict_encoding
+
+    cols, dicts = dict_encoding.encode_string_columns(dict(cols))
     cols = block_lib.encode_value_columns(
-        block_lib.encode_key_columns(dict(cols)))
+        block_lib.encode_key_columns(cols))
     n = len(next(iter(cols.values()))) if cols else 0
     n_chunks = max(1, -(-n // chunk_rows))
 
@@ -407,18 +412,21 @@ def streamed_npz(ctx, cols: dict, chunk_rows: int, mesh=None
             yield dense_from_block(
                 ctx,
                 block_lib.from_numpy(
-                    {name: col[lo:hi] for name, col in cols.items()}, mesh
+                    {name: col[lo:hi] for name, col in cols.items()}, mesh,
+                    dicts=dicts,
                 ),
             )
 
     def resident():
-        return dense_from_block(ctx, block_lib.from_numpy(cols, mesh))
+        return dense_from_block(
+            ctx, block_lib.from_numpy(cols, mesh, dicts=dicts))
 
     def probe():
         if n == 0:
             return None
         tiny = {name: col[:min(n, 8)] for name, col in cols.items()}
-        return dense_from_block(ctx, block_lib.from_numpy(tiny, mesh))
+        return dense_from_block(
+            ctx, block_lib.from_numpy(tiny, mesh, dicts=dicts))
 
     return StreamedDenseRDD(ctx, chunks, resident, n_chunks,
                             make_probe=probe)
